@@ -1,0 +1,50 @@
+"""Gradient codecs: jit-compiled unbiased compression kernels.
+
+Registry mirrors the reference's coder selection (src/distributed_worker.py:
+127-137, which accepts only 'sgd'/'svd' and raises ValueError otherwise;
+'qsgd' exists but is unreachable from that CLI — SURVEY.md §2). Here all four
+are reachable: sgd (dense), svd, qsgd, terngrad.
+"""
+
+from atomo_tpu.codecs.base import (  # noqa: F401
+    Codec,
+    CodecStats,
+    decode_tree,
+    encode_tree,
+    payload_nbytes,
+    tree_nbytes,
+)
+from atomo_tpu.codecs.dense import DenseCodec, DensePayload  # noqa: F401
+from atomo_tpu.codecs.qsgd import QsgdCodec, QsgdPayload, terngrad  # noqa: F401
+from atomo_tpu.codecs.svd import (  # noqa: F401
+    SvdCodec,
+    SvdMaskedPayload,
+    SvdPayload,
+    bernoulli_probs,
+    encode_decode,
+    resize_to_2d,
+    undo_resize,
+)
+
+
+def get_codec(
+    name: str,
+    *,
+    svd_rank: int = 3,
+    quantization_level: int = 2,
+    bucket_size: int = 512,
+    sample: str = "fixed_k",
+):
+    """Build a codec by CLI name (reference --code flag surface + terngrad)."""
+    name = name.lower()
+    if name in ("sgd", "dense", "none"):
+        return DenseCodec()
+    if name == "svd":
+        return SvdCodec(rank=svd_rank, sample=sample)
+    if name == "qsgd":
+        return QsgdCodec(bits=quantization_level, bucket_size=bucket_size)
+    if name == "terngrad":
+        return terngrad(bucket_size=bucket_size)
+    raise ValueError(
+        f"unknown codec {name!r}; expected one of sgd|svd|qsgd|terngrad"
+    )

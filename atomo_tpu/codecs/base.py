@@ -1,0 +1,111 @@
+"""Codec interface: unbiased gradient compression as pure JAX transforms.
+
+Reference parity: src/codings/coding.py:3-11 defines ``Coding.encode/decode``
+raising NotImplementedError; codecs there are stateful Python objects operating
+on numpy arrays outside any compiler. Here a codec is a pair of *pure,
+jit-compilable* functions over fixed-shape pytrees, so encode/decode live
+inside the compiled SPMD step and the wire format is a pytree of dense arrays
+that XLA collectives (all_gather) can move over ICI.
+
+Design rules (TPU-first):
+  * Static shapes only. The reference keeps a random *subset* of atoms
+    (variable length, src/codings/svd.py:49-67); we use fixed-budget sampling
+    so the payload shape is known at trace time.
+  * Unbiasedness is the contract: E_key[decode(encode(key, g))] == g.
+  * ``payload_nbytes`` gives the honest bytes-on-wire metric (the reference's
+    ``Msg(MB)``, src/distributed_worker.py:316-328) as the byte size of the
+    payload pytree, computable at trace time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Protocol
+
+import jax
+import jax.numpy as jnp
+
+Payload = Any  # a pytree of jnp arrays with static shapes
+PRNGKey = jax.Array
+
+
+class Codec(Protocol):
+    """An unbiased gradient compressor.
+
+    ``encode`` maps (key, grad) -> payload; ``decode`` maps payload -> grad
+    with the same shape/dtype as the input. Both must be jit-compilable with
+    static output shapes determined by the input shape alone.
+    """
+
+    name: str
+
+    def encode(self, key: PRNGKey, grad: jax.Array) -> Payload: ...
+
+    def decode(
+        self, payload: Payload, grad_shape: tuple[int, ...], dtype: Any
+    ) -> jax.Array: ...
+
+
+def payload_nbytes(payload: Payload) -> int:
+    """Static byte size of a payload pytree — the Msg(MB) analogue.
+
+    Unlike the reference (len of a pickled+blosc'd bytearray, measured at
+    runtime), this is exact at trace time because every leaf has a static
+    shape and dtype.
+    """
+    leaves = jax.tree_util.tree_leaves(payload)
+    return int(sum(l.size * l.dtype.itemsize for l in leaves))
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Byte size of an arbitrary pytree of arrays (e.g. a dense gradient)."""
+    return payload_nbytes(tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class CodecStats:
+    """Per-encode compression accounting."""
+
+    dense_bytes: int
+    payload_bytes: int
+
+    @property
+    def reduction(self) -> float:
+        return self.dense_bytes / max(self.payload_bytes, 1)
+
+
+def encode_tree(
+    codec: Codec, key: PRNGKey, grads: Any
+) -> tuple[Any, CodecStats]:
+    """Encode every leaf of a gradient pytree with per-leaf folded keys.
+
+    Key discipline: ``jax.random.fold_in(key, leaf_index)`` so each layer gets
+    an independent stream while remaining deterministic given (key) — required
+    for replicated-PS equivalence (every chip must be able to reproduce any
+    other chip's sampling given its key).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    payloads = [
+        codec.encode(jax.random.fold_in(key, i), leaf)
+        for i, leaf in enumerate(leaves)
+    ]
+    stats = CodecStats(
+        dense_bytes=sum(l.size * l.dtype.itemsize for l in leaves),
+        payload_bytes=sum(payload_nbytes(p) for p in payloads),
+    )
+    return jax.tree_util.tree_unflatten(treedef, payloads), stats
+
+
+def decode_tree(codec: Codec, payloads: Any, grads_like: Any) -> Any:
+    """Decode a pytree of payloads back into a gradient pytree.
+
+    ``grads_like`` supplies the treedef; payloads produced by ``encode_tree``
+    are unflattened against it.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(grads_like)
+    p_leaves = treedef.flatten_up_to(payloads)
+    decoded = [
+        codec.decode(p, tuple(g.shape), g.dtype)
+        for p, g in zip(p_leaves, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, decoded)

@@ -1,0 +1,139 @@
+"""QSGD / TernGrad codec: stochastic quantization with uint32 bit-packing.
+
+Reference behavior (src/codings/qsgd.py): flatten the gradient, split into
+buckets (qsgd.py:31-40), per bucket compute a scale (L2 norm for QSGD, clipped
+max-norm for TernGrad, qsgd.py:153-155,212-216), stochastically round each
+|x|/scale onto 2^b-1 levels, and bit-pack sign+magnitude into *uint64* words,
+int(64/(2+b)) values per word (qsgd.py:52-79); decode unpacks masks in reverse
+(qsgd.py:89-151).
+
+TPU-first redesign: TPU vector units have no native 64-bit integer lanes
+(SURVEY.md §2.9), so the word layout is *uint32* with (1+b) bits per value —
+1 sign bit + b magnitude bits, floor(32/(1+b)) values per word. Packing and
+unpacking are pure vectorized shift/mask ops (no Python loops over values),
+jit-compiled, with shapes fixed by the input size. Stochastic rounding uses
+``jax.random`` instead of numpy (qsgd.py:47-50).
+
+The whole encode (and decode) runs inside the compiled step function; the
+payload (words, scales) is what an all_gather moves over ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from atomo_tpu.codecs.base import PRNGKey
+
+
+class QsgdPayload(NamedTuple):
+    words: jax.Array  # (n_words,) uint32 bit-packed sign+magnitude codes
+    scales: jax.Array  # (n_buckets,) float32 per-bucket scale
+
+
+def _bits_per_value(bits: int) -> int:
+    return bits + 1  # 1 sign bit + `bits` magnitude bits
+
+
+def _vals_per_word(bits: int) -> int:
+    return 32 // _bits_per_value(bits)
+
+
+def pack_u32(codes: jax.Array, bits: int) -> jax.Array:
+    """Pack small unsigned codes (< 2^(bits+1)) into uint32 words.
+
+    Vectorized analogue of the reference's per-value uint64 shifting loop
+    (qsgd.py:52-79): reshape to (n_words, vals_per_word) and reduce with
+    per-lane shifts.
+    """
+    bpv = _bits_per_value(bits)
+    vpw = _vals_per_word(bits)
+    n = codes.shape[0]
+    n_words = -(-n // vpw)
+    padded = jnp.zeros((n_words * vpw,), jnp.uint32).at[:n].set(codes.astype(jnp.uint32))
+    lanes = padded.reshape(n_words, vpw)
+    shifts = (jnp.arange(vpw, dtype=jnp.uint32) * bpv)[None, :]
+    # lane bit-fields are disjoint, so a sum is a bitwise OR
+    return jnp.sum(lanes << shifts, axis=1, dtype=jnp.uint32)
+
+
+def unpack_u32(words: jax.Array, bits: int, n: int) -> jax.Array:
+    """Inverse of :func:`pack_u32`; returns the first ``n`` codes."""
+    bpv = _bits_per_value(bits)
+    vpw = _vals_per_word(bits)
+    mask = jnp.uint32((1 << bpv) - 1)
+    shifts = (jnp.arange(vpw, dtype=jnp.uint32) * bpv)[None, :]
+    lanes = (words[:, None] >> shifts) & mask
+    return lanes.reshape(-1)[:n]
+
+
+@dataclasses.dataclass(frozen=True)
+class QsgdCodec:
+    """Stochastic b-bit quantization with per-bucket scaling.
+
+    bits: magnitude bits; levels = 2^bits - 1 (reference --quantization-level).
+    bucket_size: values per scale (reference --bucket-size, default 512).
+    scheme: "qsgd" (L2-norm scale) or "terngrad" (max-norm scale + 2.5-sigma
+        clip, qsgd.py:212-216; terngrad implies bits=1 in the reference).
+    """
+
+    bits: int = 2
+    bucket_size: int = 512
+    scheme: str = "qsgd"
+    name: str = "qsgd"
+
+    @property
+    def levels(self) -> int:
+        return (1 << self.bits) - 1
+
+    def encode(self, key: PRNGKey, grad: jax.Array) -> QsgdPayload:
+        x = grad.astype(jnp.float32).reshape(-1)
+        n = x.shape[0]
+        if self.scheme == "terngrad":
+            # clip at 2.5 sigma of the whole tensor (qsgd.py:212-216)
+            sigma = jnp.std(x)
+            limit = 2.5 * sigma
+            x = jnp.clip(x, -limit, limit)
+
+        b = self.bucket_size
+        n_buckets = -(-n // b)
+        padded = jnp.zeros((n_buckets * b,), jnp.float32).at[:n].set(x)
+        buckets = padded.reshape(n_buckets, b)
+
+        if self.scheme == "terngrad":
+            scales = jnp.max(jnp.abs(buckets), axis=1)
+        else:
+            scales = jnp.linalg.norm(buckets, axis=1)
+        safe = jnp.maximum(scales, jnp.finfo(jnp.float32).tiny)
+
+        y = jnp.abs(buckets) / safe[:, None] * self.levels
+        lo = jnp.floor(y)
+        frac = y - lo
+        rnd = jax.random.uniform(key, buckets.shape)
+        level = jnp.clip(lo + (rnd < frac), 0, self.levels).astype(jnp.uint32)
+        sign = (buckets < 0).astype(jnp.uint32)
+        codes = (sign << self.bits) | level
+        words = pack_u32(codes.reshape(-1), self.bits)
+        return QsgdPayload(words=words, scales=scales.astype(jnp.float32))
+
+    def decode(
+        self, payload: QsgdPayload, grad_shape: tuple[int, ...], dtype=jnp.float32
+    ) -> jax.Array:
+        n = 1
+        for d in grad_shape:
+            n *= d
+        b = self.bucket_size
+        n_buckets = payload.scales.shape[0]
+        codes = unpack_u32(payload.words, self.bits, n_buckets * b).reshape(n_buckets, b)
+        level = (codes & jnp.uint32(self.levels)).astype(jnp.float32)
+        sign = 1.0 - 2.0 * ((codes >> self.bits) & 1).astype(jnp.float32)
+        vals = sign * level / self.levels * payload.scales[:, None]
+        return vals.reshape(-1)[:n].reshape(grad_shape).astype(dtype)
+
+
+def terngrad(bucket_size: int = 512) -> QsgdCodec:
+    """TernGrad = 1-bit-magnitude QSGD with max-norm scale + sigma clip."""
+    return QsgdCodec(bits=1, bucket_size=bucket_size, scheme="terngrad", name="terngrad")

@@ -1,0 +1,205 @@
+"""ATOMO's SVD codec: atomic gradient sparsification on the singular-value basis.
+
+Reference behavior (src/codings/svd.py): reshape the gradient to 2-D
+(`_resize_to_2d`, svd.py:12-28), take a thin SVD (svd.py:95), Bernoulli-sample
+singular triplets with probabilities proportional to their singular values
+(`_sample_svd`, svd.py:49-67: p_i = min(1, rank * s_i / sum(s)), recurse if
+nothing kept), rescale kept values by 1/p_i for unbiasedness, ship the kept
+(U, s, Vt) columns; decode = U @ diag(s) @ Vt reshaped back (svd.py:160-178).
+
+TPU-first redesign — two sampling modes, both unbiased:
+
+* ``fixed_k`` (the wire format): sample exactly ``rank`` atoms *with
+  replacement*, atom i drawn with probability q_i = s_i / sum(s); estimator
+  sum_j s_{i_j} / (rank * q_{i_j}) * u_{i_j} v_{i_j}^T. Unbiased
+  (E = sum_i q_i * s_i/q_i u_i v_i^T / rank * rank = X) with a *static*
+  payload shape (m*k + k + k*n floats), which is what an XLA all_gather
+  needs. The reference's variable-length Bernoulli keep-set cannot be
+  expressed with static shapes without either padding to the full width or
+  biased truncation.
+* ``bernoulli`` (reference-faithful semantics): the exact reference
+  probabilities p_i = min(1, rank * s_i / sum(s)) (or s/s[0] when rank==0,
+  svd.py:54-56), keep-mask applied to the *full-width* factors. Payload is
+  full-size (no bytes win) — used for in-process compression studies and as
+  the oracle in unbiasedness tests, mirroring how the reference master uses
+  deterministic top-k (random_sample=False, svd.py:109-113).
+
+Deviation notes (SURVEY.md §7 'reference bug compatibility'): the reference's
+encode-path name shadowing of the nuclear indicator (svd.py:97-101), the dead
+code after return (svd.py:180-197) and the CUDA branch are not reproduced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from atomo_tpu.codecs.base import PRNGKey
+
+
+class SvdPayload(NamedTuple):
+    """Fixed-shape wire format: ``rank`` sampled (and 1/p-rescaled) atoms."""
+
+    u: jax.Array  # (m, k) sampled left singular vectors
+    coeff: jax.Array  # (k,) s_i / (k * q_i) importance-sampling coefficients
+    vt: jax.Array  # (k, n) sampled right singular vectors
+    # static metadata (hashable python ints via dataclass? kept as arrays is
+    # wasteful — shape info travels out-of-band in `meta`)
+
+
+class SvdMaskedPayload(NamedTuple):
+    """Full-width masked factors (reference-faithful Bernoulli mode)."""
+
+    u: jax.Array  # (m, r)
+    s: jax.Array  # (r,) masked + 1/p rescaled singular values
+    vt: jax.Array  # (r, n)
+
+
+def resize_to_2d(x: jax.Array) -> tuple[jax.Array, tuple[int, ...], int]:
+    """Reshape an arbitrary-rank gradient to 2-D for SVD.
+
+    Same shape policy as the reference `_resize_to_2d` (src/codings/svd.py:12-28):
+      * scalars/0-d -> (1, 1)
+      * 1-D (n,)    -> (n/2, 2) when n is even (reference assumes even); odd
+                       sizes are zero-padded by one element first (deviation:
+                       the reference would crash on odd n).
+      * 2-D         -> unchanged
+      * >=3-D (a, b, *c) -> (a*b/2, 2*prod(c)) when a*b even, else (a*b, prod(c))
+
+    Returns (matrix, original_shape, pad) where ``pad`` is the number of
+    zero elements appended before reshaping (0 or 1, only for odd 1-D).
+    """
+    shape = tuple(x.shape)
+    if x.ndim == 0:
+        return x.reshape(1, 1), shape, 0
+    if x.ndim == 1:
+        n = shape[0]
+        pad = n % 2
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((1,), x.dtype)])
+        return x.reshape((n + pad) // 2, 2), shape, pad
+    if x.ndim == 2:
+        return x, shape, 0
+    a, b = shape[0], shape[1]
+    rest = 1
+    for d in shape[2:]:
+        rest *= d
+    m = a * b
+    if m % 2 == 0:
+        return x.reshape(m // 2, 2 * rest), shape, 0
+    return x.reshape(m, rest), shape, 0
+
+
+def undo_resize(mat: jax.Array, orig_shape: tuple[int, ...], pad: int) -> jax.Array:
+    """Inverse of :func:`resize_to_2d`."""
+    flat = mat.reshape(-1)
+    if pad:
+        flat = flat[:-pad]
+    return flat.reshape(orig_shape)
+
+
+def _safe_probs(s: jax.Array) -> jax.Array:
+    """q_i = s_i / sum(s), falling back to uniform for an all-zero spectrum."""
+    total = jnp.sum(s)
+    r = s.shape[0]
+    uniform = jnp.full_like(s, 1.0 / r)
+    return jnp.where(total > 0, s / jnp.where(total > 0, total, 1.0), uniform)
+
+
+def bernoulli_probs(s: jax.Array, rank: int) -> jax.Array:
+    """Reference keep-probabilities (src/codings/svd.py:49-60).
+
+    rank == 0: p_i = s_i / s_0 (relative to the largest singular value);
+    rank >= 1: p_i = clip(rank * s_i / sum(s), 0, 1).
+    """
+    if rank == 0:
+        p = s / jnp.maximum(s[0], jnp.finfo(s.dtype).tiny)
+    else:
+        p = rank * s / jnp.maximum(jnp.sum(s), jnp.finfo(s.dtype).tiny)
+    return jnp.clip(p, 0.0, 1.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class SvdCodec:
+    """Atomic sparsification with a fixed atom budget (static wire shape)."""
+
+    rank: int = 3
+    sample: str = "fixed_k"  # "fixed_k" | "bernoulli" | "topk"
+    name: str = "svd"
+
+    # -- encode ------------------------------------------------------------
+    def encode(self, key: PRNGKey, grad: jax.Array):
+        mat, orig_shape, pad = resize_to_2d(grad.astype(jnp.float32))
+        m, n = mat.shape
+        r_full = min(m, n)
+        u, s, vt = jnp.linalg.svd(mat, full_matrices=False)
+
+        if self.sample == "bernoulli":
+            p = bernoulli_probs(s, self.rank)
+            keep = jax.random.bernoulli(key, p).astype(s.dtype)
+            s_hat = jnp.where(p > 0, s * keep / jnp.maximum(p, jnp.finfo(s.dtype).tiny), 0.0)
+            return SvdMaskedPayload(u=u, s=s_hat, vt=vt)
+
+        k = min(self.rank, r_full) if self.rank > 0 else r_full
+        if self.sample == "topk":
+            # Deterministic top-k — the reference master's random_sample=False
+            # path (svd.py:109-113). Biased; used for decode-side parity.
+            coeff = s[:k]
+            return SvdPayload(u=u[:, :k], coeff=coeff, vt=vt[:k, :])
+
+        # fixed_k importance sampling with replacement
+        q = _safe_probs(s)
+        idx = jax.random.categorical(
+            key, jnp.log(jnp.maximum(q, jnp.finfo(q.dtype).tiny)), shape=(k,)
+        )
+        coeff = s[idx] / (k * jnp.maximum(q[idx], jnp.finfo(q.dtype).tiny))
+        # all-zero gradient: s[idx] == 0 -> coeff 0, decode gives exact zeros
+        return SvdPayload(u=u[:, idx], coeff=coeff, vt=vt[idx, :])
+
+    # -- decode ------------------------------------------------------------
+    def decode_matrix(self, payload) -> jax.Array:
+        """Reconstruct the 2-D matrix: U @ diag(c) @ Vt (svd.py:171-175).
+
+        HIGHEST matmul precision: on TPU the MXU's default bf16 passes would
+        corrupt the reconstructed gradient; full-f32 accumulation keeps the
+        decode bit-stable across replicas (replicated-PS equivalence).
+        """
+        if isinstance(payload, SvdMaskedPayload):
+            scaled, vt = payload.u * payload.s[None, :], payload.vt
+        else:
+            scaled, vt = payload.u * payload.coeff[None, :], payload.vt
+        return jnp.matmul(scaled, vt, precision=jax.lax.Precision.HIGHEST)
+
+    def decode(self, payload, grad_shape: tuple[int, ...], dtype=jnp.float32) -> jax.Array:
+        """Reconstruct the gradient from a payload + static shape metadata."""
+        probe = jnp.zeros(grad_shape, dtype)
+        _, orig_shape, pad = resize_to_2d(probe)
+        return undo_resize(self.decode_matrix(payload), orig_shape, pad).astype(dtype)
+
+    def make_decoder(self, grad_shape: tuple[int, ...], dtype=jnp.float32):
+        """Return decode(payload) -> grad for a known gradient shape.
+
+        Shape metadata travels out-of-band (it is static), not on the wire —
+        unlike the reference which pickles `orig_size`/`reshaped` flags into
+        every message (svd.py:103-117).
+        """
+        probe = jnp.zeros(grad_shape, dtype)
+        _, orig_shape, pad = resize_to_2d(probe)
+
+        def decode(payload):
+            return undo_resize(self.decode_matrix(payload), orig_shape, pad).astype(dtype)
+
+        return decode
+
+
+def encode_decode(codec: SvdCodec, key: PRNGKey, grad: jax.Array) -> jax.Array:
+    """Round-trip helper: compress-then-decompress one gradient in-process.
+
+    This is the single-host 'compression on, comm off' mode (SURVEY.md §7
+    build-order step 4 / the reference's single_machine study path).
+    """
+    payload = codec.encode(key, grad)
+    return codec.make_decoder(tuple(grad.shape), grad.dtype)(payload)

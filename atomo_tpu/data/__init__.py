@@ -1,0 +1,15 @@
+"""Data layer: datasets (disk or synthetic) + TPU-first input pipeline."""
+
+from atomo_tpu.data.datasets import (  # noqa: F401
+    SPECS,
+    ArrayDataset,
+    DatasetSpec,
+    canonical_name,
+    load_dataset,
+    synthetic_dataset,
+)
+from atomo_tpu.data.pipeline import (  # noqa: F401
+    BatchIterator,
+    augment_batch,
+    normalize,
+)

@@ -1,0 +1,93 @@
+"""Input pipeline: host-side batching + device-side jit augmentation.
+
+Reference parity: the CIFAR train transform is pad-4 reflect -> random crop
+32 -> random horizontal flip -> normalize (src/distributed_nn.py:104-120);
+MNIST/SVHN use normalize(-ish) only. The reference runs these per-sample in
+Python worker processes (the vendored DataLoader fork,
+src/data_loader_ops/my_data_loader.py). TPU-first redesign: augmentation is
+a pure vmapped jnp function executed *on device inside the compiled step* —
+no Python-loop per-sample work, no multiprocess reorder queues; the host
+only shuffles indices and slices batches.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from atomo_tpu.data.datasets import ArrayDataset
+
+
+def normalize(images: jax.Array, mean, std) -> jax.Array:
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    return (images - mean) / std
+
+
+def augment_batch(key: jax.Array, images: jax.Array, pad: int = 4) -> jax.Array:
+    """Pad-reflect -> per-image random crop -> random horizontal flip.
+
+    Pure, static-shape, vmapped: runs on the TPU inside the train step.
+    """
+    n, h, w, _ = images.shape
+    kc, kf = jax.random.split(key)
+    padded = jnp.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect"
+    )
+    offsets = jax.random.randint(kc, (n, 2), 0, 2 * pad + 1)
+    flips = jax.random.bernoulli(kf, 0.5, (n,))
+
+    def crop_one(img, off, flip):
+        out = jax.lax.dynamic_slice(
+            img, (off[0], off[1], 0), (h, w, img.shape[-1])
+        )
+        return jnp.where(flip, out[:, ::-1, :], out)
+
+    return jax.vmap(crop_one)(padded, offsets, flips)
+
+
+class BatchIterator:
+    """Epoch-shuffled batch stream over an in-memory dataset.
+
+    Replaces the reference's vendored multiprocess DataLoader
+    (my_data_loader.py:310-319, incl. its persistent `next_batch`): with
+    device-side augmentation the host work is an index shuffle + gather,
+    which numpy does faster than a worker pool for these dataset sizes.
+    """
+
+    def __init__(
+        self,
+        dataset: ArrayDataset,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.RandomState(seed)
+        self.images = dataset.normalized()
+        self.labels = dataset.labels
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def epoch(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.shuffle:
+            self._rng.shuffle(idx)
+        stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
+        for s in range(0, stop, self.batch_size):
+            sel = idx[s : s + self.batch_size]
+            yield self.images[sel], self.labels[sel]
+
+    def forever(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        while True:
+            yield from self.epoch()

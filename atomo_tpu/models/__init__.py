@@ -1,0 +1,79 @@
+"""Flax model zoo, name-dispatched like the reference build_model
+(src/distributed_worker.py:139-164 / src/sync_replicas_master_nn.py:146-171).
+
+Reference CLI names: LeNet, ResNet18, ResNet34, FC, DenseNet, VGG11, AlexNet.
+Extended (capability superset): ResNet50/101/152/110, VGG13/16/19 (+ _bn),
+DenseNet100.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+
+from atomo_tpu.models.alexnet import AlexNet, alexnet  # noqa: F401
+from atomo_tpu.models.densenet import (  # noqa: F401
+    DenseNet,
+    densenet_bc_100,
+    densenet_reference,
+)
+from atomo_tpu.models.lenet import FCNN, LeNet  # noqa: F401
+from atomo_tpu.models.resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet110,
+    ResNet152,
+)
+from atomo_tpu.models.vgg import (  # noqa: F401
+    VGG,
+    vgg11,
+    vgg11_bn,
+    vgg13,
+    vgg13_bn,
+    vgg16,
+    vgg16_bn,
+    vgg19,
+    vgg19_bn,
+)
+
+_REGISTRY: dict[str, Callable[[int], nn.Module]] = {
+    # reference CLI surface
+    "lenet": lambda n: LeNet(num_classes=n),
+    "fc": lambda n: FCNN(num_classes=n),
+    "resnet18": ResNet18,
+    "resnet34": ResNet34,
+    "densenet": densenet_reference,
+    "vgg11": vgg11_bn,  # the reference's VGG11 is vgg11_bn (worker :153-154)
+    "alexnet": alexnet,
+    # capability superset
+    "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "resnet152": ResNet152,
+    "resnet110": ResNet110,
+    "densenet100": densenet_bc_100,
+    "vgg11_plain": vgg11,
+    "vgg13": vgg13_bn,
+    "vgg16": vgg16_bn,
+    "vgg19": vgg19_bn,
+    "vgg13_plain": vgg13,
+    "vgg16_plain": vgg16,
+    "vgg19_plain": vgg19,
+}
+
+
+def get_model(name: str, num_classes: int = 10) -> nn.Module:
+    """Build a model by CLI name (case-insensitive)."""
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"unknown network {name!r}; known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[key](num_classes)
+
+
+def model_names() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,49 @@
+"""AlexNet, as a Flax module.
+
+Architecture parity with src/model_ops/alexnet.py:13-47 (the torchvision
+'one weird trick' variant): 5 conv features with 3 maxpools, classifier
+Dropout -> 4096 -> ReLU -> Dropout -> 4096 -> ReLU -> num_classes.
+
+Note: the reference wires AlexNet into its CIFAR CLI
+(src/distributed_worker.py:154-155) although the 224x224 feature geometry
+collapses 32x32 inputs to zero spatial size — i.e. the reference's AlexNet
+path only works on ImageNet-sized inputs. We keep the faithful geometry and
+flatten dynamically, so 224x224 inputs reproduce the 256*6*6 classifier
+input; small inputs raise a clear shape error instead of a torch crash.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(64, (11, 11), strides=4, padding=2)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(192, (5, 5), padding=2)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), padding=1)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding=1)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding=1)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        if x.shape[1] == 0 or x.shape[2] == 0:
+            raise ValueError(
+                f"AlexNet features collapsed to spatial size {x.shape[1:3]}; "
+                "input must be >= 63x63 (224x224 canonical)."
+            )
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def alexnet(num_classes: int = 1000) -> AlexNet:
+    return AlexNet(num_classes=num_classes)

@@ -1,0 +1,72 @@
+"""DenseNet-BC for CIFAR, as a Flax module.
+
+Architecture parity with src/model_ops/densenet.py:18-116: pre-activation
+dense layers (BN-ReLU-Conv1x1(4k)-BN-ReLU-Conv3x3(k) bottleneck, or
+BN-ReLU-Conv3x3 single), channel-concat growth, Transition =
+BN-ReLU-Conv1x1(compression)-AvgPool2, three dense blocks of
+(depth-4)/3 layers (halved when bottlenecked), final BN-ReLU-GlobalAvgPool
+-> linear head. The reference CLI instantiates growthRate=40, depth=190,
+reduction=0.5, bottleneck=True (src/distributed_worker.py:149-151); the
+standard DenseNet-BC-100 (k=12) is also provided.
+
+Deviation: the head returns logits (the reference applies log_softmax in
+forward, densenet.py:115, and then feeds CrossEntropyLoss — a double-log
+bug noted in SURVEY.md §7; we return logits and apply the loss once).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DenseNet(nn.Module):
+    growth_rate: int = 12
+    depth: int = 100
+    reduction: float = 0.5
+    num_classes: int = 10
+    bottleneck: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9)
+        k = self.growth_rate
+        n_layers = (self.depth - 4) // 3
+        if self.bottleneck:
+            n_layers //= 2
+
+        def dense_layer(x):
+            out = nn.relu(norm()(x))
+            if self.bottleneck:
+                out = nn.Conv(4 * k, (1, 1), use_bias=False)(out)
+                out = nn.relu(norm()(out))
+            out = nn.Conv(k, (3, 3), padding=1, use_bias=False)(out)
+            return jnp.concatenate([x, out], axis=-1)
+
+        def transition(x, out_ch):
+            out = nn.Conv(out_ch, (1, 1), use_bias=False)(nn.relu(norm()(x)))
+            return nn.avg_pool(out, (2, 2), strides=(2, 2))
+
+        channels = 2 * k
+        x = nn.Conv(channels, (3, 3), padding=1, use_bias=False)(x)
+        for block in range(3):
+            for _ in range(n_layers):
+                x = dense_layer(x)
+            channels += n_layers * k
+            if block < 2:
+                channels = int(math.floor(channels * self.reduction))
+                x = transition(x, channels)
+        x = nn.relu(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def densenet_bc_100(num_classes: int = 10) -> DenseNet:
+    return DenseNet(growth_rate=12, depth=100, num_classes=num_classes)
+
+
+def densenet_reference(num_classes: int = 10) -> DenseNet:
+    """The reference CLI's (enormous) DenseNet config (worker build_model)."""
+    return DenseNet(growth_rate=40, depth=190, reduction=0.5, num_classes=num_classes)

@@ -1,0 +1,52 @@
+"""LeNet and FC_NN — the reference's small MNIST nets, as Flax modules.
+
+Architecture parity (not code translation):
+  * LeNet: conv(1->20, k5, valid) -> maxpool2 -> relu -> conv(20->50, k5)
+    -> maxpool2 -> relu -> flatten(4*4*50) -> fc 500 -> fc 10, matching
+    src/model_ops/lenet.py:12-35 (note the reference pools *before* relu —
+    kept, since max-pool and relu commute it is also mathematically equal).
+  * FC_NN: 784 -> 800 -> 500 -> 10, relu/relu/sigmoid, matching
+    src/model_ops/fc_nn.py:12-30 (the sigmoid on the output into a
+    cross-entropy loss is a reference quirk, reproduced for parity).
+
+Layout deviation: NHWC (TPU-native) instead of torch NCHW. The 'split'
+variants (lenet.py:37-229) are deliberately absent: their purpose —
+overlapping per-layer backward with per-layer gradient sends — is subsumed
+by XLA's async collectives (SURVEY.md §7 build-order step 2).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        x = nn.Conv(20, (5, 5), padding="VALID")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(50, (5, 5), padding="VALID")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(500)(x)
+        x = nn.Dense(self.num_classes)(x)
+        return x
+
+
+class FCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(800)(x))
+        x = nn.relu(nn.Dense(500)(x))
+        x = nn.sigmoid(nn.Dense(self.num_classes)(x))
+        return x

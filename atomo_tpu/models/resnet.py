@@ -1,0 +1,128 @@
+"""CIFAR-style ResNets (18/34/50/101/152) + ResNet-110, as Flax modules.
+
+Architecture parity with src/model_ops/resnet.py:14-127 (the kuangliu
+CIFAR variant): 3x3 stem conv (64 ch, stride 1), 4 stages of BasicBlock /
+Bottleneck with plane widths 64/128/256/512, stride-2 downsampling at stage
+entry, 1x1-conv+BN shortcut when shape changes, 4x4 average pool, linear head.
+Depths: 18=[2,2,2,2], 34=[3,4,6,3] basic; 50=[3,4,6,3], 101=[3,4,23,3],
+152=[3,8,36,3] bottleneck (expansion 4).
+
+ResNet-110 is the classic 6n+2 (n=18) three-stage CIFAR ResNet with plane
+widths 16/32/64 (He et al. 2015, Table 6) — required by the BASELINE config
+ladder (config 5), not present in the reference zoo.
+
+Deviations: NHWC layout; flax BatchNorm momentum 0.9 == torch momentum 0.1;
+the reference's `full_modules` bookkeeping lists (resnet.py:19-36) are
+unnecessary — per-layer gradient access falls out of the params pytree.
+The reference's ResNet34 NameError on `num_classes` (resnet.py:117-118,
+SURVEY.md §7 bug list) is fixed, not reproduced.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9)
+        out = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1, use_bias=False)(x)
+        out = nn.relu(norm()(out))
+        out = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(out)
+        out = norm()(out)
+        if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
+            x = nn.Conv(
+                self.planes * self.expansion, (1, 1), strides=self.stride, use_bias=False
+            )(x)
+            x = norm()(x)
+        return nn.relu(out + x)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda: nn.BatchNorm(use_running_average=not train, momentum=0.9)
+        out = nn.relu(norm()(nn.Conv(self.planes, (1, 1), use_bias=False)(x)))
+        out = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1, use_bias=False)(out)
+        out = nn.relu(norm()(out))
+        out = nn.Conv(self.planes * self.expansion, (1, 1), use_bias=False)(out)
+        out = norm()(out)
+        if self.stride != 1 or x.shape[-1] != self.planes * self.expansion:
+            x = nn.Conv(
+                self.planes * self.expansion, (1, 1), strides=self.stride, use_bias=False
+            )(x)
+            x = norm()(x)
+        return nn.relu(out + x)
+
+
+class ResNet(nn.Module):
+    """4-stage CIFAR ResNet (stem 64ch), ref resnet.py:75-112."""
+
+    block: type
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(64, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+        for stage, (planes, n) in enumerate(zip((64, 128, 256, 512), self.num_blocks)):
+            for i in range(n):
+                stride = (2 if stage > 0 else 1) if i == 0 else 1
+                x = self.block(planes=planes, stride=stride)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global avg == avg_pool2d(out, 4) on 4x4
+        return nn.Dense(self.num_classes)(x)
+
+
+class ResNetCifar3Stage(nn.Module):
+    """6n+2 three-stage ResNet (16/32/64 planes) — ResNet-110 with n=18."""
+
+    n: int = 18
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9)(x))
+        for stage, planes in enumerate((16, 32, 64)):
+            for i in range(self.n):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = BasicBlock(planes=planes, stride=stride)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def ResNet18(num_classes: int = 10) -> ResNet:
+    return ResNet(block=BasicBlock, num_blocks=(2, 2, 2, 2), num_classes=num_classes)
+
+
+def ResNet34(num_classes: int = 10) -> ResNet:
+    return ResNet(block=BasicBlock, num_blocks=(3, 4, 6, 3), num_classes=num_classes)
+
+
+def ResNet50(num_classes: int = 10) -> ResNet:
+    return ResNet(block=Bottleneck, num_blocks=(3, 4, 6, 3), num_classes=num_classes)
+
+
+def ResNet101(num_classes: int = 10) -> ResNet:
+    return ResNet(block=Bottleneck, num_blocks=(3, 4, 23, 3), num_classes=num_classes)
+
+
+def ResNet152(num_classes: int = 10) -> ResNet:
+    return ResNet(block=Bottleneck, num_blocks=(3, 8, 36, 3), num_classes=num_classes)
+
+
+def ResNet110(num_classes: int = 10) -> ResNetCifar3Stage:
+    return ResNetCifar3Stage(n=18, num_classes=num_classes)

@@ -1,0 +1,85 @@
+"""CIFAR VGG 11/13/16/19 (+BN variants), as Flax modules.
+
+Architecture parity with src/model_ops/vgg.py:15-108: feature configs
+A/B/D/E (3x3 convs, 'M' = 2x2 maxpool), classifier
+Dropout -> 512 -> ReLU -> Dropout -> 512 -> ReLU -> num_classes.
+The reference CLI's VGG11 is the batch-norm variant (vgg11_bn,
+src/distributed_worker.py:153-154).
+
+Deviations: NHWC; He-normal conv init matches the reference's manual
+normal_(0, sqrt(2/n)) fan-out init (vgg.py:32-36).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+CFGS: dict[str, list] = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    batch_norm: bool = False
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(v), (3, 3), padding=1, kernel_init=kernel_init)(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(512)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def _vgg(cfg: str, bn: bool, num_classes: int) -> VGG:
+    return VGG(cfg=tuple(CFGS[cfg]), batch_norm=bn, num_classes=num_classes)
+
+
+def vgg11(num_classes: int = 10) -> VGG:
+    return _vgg("A", False, num_classes)
+
+
+def vgg11_bn(num_classes: int = 10) -> VGG:
+    return _vgg("A", True, num_classes)
+
+
+def vgg13(num_classes: int = 10) -> VGG:
+    return _vgg("B", False, num_classes)
+
+
+def vgg13_bn(num_classes: int = 10) -> VGG:
+    return _vgg("B", True, num_classes)
+
+
+def vgg16(num_classes: int = 10) -> VGG:
+    return _vgg("D", False, num_classes)
+
+
+def vgg16_bn(num_classes: int = 10) -> VGG:
+    return _vgg("D", True, num_classes)
+
+
+def vgg19(num_classes: int = 10) -> VGG:
+    return _vgg("E", False, num_classes)
+
+
+def vgg19_bn(num_classes: int = 10) -> VGG:
+    return _vgg("E", True, num_classes)

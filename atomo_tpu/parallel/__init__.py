@@ -1,0 +1,13 @@
+"""Parallelism layer: meshes, replicated compressed-DP, distributed init."""
+
+from atomo_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharded,
+    make_mesh,
+    replicated,
+)
+from atomo_tpu.parallel.replicated import (  # noqa: F401
+    make_distributed_eval_step,
+    make_distributed_train_step,
+    replicate_state,
+    shard_batch,
+)

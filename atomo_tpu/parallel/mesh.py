@@ -1,0 +1,52 @@
+"""Device mesh construction — the TPU-native replacement for the reference's
+MPI world (mpirun -n <P+1> --hostfile, src/run_pytorch.sh:1).
+
+The reference topology is 1 master + N workers over TCP
+(src/distributed_nn.py:243-259). SPMD has no master: every chip runs the
+same compiled program; the 'parameter server' is the replicated update.
+Axis taxonomy (forward-looking — the reference is DP-only, SURVEY.md §2.1):
+
+  dp  data parallelism (the reference's workers)           — first-class
+  sp  sequence/context parallelism (ring attention)        — atomo_tpu.parallel.ring
+  tp  tensor parallelism                                   — reserved
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axes: Sequence[tuple[str, int]] = (),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh.
+
+    Default: 1-D ('dp', n) over all visible devices. Pass ``axes`` as
+    [('dp', 4), ('sp', 2)] for multi-axis layouts; sizes must multiply to
+    the device count.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    if not axes:
+        axes = (("dp", len(devs)),)
+    names = tuple(a for a, _ in axes)
+    sizes = tuple(s for _, s in axes)
+    if int(np.prod(sizes)) != len(devs):
+        raise ValueError(f"mesh axes {axes} need {np.prod(sizes)} devices, have {len(devs)}")
+    arr = np.asarray(devs).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

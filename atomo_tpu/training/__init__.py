@@ -1,0 +1,13 @@
+"""Training runtimes: single-host trainer, replicated distributed trainer,
+optimizers, checkpointing."""
+
+from atomo_tpu.training.optim import make_optimizer, stepwise_shrink  # noqa: F401
+from atomo_tpu.training.trainer import (  # noqa: F401
+    TrainState,
+    create_state,
+    cross_entropy_loss,
+    evaluate,
+    make_eval_step,
+    make_train_step,
+    train_loop,
+)

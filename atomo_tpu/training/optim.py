@@ -1,0 +1,69 @@
+"""Optimizers + the reference LR schedule, as optax transforms.
+
+The reference's distinguishing optimizer trait is that `step(grads=...)`
+consumes *externally supplied* (decoded, averaged) gradients rather than
+`.grad` attributes (src/optim/sgd.py:57-89 — `d_p = torch.from_numpy(
+grads[i])`, weight decay, momentum buffer, Nesterov; src/optim/adam.py:37-94
+with amsgrad). In JAX gradients are ordinary values, so this capability is
+the default: `optimizer.update(decoded_grads, state, params)`.
+
+LR schedule parity: the master shrinks lr to `base * shrinkage^k` every
+`freq` steps, defaults shrinkage=0.95, freq=50
+(src/sync_replicas_master_nn.py:106-107,232-234).
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def stepwise_shrink(
+    base_lr: float, shrinkage: float = 0.95, freq: int = 50
+) -> optax.Schedule:
+    """lr(step) = base * shrinkage ** (step // freq)."""
+
+    def schedule(step):
+        return base_lr * shrinkage ** (step // freq)
+
+    return schedule
+
+
+def make_optimizer(
+    name: str = "sgd",
+    *,
+    lr: float = 0.01,
+    lr_shrinkage: float = 0.95,
+    shrinkage_freq: int = 50,
+    momentum: float = 0.0,
+    nesterov: bool = False,
+    weight_decay: float = 0.0,
+    beta1: float = 0.9,
+    beta2: float = 0.999,
+    eps: float = 1e-8,
+    amsgrad: bool = False,
+) -> optax.GradientTransformation:
+    """Build the replicated-PS optimizer (runs identically on every chip)."""
+    schedule = stepwise_shrink(lr, lr_shrinkage, shrinkage_freq)
+    name = name.lower()
+    if name == "sgd":
+        chain = []
+        if weight_decay:
+            chain.append(optax.add_decayed_weights(weight_decay))
+        chain.append(
+            optax.sgd(
+                learning_rate=schedule,
+                momentum=momentum if momentum else None,
+                nesterov=nesterov,
+            )
+        )
+        return optax.chain(*chain)
+    if name == "adam":
+        opt = (
+            optax.amsgrad(schedule, b1=beta1, b2=beta2, eps=eps)
+            if amsgrad
+            else optax.adam(schedule, b1=beta1, b2=beta2, eps=eps)
+        )
+        if weight_decay:
+            return optax.chain(optax.add_decayed_weights(weight_decay), opt)
+        return opt
+    raise ValueError(f"unknown optimizer {name!r}; expected sgd|adam")

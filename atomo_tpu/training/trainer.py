@@ -1,0 +1,206 @@
+"""Single-host trainer: the reference `single_machine.py` / `NN_Trainer`
+equivalent, with optional in-loop gradient compression.
+
+Reference behavior (src/nn_ops.py:101-189): per batch zero_grad -> forward ->
+cross-entropy -> backward -> optimizer.step -> prec@1/5 log; per epoch
+validate. This trainer adds the 'compression on, comm off' mode (SURVEY.md §7
+build-order step 4): each step's gradient is encoded and decoded in-graph
+before the optimizer update, so codec effects on convergence are measurable
+without a mesh — the oracle against which distributed runs are compared
+(§4 'single_machine as correctness baseline').
+
+Everything (forward, backward, augment, encode, decode, update) is one
+compiled XLA program per step; the host loop only feeds batches and reads
+metrics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from flax.core import FrozenDict
+
+from atomo_tpu.codecs import decode_tree, encode_tree
+from atomo_tpu.data.pipeline import augment_batch
+from atomo_tpu.utils.metrics import StepMetrics, Timer, accuracy
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    augment: bool = False
+    compress_in_loop: bool = False
+    label_smoothing: float = 0.0
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def create_state(model, optimizer, rng, sample_input) -> TrainState:
+    variables = model.init(
+        {"params": rng, "dropout": jax.random.PRNGKey(0)}, sample_input, train=False
+    )
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", FrozenDict())
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=optimizer.init(params),
+    )
+
+
+def make_train_step(model, optimizer, codec=None, augment: bool = False):
+    """Build the jitted single-host train step.
+
+    codec != None applies encode->decode to the gradient pytree in-graph
+    (per-leaf folded PRNG keys) before the optimizer — the compression
+    study path.
+    """
+
+    def loss_fn(params, batch_stats, images, labels, dropout_key):
+        variables = {"params": params}
+        has_bn = bool(jax.tree_util.tree_leaves(batch_stats))
+        if has_bn:
+            variables["batch_stats"] = batch_stats
+        out = model.apply(
+            variables,
+            images,
+            train=True,
+            rngs={"dropout": dropout_key},
+            mutable=["batch_stats"] if has_bn else [],
+        )
+        logits, mutated = out
+        new_stats = mutated.get("batch_stats", batch_stats)
+        loss = cross_entropy_loss(logits, labels)
+        return loss, (logits, new_stats)
+
+    @jax.jit
+    def train_step(state: TrainState, key: jax.Array, images, labels):
+        k_aug, k_drop, k_codec = jax.random.split(jax.random.fold_in(key, state.step), 3)
+        if augment:
+            images = augment_batch(k_aug, images)
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state.batch_stats, images, labels, k_drop)
+
+        msg_bytes = 0
+        if codec is not None:
+            payloads, stats = encode_tree(codec, k_codec, grads)
+            grads = decode_tree(codec, payloads, grads)
+            msg_bytes = stats.payload_bytes
+
+        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        prec1, prec5 = accuracy(logits, labels)
+        metrics = {
+            "loss": loss,
+            "prec1": prec1,
+            "prec5": prec5,
+            "msg_bytes": jnp.asarray(msg_bytes, jnp.int32),
+        }
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=new_stats,
+                opt_state=new_opt,
+            ),
+            metrics,
+        )
+
+    return train_step
+
+
+def make_eval_step(model):
+    @jax.jit
+    def eval_step(state: TrainState, images, labels):
+        variables = {"params": state.params}
+        if jax.tree_util.tree_leaves(state.batch_stats):
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, images, train=False)
+        loss = cross_entropy_loss(logits, labels)
+        prec1, prec5 = accuracy(logits, labels)
+        return {"loss": loss, "prec1": prec1, "prec5": prec5}
+
+    return eval_step
+
+
+def evaluate(model, state: TrainState, test_iter) -> dict[str, float]:
+    """Full-test-set metrics (the reference validate, nn_ops.py:171-189)."""
+    eval_step = make_eval_step(model)
+    totals: dict[str, float] = {"loss": 0.0, "prec1": 0.0, "prec5": 0.0}
+    n = 0
+    for images, labels in test_iter.epoch():
+        m = eval_step(state, jnp.asarray(images), jnp.asarray(labels))
+        bs = images.shape[0]
+        for k_ in totals:
+            totals[k_] += float(m[k_]) * bs
+        n += bs
+    return {k_: v / max(n, 1) for k_, v in totals.items()}
+
+
+def train_loop(
+    model,
+    optimizer,
+    train_iter,
+    test_iter=None,
+    *,
+    codec=None,
+    augment: bool = False,
+    max_steps: int = 100,
+    eval_freq: int = 0,
+    seed: int = 0,
+    log_fn=print,
+    log_every: int = 1,
+) -> TrainState:
+    """The reference train_and_validate loop (nn_ops.py:123-169), jitted."""
+    sample_images, _ = next(iter(train_iter.epoch()))
+    state = create_state(
+        model, optimizer, jax.random.PRNGKey(seed), jnp.asarray(sample_images)
+    )
+    step_fn = make_train_step(model, optimizer, codec=codec, augment=augment)
+    key = jax.random.PRNGKey(seed + 1)
+    timer = Timer()
+    epoch = 0
+    stream = train_iter.forever()
+    n_train = len(train_iter.dataset)
+    for step in range(1, max_steps + 1):
+        images, labels = next(stream)
+        state, metrics = step_fn(state, key, jnp.asarray(images), jnp.asarray(labels))
+        if log_every and step % log_every == 0:
+            rec = StepMetrics(
+                rank=0,
+                step=step,
+                epoch=step * train_iter.batch_size // max(n_train, 1),
+                samples_seen=(step * train_iter.batch_size) % max(n_train, 1),
+                dataset_size=n_train,
+                loss=float(metrics["loss"]),
+                time_cost=timer.lap(),
+                msg_bytes=int(metrics["msg_bytes"]),
+                prec1=float(metrics["prec1"]),
+                prec5=float(metrics["prec5"]),
+            )
+            log_fn(rec.worker_line())
+        if eval_freq and test_iter is not None and step % eval_freq == 0:
+            ev = evaluate(model, state, test_iter)
+            log_fn(
+                "Validation: Step: {}, Loss: {:.4f}, Prec@1: {:.4f}, Prec@5: {:.4f}".format(
+                    step, ev["loss"], ev["prec1"], ev["prec5"]
+                )
+            )
+    return state

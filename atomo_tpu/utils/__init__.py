@@ -1,0 +1,8 @@
+"""Shared utilities: metrics, logging, tracing."""
+
+from atomo_tpu.utils.metrics import (  # noqa: F401
+    StepMetrics,
+    Timer,
+    accuracy,
+    master_line,
+)

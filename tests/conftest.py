@@ -1,0 +1,26 @@
+"""Test harness: simulate an 8-device TPU mesh on CPU.
+
+Multi-chip hardware is not available in CI; all mesh/sharding tests run on
+XLA's host platform with 8 virtual devices (SURVEY.md §4 'Implication for the
+new framework'). Env vars must be set before jax is first imported.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)

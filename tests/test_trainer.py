@@ -1,0 +1,88 @@
+"""End-to-end single-host trainer tests: the 'minimum slice' milestone
+(SURVEY.md §7 build-order step 4 / BASELINE config 1: LeNet + entry-wise
+sparsification, single process)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from atomo_tpu.codecs import QsgdCodec, SvdCodec
+from atomo_tpu.data import BatchIterator, load_dataset, synthetic_dataset, SPECS
+from atomo_tpu.models import get_model
+from atomo_tpu.training import evaluate, make_optimizer, train_loop
+from atomo_tpu.training.optim import stepwise_shrink
+
+
+def _iters(name="mnist", batch=32, train_n=512, test_n=128):
+    train = synthetic_dataset(SPECS[name], True, size=train_n)
+    test = synthetic_dataset(SPECS[name], False, size=test_n)
+    return (
+        BatchIterator(train, batch, seed=0),
+        BatchIterator(test, batch, shuffle=False, seed=0),
+    )
+
+
+def test_lr_schedule_reference_semantics():
+    # lr = base * 0.95^(step // 50)  (sync_replicas_master_nn.py:232-234)
+    sched = stepwise_shrink(0.01, 0.95, 50)
+    assert float(sched(0)) == 0.01
+    assert float(sched(49)) == 0.01
+    np.testing.assert_allclose(float(sched(50)), 0.0095)
+    np.testing.assert_allclose(float(sched(100)), 0.01 * 0.95**2)
+
+
+def test_lenet_learns_uncompressed():
+    train_it, test_it = _iters()
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.05, momentum=0.9)
+    logs = []
+    state = train_loop(
+        model, opt, train_it, max_steps=60, log_fn=logs.append, log_every=10
+    )
+    ev = evaluate(model, state, test_it)
+    assert ev["prec1"] > 30.0, ev  # well above 10% chance on blob data
+    assert any(line.startswith("Worker: 0, Step:") for line in logs)
+
+
+def test_lenet_learns_with_qsgd_codec():
+    train_it, test_it = _iters()
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.05, momentum=0.9)
+    codec = QsgdCodec(bits=2, bucket_size=512)
+    state = train_loop(
+        model, opt, train_it, codec=codec, max_steps=60, log_every=0
+    )
+    ev = evaluate(model, state, test_it)
+    assert ev["prec1"] > 30.0, ev
+
+
+def test_lenet_learns_with_svd_codec():
+    train_it, test_it = _iters()
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.05, momentum=0.9)
+    codec = SvdCodec(rank=3)
+    state = train_loop(
+        model, opt, train_it, codec=codec, max_steps=60, log_every=0
+    )
+    ev = evaluate(model, state, test_it)
+    assert ev["prec1"] > 25.0, ev
+
+
+def test_worker_log_line_matches_tuning_regex():
+    """The tuning parser regex (tiny_tuning_parser.py:17-19) must match."""
+    import re
+
+    from atomo_tpu.utils.metrics import StepMetrics
+
+    line = StepMetrics(
+        rank=1, step=5, epoch=0, samples_seen=640, dataset_size=50000,
+        loss=2.3021, time_cost=0.5, comp_dur=0.1, encode_dur=0.2,
+        comm_dur=0.1, msg_bytes=1048576, prec1=12.5, prec5=50.0,
+    ).worker_line()
+    pat = (
+        r"Worker: .*, Step: .*, Epoch: .* \[.* \(.*\)\], Loss: (.*), "
+        r"Time Cost: .*, Comp: .*, Encode:  .*, Comm:  .*, Msg\(MB\):  .*"
+    )
+    m = re.search(pat, line)
+    assert m, line
+    assert float(m.group(1).split(",")[0]) == 2.3021
