@@ -1,0 +1,286 @@
+"""Command-line interface — the reference's entry-point surface, TPU-native.
+
+Parity target: the argparse block of src/distributed_nn.py:31-82 (every flag
+accepted, same names/defaults where meaningful) so the reference's job
+scripts (src/run_pytorch.sh, src/tune.sh, src/evaluate_pytorch.sh) translate
+mechanically. Deviations are honest:
+
+  --comm-type     accepted, ignored with a warning — it is "a fake parameter"
+                  in the reference too (README.md:111).
+  --no-cuda /
+  --enable-gpu    accepted, ignored — device selection belongs to JAX/XLA.
+  --num-aggregate accepted, ignored with a warning — the reference stores it
+                  but always waits for all workers
+                  (sync_replicas_master_nn.py:113,124; SURVEY.md §2.1).
+  --compress      in the reference this flag is stored but never read in the
+                  step path (SURVEY.md §5.6); here it controls lossless
+                  checkpoint compression via the C++ native codec.
+  --epochs        the reference calls it "somehow redundant" (README.md:115);
+                  training length is --max-steps, epochs only caps it.
+
+Subcommands:
+  train      single-host or mesh-distributed training (rank dispatch in the
+             reference, distributed_nn.py:243-259, collapses to --n-devices)
+  evaluate   checkpoint-polling evaluator (src/distributed_evaluator.py)
+  tune       LR grid search (src/tune.sh + src/tiny_tuning_parser.py)
+
+`python -m atomo_tpu.cli <flags>` with no subcommand behaves like `train`,
+matching `python distributed_nn.py <flags>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import warnings
+
+
+def _add_fit_args(parser: argparse.ArgumentParser) -> None:
+    """Reference flag surface (distributed_nn.py:31-82) + TPU-native extras."""
+    g = parser.add_argument_group("reference-parity flags")
+    g.add_argument("--batch-size", type=int, default=128, metavar="N")
+    g.add_argument("--test-batch-size", type=int, default=1000, metavar="N")
+    g.add_argument("--max-steps", type=int, default=10000, metavar="N")
+    g.add_argument("--epochs", type=int, default=100, metavar="N")
+    g.add_argument("--lr", type=float, default=0.01, metavar="LR")
+    g.add_argument("--momentum", type=float, default=0.5, metavar="M")
+    g.add_argument("--lr-shrinkage", type=float, default=0.95, metavar="M")
+    g.add_argument("--no-cuda", action="store_true", default=False)
+    g.add_argument("--seed", type=int, default=1, metavar="S")
+    g.add_argument("--log-interval", type=int, default=10, metavar="N")
+    g.add_argument("--network", type=str, default="LeNet", metavar="N")
+    g.add_argument("--code", type=str, default="sgd",
+                   help="codec: sgd | svd | qsgd | terngrad")
+    g.add_argument("--bucket-size", type=int, default=512)
+    g.add_argument("--dataset", type=str, default="MNIST", metavar="N")
+    g.add_argument("--comm-type", type=str, default="Bcast", metavar="N")
+    g.add_argument("--num-aggregate", type=int, default=5, metavar="N")
+    g.add_argument("--eval-freq", type=int, default=50, metavar="N")
+    g.add_argument("--train-dir", type=str, default="output/models/", metavar="N")
+    g.add_argument("--compress", action="store_true", default=False,
+                   help="lossless-compress checkpoints (C++ native codec)")
+    g.add_argument("--enable-gpu", action="store_true", default=False)
+    g.add_argument("--svd-rank", type=int, default=0)
+    g.add_argument("--quantization-level", type=int, default=4)
+
+    t = parser.add_argument_group("tpu-native flags")
+    t.add_argument("--n-devices", type=int, default=0,
+                   help="devices in the dp mesh; 0 = all visible, 1 = single-host")
+    t.add_argument("--aggregate", type=str, default="gather",
+                   choices=["gather", "psum"],
+                   help="factor all_gather vs dense psum aggregation")
+    t.add_argument("--sample", type=str, default="fixed_k",
+                   choices=["fixed_k", "bernoulli", "topk"],
+                   help="SVD atom sampling mode")
+    t.add_argument("--optimizer", type=str, default="sgd", choices=["sgd", "adam"])
+    t.add_argument("--weight-decay", type=float, default=0.0)
+    t.add_argument("--nesterov", action="store_true", default=False)
+    t.add_argument("--shrinkage-freq", type=int, default=50,
+                   help="steps between lr shrink (reference hardcodes 50)")
+    t.add_argument("--data-root", type=str, default="./data")
+    t.add_argument("--synthetic", action="store_true", default=False,
+                   help="force the synthetic dataset (offline smoke runs)")
+    t.add_argument("--no-augment", action="store_true", default=False)
+    t.add_argument("--save-freq", type=int, default=0,
+                   help="checkpoint every N steps (0 = only at eval-freq)")
+    t.add_argument("--resume", action="store_true", default=False)
+
+
+def _warn_dead_flags(args: argparse.Namespace) -> None:
+    if args.comm_type != "Bcast":
+        warnings.warn(
+            "--comm-type is accepted for parity but ignored (it is a fake "
+            "parameter in the reference too, README.md:111)"
+        )
+    if args.num_aggregate != 5:
+        warnings.warn(
+            "--num-aggregate is accepted for parity but ignored: the reference "
+            "always waits for all workers (sync_replicas_master_nn.py:113,124); "
+            "SPMD aggregation is likewise all-replica"
+        )
+    if args.enable_gpu or args.no_cuda:
+        warnings.warn("--enable-gpu/--no-cuda are ignored: device selection is JAX's")
+
+
+def _num_classes(dataset: str) -> int:
+    from atomo_tpu.data import SPECS, canonical_name
+
+    return SPECS[canonical_name(dataset)].num_classes
+
+
+def _build_common(args: argparse.Namespace, need_train: bool = True):
+    from atomo_tpu.codecs import get_codec
+    from atomo_tpu.data import BatchIterator, load_dataset, synthetic_dataset, SPECS, canonical_name
+    from atomo_tpu.models import get_model
+    from atomo_tpu.training import make_optimizer
+
+    name = canonical_name(args.dataset)
+    train_iter = None
+    if need_train:  # the evaluator never touches the train split
+        if args.synthetic:
+            train_ds = synthetic_dataset(SPECS[name], True)
+        else:
+            train_ds = load_dataset(name, args.data_root, train=True)
+        train_iter = BatchIterator(train_ds, args.batch_size, seed=args.seed)
+    if args.synthetic:
+        test_ds = synthetic_dataset(SPECS[name], False)
+    else:
+        test_ds = load_dataset(name, args.data_root, train=False)
+    test_iter = BatchIterator(
+        test_ds, args.test_batch_size, shuffle=False, drop_last=False, seed=args.seed
+    )
+    model = get_model(args.network, _num_classes(args.dataset))
+    optimizer = make_optimizer(
+        args.optimizer,
+        lr=args.lr,
+        lr_shrinkage=args.lr_shrinkage,
+        shrinkage_freq=args.shrinkage_freq,
+        momentum=args.momentum,
+        nesterov=args.nesterov,
+        weight_decay=args.weight_decay,
+    )
+    svd_rank = args.svd_rank
+    if svd_rank == 0 and args.sample != "bernoulli":
+        # reference semantics: rank 0 selects the p_i = s_i/s_0 Bernoulli
+        # mode (svd.py:54-56), which only exists for --sample bernoulli;
+        # for the static-shape samplers rank 0 would mean full rank
+        # (payload > dense), so fall back to the canonical rank 3.
+        if args.code.lower() == "svd":
+            warnings.warn(
+                "--svd-rank 0 maps to the reference's rank-0 mode only with "
+                "--sample bernoulli; using rank 3 for the fixed-budget sampler"
+            )
+        svd_rank = 3
+    codec = get_codec(
+        args.code,
+        svd_rank=svd_rank,
+        quantization_level=args.quantization_level,
+        bucket_size=args.bucket_size,
+        sample=args.sample,
+    )
+    if args.code.lower() in ("sgd", "dense", "none"):
+        codec = None  # dense path: plain psum aggregation
+    return model, optimizer, codec, train_iter, test_iter, name
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    import jax
+
+    _warn_dead_flags(args)
+    model, optimizer, codec, train_iter, test_iter, ds_name = _build_common(args)
+    augment = ds_name.startswith("cifar") and not args.no_augment
+    n_train = len(train_iter.dataset)
+    steps_per_epoch = max(n_train // args.batch_size, 1)
+    max_steps = min(args.max_steps, args.epochs * steps_per_epoch)
+    save_freq = args.save_freq or args.eval_freq
+
+    n_dev = args.n_devices or len(jax.devices())
+    if n_dev > 1:
+        from atomo_tpu.parallel import distributed_train_loop, make_mesh
+
+        mesh = make_mesh(n_dev)
+        distributed_train_loop(
+            model, optimizer, mesh, train_iter, test_iter,
+            codec=codec, aggregate=args.aggregate, augment=augment,
+            max_steps=max_steps, eval_freq=args.eval_freq, seed=args.seed,
+            train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
+            compress_ckpt=args.compress, log_every=args.log_interval,
+        )
+    else:
+        from atomo_tpu.training import train_loop
+
+        train_loop(
+            model, optimizer, train_iter, test_iter,
+            codec=codec, augment=augment, max_steps=max_steps,
+            eval_freq=args.eval_freq, seed=args.seed,
+            train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
+            compress_ckpt=args.compress, log_every=args.log_interval,
+        )
+    return 0
+
+
+def cmd_evaluate(args: argparse.Namespace) -> int:
+    from atomo_tpu.training.evaluator import CheckpointEvaluator
+
+    model, optimizer, _, _, test_iter, _ = _build_common(args, need_train=False)
+    ev = CheckpointEvaluator(
+        model, optimizer, test_iter, args.model_dir or args.train_dir,
+        poll_interval=args.poll_interval,
+    )
+    ev.run(max_polls=args.max_polls or None, stop_when_idle=args.stop_when_idle)
+    return 0
+
+
+def cmd_tune(args: argparse.Namespace) -> int:
+    from atomo_tpu.tuning import grid_search
+
+    results = grid_search(args)
+    best = min(results, key=lambda r: r.mean_loss)
+    for r in results:
+        print(f"lr {r.lr:g}: mean loss {r.mean_loss:.4f} over final {r.window} steps")
+    print(f"best lr: {best.lr:g} (mean loss {best.mean_loss:.4f})")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="atomo_tpu",
+        description="TPU-native communication-efficient distributed SGD (ATOMO capabilities)",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    p_train = sub.add_parser("train", help="train a model (single-host or mesh)")
+    _add_fit_args(p_train)
+    p_train.set_defaults(fn=cmd_train)
+
+    p_eval = sub.add_parser("evaluate", help="poll a checkpoint dir and evaluate")
+    _add_fit_args(p_eval)
+    p_eval.add_argument("--model-dir", type=str, default="", metavar="N",
+                        help="checkpoint dir (defaults to --train-dir)")
+    p_eval.add_argument("--poll-interval", type=float, default=10.0)
+    p_eval.add_argument("--max-polls", type=int, default=0, help="0 = forever")
+    p_eval.add_argument("--stop-when-idle", action="store_true", default=False)
+    p_eval.set_defaults(fn=cmd_evaluate)
+
+    p_tune = sub.add_parser("tune", help="LR grid search (src/tune.sh parity)")
+    _add_fit_args(p_tune)
+    p_tune.add_argument("--grid", type=str, default="",
+                        help="comma-separated LRs; default 2^-7..2^-1 (tune.sh:7)")
+    p_tune.add_argument("--tuning-steps", type=int, default=100,
+                        help="steps per LR (tune.sh max_tuning_step)")
+    p_tune.add_argument("--window", type=int, default=10,
+                        help="final steps averaged for the score")
+    p_tune.set_defaults(fn=cmd_tune)
+
+    return parser
+
+
+def _honor_platform_env() -> None:
+    """An explicit JAX_PLATFORMS env var wins over any jax_platforms config
+    a sitecustomize PJRT plugin force-set at interpreter start (config beats
+    env in jax, so without this a user's JAX_PLATFORMS=cpu is ignored and
+    backend init dials external hardware)."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
+
+def main(argv=None) -> int:
+    _honor_platform_env()
+    argv = list(sys.argv[1:] if argv is None else argv)
+    known = {"train", "evaluate", "tune", "-h", "--help"}
+    if argv and argv[0] not in known:
+        argv = ["train"] + argv  # bare flags behave like the reference CLI
+    elif not argv:
+        argv = ["train", "--help"]
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,6 +15,11 @@ from atomo_tpu.codecs.base import (  # noqa: F401
     tree_nbytes,
 )
 from atomo_tpu.codecs.dense import DenseCodec, DensePayload  # noqa: F401
+from atomo_tpu.codecs.indicators import (  # noqa: F401
+    l1_indicator,
+    nuclear_indicator,
+    spectral_atoms_preferred,
+)
 from atomo_tpu.codecs.qsgd import QsgdCodec, QsgdPayload, terngrad  # noqa: F401
 from atomo_tpu.codecs.svd import (  # noqa: F401
     SvdCodec,

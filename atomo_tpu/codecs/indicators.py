@@ -1,0 +1,42 @@
+"""Sparsity indicators — diagnostics guiding atom-basis choice.
+
+Reference parity: src/codings/utils.py:3-8 defines the nuclear indicator
+``sum(s) * sqrt(m + n)`` and the L1 indicator ``||x||_1 * sqrt(numel)``;
+they are used in svd.py:97-101 (with a name-shadowing bug, not reproduced)
+and the research utilities in nn_ops.py:17-23,66-82 to decide whether the
+spectral (SVD) or entry-wise (QSGD) atomic basis sparsifies a gradient
+better: the basis with the smaller indicator yields lower variance at equal
+budget. Both are pure jnp and jit-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from atomo_tpu.codecs.svd import resize_to_2d
+
+
+def nuclear_indicator(mat: jax.Array) -> jax.Array:
+    """sum of singular values * sqrt(m + n)  (utils.py:3-5)."""
+    m, n = mat.shape
+    s = jnp.linalg.svd(mat, compute_uv=False)
+    return jnp.sum(s) * jnp.sqrt(jnp.asarray(m + n, mat.dtype))
+
+def l1_indicator(x: jax.Array) -> jax.Array:
+    """L1 norm * sqrt(numel)  (utils.py:7-8)."""
+    return jnp.sum(jnp.abs(x)) * jnp.sqrt(jnp.asarray(x.size, x.dtype))
+
+
+def spectral_atoms_preferred(
+    grad: jax.Array, policy: str = "square", max_min_dim: int = 512
+) -> jax.Array:
+    """True when the SVD basis beats the entry-wise basis for this gradient
+    (the decision rule of the reference's research path, nn_ops.py:66-82).
+
+    Both indicators are evaluated on the same matricized (possibly padded)
+    matrix so their dimension factors are consistent — the padding zeros
+    leave both the spectrum and the L1 norm unchanged, only the size factors
+    would diverge if one side used the unpadded tensor."""
+    mat, _, _ = resize_to_2d(grad, policy=policy, max_min_dim=max_min_dim)
+    return nuclear_indicator(mat) < l1_indicator(mat)

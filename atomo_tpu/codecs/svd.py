@@ -32,12 +32,14 @@ code after return (svd.py:180-197) and the CUDA branch are not reproduced.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
 from atomo_tpu.codecs.base import PRNGKey
+from atomo_tpu.codecs.dense import DensePayload
 
 
 class SvdPayload(NamedTuple):
@@ -58,10 +60,26 @@ class SvdMaskedPayload(NamedTuple):
     vt: jax.Array  # (r, n)
 
 
-def resize_to_2d(x: jax.Array) -> tuple[jax.Array, tuple[int, ...], int]:
+def _square_dims(total: int, cap: int) -> tuple[int, int]:
+    """Near-square power-of-two matricization, capped at ``cap``.
+
+    Picks m from the two powers of two bracketing sqrt(total) — whichever
+    minimizes the rank-k payload factor m + ceil(total/m) (floor alone can
+    land up to 2x under sqrt and cost ~25% extra wire bytes)."""
+    if total <= 1:
+        return 1, 1
+    lo = 1 << int(math.floor(math.log2(math.sqrt(total))))
+    candidates = [min(lo, cap), min(lo * 2, cap)]
+    m = min(candidates, key=lambda c: c + -(-total // c))
+    return m, -(-total // m)
+
+
+def resize_to_2d(
+    x: jax.Array, policy: str = "reference", max_min_dim: int = 512
+) -> tuple[jax.Array, tuple[int, ...], int]:
     """Reshape an arbitrary-rank gradient to 2-D for SVD.
 
-    Same shape policy as the reference `_resize_to_2d` (src/codings/svd.py:12-28):
+    ``policy="reference"`` follows `_resize_to_2d` (src/codings/svd.py:12-28):
       * scalars/0-d -> (1, 1)
       * 1-D (n,)    -> (n/2, 2) when n is even (reference assumes even); odd
                        sizes are zero-padded by one element first (deviation:
@@ -69,10 +87,29 @@ def resize_to_2d(x: jax.Array) -> tuple[jax.Array, tuple[int, ...], int]:
       * 2-D         -> unchanged
       * >=3-D (a, b, *c) -> (a*b/2, 2*prod(c)) when a*b even, else (a*b, prod(c))
 
+    ``policy="square"`` (the TPU-first default on SvdCodec) flattens and
+    zero-pads to a near-square (m, ceil(total/m)) with m a power of two
+    capped at ``max_min_dim``. Rationale: a rank-k payload costs k*(m+n)
+    floats, minimized at m == n == sqrt(total) — the reference's layouts
+    (e.g. (9, cin*cout) for a flax conv kernel, (cout*cin/2, 2*kh*kw) for a
+    torch one) cap the achievable byte reduction at small multiples, while
+    near-square matricization reaches k*2*sqrt(total)/total. The power-of-two
+    m keeps XLA tilings MXU-friendly; the cap bounds SVD cost (O(m^2 * n)).
+
     Returns (matrix, original_shape, pad) where ``pad`` is the number of
-    zero elements appended before reshaping (0 or 1, only for odd 1-D).
+    zero elements appended to the flattened tensor before reshaping.
     """
     shape = tuple(x.shape)
+    if policy == "square":
+        total = int(x.size)
+        m, n = _square_dims(total, max_min_dim)
+        pad = m * n - total
+        flat = x.reshape(-1)
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), x.dtype)])
+        return flat.reshape(m, n), shape, pad
+    if policy != "reference":
+        raise ValueError(f"unknown resize policy {policy!r}")
     if x.ndim == 0:
         return x.reshape(1, 1), shape, 0
     if x.ndim == 1:
@@ -124,15 +161,42 @@ def bernoulli_probs(s: jax.Array, rank: int) -> jax.Array:
 
 @dataclasses.dataclass(frozen=True)
 class SvdCodec:
-    """Atomic sparsification with a fixed atom budget (static wire shape)."""
+    """Atomic sparsification with a fixed atom budget (static wire shape).
+
+    ``reshape``/``max_min_dim`` select the matricization (see resize_to_2d);
+    tensors too small for SVD to beat dense (k*(m+n+1) >= total, e.g. BN
+    scales and biases) are shipped as exact DensePayloads — the decision is
+    static (shape-only) so both encode and decode agree at trace time.
+    """
 
     rank: int = 3
     sample: str = "fixed_k"  # "fixed_k" | "bernoulli" | "topk"
+    reshape: str = "square"  # "square" | "reference"
+    max_min_dim: int = 512
     name: str = "svd"
+
+    def _resize(self, x: jax.Array):
+        return resize_to_2d(x, policy=self.reshape, max_min_dim=self.max_min_dim)
+
+    def _dense_fallback(self, grad_shape: tuple[int, ...]) -> bool:
+        if self.sample == "bernoulli":
+            return False  # full-width payload by design
+        total = 1
+        for d in grad_shape:
+            total *= d
+        probe_m, probe_n = (
+            _square_dims(total, self.max_min_dim)
+            if self.reshape == "square"
+            else resize_to_2d(jnp.zeros(grad_shape), self.reshape)[0].shape
+        )
+        k = min(self.rank, min(probe_m, probe_n)) if self.rank > 0 else min(probe_m, probe_n)
+        return k * (probe_m + probe_n + 1) >= total
 
     # -- encode ------------------------------------------------------------
     def encode(self, key: PRNGKey, grad: jax.Array):
-        mat, orig_shape, pad = resize_to_2d(grad.astype(jnp.float32))
+        if self._dense_fallback(tuple(grad.shape)):
+            return DensePayload(values=grad.astype(jnp.float32))
+        mat, orig_shape, pad = self._resize(grad.astype(jnp.float32))
         m, n = mat.shape
         r_full = min(m, n)
         u, s, vt = jnp.linalg.svd(mat, full_matrices=False)
@@ -175,9 +239,7 @@ class SvdCodec:
 
     def decode(self, payload, grad_shape: tuple[int, ...], dtype=jnp.float32) -> jax.Array:
         """Reconstruct the gradient from a payload + static shape metadata."""
-        probe = jnp.zeros(grad_shape, dtype)
-        _, orig_shape, pad = resize_to_2d(probe)
-        return undo_resize(self.decode_matrix(payload), orig_shape, pad).astype(dtype)
+        return self.make_decoder(grad_shape, dtype)(payload)
 
     def make_decoder(self, grad_shape: tuple[int, ...], dtype=jnp.float32):
         """Return decode(payload) -> grad for a known gradient shape.
@@ -186,8 +248,13 @@ class SvdCodec:
         unlike the reference which pickles `orig_size`/`reshaped` flags into
         every message (svd.py:103-117).
         """
+        if self._dense_fallback(tuple(grad_shape)):
+            def decode_dense(payload):
+                return payload.values.reshape(grad_shape).astype(dtype)
+
+            return decode_dense
         probe = jnp.zeros(grad_shape, dtype)
-        _, orig_shape, pad = resize_to_2d(probe)
+        _, orig_shape, pad = self._resize(probe)
 
         def decode(payload):
             return undo_resize(self.decode_matrix(payload), orig_shape, pad).astype(dtype)

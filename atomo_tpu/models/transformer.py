@@ -1,0 +1,117 @@
+"""Decoder-only transformer LM — the long-context model family.
+
+The reference's zoo is CV-only (SURVEY.md §2 model row); this family extends
+the framework to sequence models so the sequence/context-parallel machinery
+(atomo_tpu.parallel.ring) has a first-class consumer. Design is TPU-first:
+pre-LN blocks, bias-free linears feeding the MXU, GELU MLP at 4x width,
+learned positional embeddings, all static shapes.
+
+The attention callable is injectable: ``attention_fn(q, k, v)`` receives
+(B, H, S, D). Default is the single-device exact softmax
+(parallel.ring.full_attention); under a mesh with an 'sp' axis pass the
+shard_map-wrapped ring attention (make_sequence_parallel_attention) and the
+same module runs with the sequence dimension sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from atomo_tpu.parallel.ring import full_attention
+
+AttentionFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    head_dim: int
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, _ = x.shape
+        h, d = self.num_heads, self.head_dim
+        qkv = nn.Dense(3 * h * d, use_bias=False, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # (B, S, H*D) -> (B, H, S, D)
+            return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+
+        fn = self.attention_fn or (lambda q, k, v: full_attention(q, k, v, causal=True))
+        out = fn(heads(q), heads(k), heads(v))  # (B, H, S, D)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        return nn.Dense(x.shape[-1], use_bias=False, name="proj")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        width = x.shape[-1]
+        y = nn.LayerNorm(use_bias=False, name="ln1")(x)
+        y = MultiHeadAttention(self.num_heads, self.head_dim, self.attention_fn)(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(use_bias=False, name="ln2")(x)
+        y = nn.Dense(self.mlp_ratio * width, use_bias=False, name="up")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(width, use_bias=False, name="down")(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: int32 tokens (B, S) -> logits (B, S, vocab)."""
+
+    vocab_size: int = 256
+    max_len: int = 1024
+    width: int = 256
+    depth: int = 4
+    num_heads: int = 4
+    dropout: float = 0.0
+    attention_fn: Optional[AttentionFn] = None
+
+    @nn.compact
+    def __call__(
+        self, tokens: jax.Array, train: bool = False, pos_offset=0
+    ) -> jax.Array:
+        """``pos_offset`` is the global position of tokens[:, 0] — pass
+        axis_index(sp) * S_local when the sequence dim is sharded, so every
+        shard embeds its true positions (not local 0..S/n)."""
+        b, s = tokens.shape
+        head_dim = self.width // self.num_heads
+        x = nn.Embed(self.vocab_size, self.width, name="tok_emb")(tokens)
+        pos = nn.Embed(self.max_len, self.width, name="pos_emb")(
+            pos_offset + jnp.arange(s)
+        )
+        x = x + pos[None, :, :]
+        for i in range(self.depth):
+            x = Block(
+                self.num_heads,
+                head_dim,
+                dropout=self.dropout,
+                attention_fn=self.attention_fn,
+                name=f"block{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(use_bias=False, name="ln_f")(x)
+        return nn.Dense(self.vocab_size, use_bias=False, name="head")(x)
+
+
+def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross-entropy: predict tokens[:, 1:] from logits[:, :-1]."""
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], tokens[:, 1:]
+    ).mean()

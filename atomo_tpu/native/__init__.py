@@ -1,0 +1,15 @@
+"""Native (C++) host-side runtime components.
+
+The reference's native-performance surface is entirely third-party C libraries
+(SURVEY.md §2.9: MPI, LAPACK, c-blosc, torch core). The TPU compute path here
+is XLA; this package holds the first-party C++ pieces for the host side:
+
+  lossless  — blosc-equivalent byte codec (shuffle + LZ), restoring the
+              src/utils.py:3-16 / missing-LosslessCompress capability for
+              checkpoints and DCN staging.
+
+The shared library is compiled on demand with g++ (no pip deps) and bound via
+ctypes.
+"""
+
+from atomo_tpu.native import lossless  # noqa: F401

@@ -1,0 +1,118 @@
+"""ctypes binding for the C++ lossless codec (lossless.cc).
+
+Python-level wire format (one header + one LZ stream):
+  magic   4s  b"ALZ1"
+  flags   u8  bit0: shuffled
+  typesz  u8  element size used for the byte shuffle
+  rawlen  u64 little-endian decompressed size
+  payload     LZ stream
+
+API mirrors the reference's blosc wrappers (src/utils.py:3-16):
+``compress(data, typesize=8) -> bytes`` / ``decompress(blob) -> bytes``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "lossless.cc")
+_LIB_PATH = os.path.join(_HERE, "libatomo_native.so")
+_MAGIC = b"ALZ1"
+_HEADER = struct.Struct("<4sBBQ")
+
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> None:
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB_PATH + ".tmp"],
+        check=True,
+        capture_output=True,
+    )
+    os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            _build()
+        lib = ctypes.CDLL(_LIB_PATH)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.atomo_lz_bound.restype = ctypes.c_int64
+        lib.atomo_lz_bound.argtypes = [ctypes.c_int64]
+        lib.atomo_lz_compress.restype = ctypes.c_int64
+        lib.atomo_lz_compress.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+        lib.atomo_lz_decompress.restype = ctypes.c_int64
+        lib.atomo_lz_decompress.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+        lib.atomo_shuffle.restype = None
+        lib.atomo_shuffle.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int32]
+        lib.atomo_unshuffle.restype = None
+        lib.atomo_unshuffle.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int32]
+        _lib = lib
+        return lib
+
+
+def compress(data: bytes, typesize: int = 8, shuffle: bool = True) -> bytes:
+    """Shuffle + LZ compress. ``typesize`` as in blosc (reference uses 8)."""
+    lib = _load()
+    n = len(data)
+    src = (ctypes.c_uint8 * n).from_buffer_copy(data) if n else (ctypes.c_uint8 * 1)()
+    work = (ctypes.c_uint8 * max(n, 1))()
+    if shuffle and typesize > 1 and n >= typesize:
+        lib.atomo_shuffle(src, n, work, typesize)
+        stage, flags = work, 1
+    else:
+        stage, flags, typesize = src, 0, 1
+    cap = int(lib.atomo_lz_bound(n))
+    out = (ctypes.c_uint8 * cap)()
+    written = int(lib.atomo_lz_compress(stage, n, out, cap))
+    if written < 0:
+        raise RuntimeError("atomo_lz_compress failed")
+    if written >= n:  # incompressible: store raw (blosc does the same)
+        header = _HEADER.pack(_MAGIC, flags | 2, typesize, n)
+        return header + bytes(bytearray(stage)[:n])
+    header = _HEADER.pack(_MAGIC, flags, typesize, n)
+    return header + bytes(out[:written])
+
+
+def decompress(blob: bytes) -> bytes:
+    lib = _load()
+    if len(blob) < _HEADER.size:
+        raise ValueError("truncated atomo lossless blob")
+    magic, flags, typesize, rawlen = _HEADER.unpack_from(blob)
+    if magic != _MAGIC:
+        raise ValueError(f"bad magic {magic!r}")
+    payload = blob[_HEADER.size:]
+    n_in = len(payload)
+    src = (ctypes.c_uint8 * max(n_in, 1)).from_buffer_copy(payload) if n_in else (ctypes.c_uint8 * 1)()
+    out = (ctypes.c_uint8 * max(rawlen, 1))()
+    if flags & 2:  # stored raw
+        if n_in != rawlen:
+            raise ValueError(f"corrupt stored blob: {n_in} != {rawlen}")
+        ctypes.memmove(out, src, rawlen)
+    else:
+        got = int(lib.atomo_lz_decompress(src, n_in, out, rawlen))
+        if got != rawlen:
+            raise ValueError(f"corrupt stream: decoded {got} of {rawlen} bytes")
+    if flags & 1:
+        final = (ctypes.c_uint8 * max(rawlen, 1))()
+        lib.atomo_unshuffle(out, rawlen, final, typesize)
+        return bytes(final[:rawlen])
+    return bytes(out[:rawlen])
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,12 @@
+"""Hot-op kernels (Pallas TPU) with jnp fallbacks.
+
+The reference's byte-level hot ops run in numpy on the host (uint64
+bit-packing, qsgd.py:52-79; LAPACK SVD, svd.py:95). Here the hot ops are
+on-device; where XLA's fusion isn't enough, Pallas kernels live in this
+package.
+"""
+
+from atomo_tpu.ops.qsgd_kernels import (  # noqa: F401
+    pallas_quantize_pack,
+    pallas_unpack_dequantize,
+)

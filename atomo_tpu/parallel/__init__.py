@@ -6,6 +6,7 @@ from atomo_tpu.parallel.mesh import (  # noqa: F401
     replicated,
 )
 from atomo_tpu.parallel.replicated import (  # noqa: F401
+    distributed_train_loop,
     make_distributed_eval_step,
     make_distributed_train_step,
     replicate_state,

@@ -1,0 +1,129 @@
+"""Long-context LM training: dp×sp SPMD with compressed gradient exchange.
+
+The capability composition the reference cannot express (DP-only, CV-only —
+SURVEY.md §2.1): a 2-D mesh where
+
+  dp — batch replicas exchanging ATOMO-compressed gradients (all_gather of
+       codec payloads, identical decode+mean on every chip — exactly the
+       replicated-PS semantics of parallel.replicated)
+  sp — the sequence dimension of each replica's batch, attended over with
+       exact ring attention (parallel.ring), gradients dense-psum'd: the sp
+       reduction *forms* one replica's gradient, so it is intra-replica and
+       not part of the compressed inter-replica exchange.
+
+Loss is the exact global next-token cross-entropy: shard-boundary targets
+are fetched from the ring neighbor with ppermute, and the final position of
+the last shard is masked, so sharded and unsharded training compute the same
+scalar.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from atomo_tpu.codecs import decode_tree, encode_tree, tree_nbytes
+from atomo_tpu.models.transformer import TransformerLM
+from atomo_tpu.parallel.ring import ring_attention
+from atomo_tpu.training.trainer import TrainState
+
+
+def make_lm_train_step(
+    lm_config: dict,
+    optimizer,
+    mesh: Mesh,
+    codec=None,
+    *,
+    dp_axis: str = "dp",
+    sp_axis: str = "sp",
+):
+    """Jitted (state, key, tokens) -> (state, metrics) with tokens (B, S)
+    sharded batch-over-dp and sequence-over-sp. ``lm_config`` are
+    TransformerLM kwargs (attention_fn is injected here)."""
+    n_sp = mesh.shape[sp_axis]
+    n_dp = mesh.shape[dp_axis]
+
+    def spmd_step(state: TrainState, key, tokens):
+        model = TransformerLM(
+            **lm_config,
+            attention_fn=partial(
+                ring_attention, axis_name=sp_axis, axis_size=n_sp, causal=True
+            ),
+        )
+        my_dp = jax.lax.axis_index(dp_axis)
+        k_codec = jax.random.fold_in(
+            jax.random.fold_in(key, state.step), my_dp
+        )
+
+        def loss_fn(params):
+            s_local = tokens.shape[1]
+            logits = model.apply(
+                {"params": params},
+                tokens,
+                train=True,
+                pos_offset=jax.lax.axis_index(sp_axis) * s_local,
+            )
+            # boundary target: first token of the next sequence shard
+            nxt = jax.lax.ppermute(
+                tokens[:, :1], sp_axis,
+                [(i, (i - 1) % n_sp) for i in range(n_sp)],
+            )
+            targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+            valid = jnp.ones(targets.shape, jnp.float32)
+            is_last = (jax.lax.axis_index(sp_axis) == n_sp - 1).astype(jnp.float32)
+            valid = valid.at[:, -1].set(1.0 - is_last)
+            total = jax.lax.psum(jnp.sum(valid), sp_axis)
+            return jax.lax.psum(jnp.sum(ce * valid), sp_axis) / total
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        # sp-psum completes THIS replica's gradient (intra-replica, dense)
+        grads = jax.lax.psum(grads, sp_axis)
+
+        dense_bytes = tree_nbytes(grads)
+        if codec is None:
+            mean_grads = jax.lax.pmean(grads, dp_axis)
+            msg_bytes = dense_bytes
+        else:
+            payloads, stats = encode_tree(codec, k_codec, grads)
+            msg_bytes = stats.payload_bytes
+            gathered = jax.lax.all_gather(payloads, dp_axis)
+            decoded = jax.vmap(lambda p: decode_tree(codec, p, grads))(gathered)
+            mean_grads = jax.tree.map(lambda g: jnp.mean(g, axis=0), decoded)
+
+        updates, new_opt = optimizer.update(mean_grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": jax.lax.pmean(loss, dp_axis),
+            "msg_bytes": jnp.asarray(msg_bytes, jnp.int32),
+            "dense_bytes": jnp.asarray(dense_bytes, jnp.int32),
+        }
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=state.batch_stats,
+                opt_state=new_opt,
+            ),
+            metrics,
+        )
+
+    sharded = jax.shard_map(
+        spmd_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(dp_axis, sp_axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def shard_tokens(mesh: Mesh, tokens, dp_axis: str = "dp", sp_axis: str = "sp"):
+    return jax.device_put(
+        jnp.asarray(tokens), NamedSharding(mesh, P(dp_axis, sp_axis))
+    )

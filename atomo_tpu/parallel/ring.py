@@ -1,0 +1,140 @@
+"""Ring attention: exact attention over a sequence-sharded axis.
+
+The reference is CV-only and has no sequence dimension (SURVEY.md §5.7), but
+this framework treats long-context as first-class: a sequence of length S is
+sharded over the mesh axis ``sp`` (S/n per chip), and attention runs exactly
+— not approximately — by rotating key/value blocks around the ring with
+``jax.lax.ppermute`` while accumulating a streaming (online-softmax) partial
+result. Compute for block t overlaps the transfer of block t+1 on the ICI
+torus, which is the TPU-native analogue of the reference's comm/compute
+overlap idea (the split-backward models, resnet_split.py:259-361 — there,
+per-layer Isend under manual backward; here, XLA pipelines the ppermute).
+
+Memory per chip is O(S/n) for activations and O((S/n)^2) for one score block
+— never the full S×S matrix; with n chips the max context grows n× at equal
+per-chip HBM.
+
+All shapes are static; the rotation loop is a ``lax.fori_loop`` (compiler-
+friendly control flow, no Python unrolling at large n).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _online_softmax_block(q, k_blk, v_blk, bias, m_prev, l_prev, o_prev, scale):
+    """One streaming-softmax update: fold a new K/V block into (m, l, o).
+
+    q: (B, H, Sq, D); k_blk/v_blk: (B, H, Sk, D); bias: (Sq, Sk) additive
+    mask (-inf for masked); m/l: (B, H, Sq); o: (B, H, Sq, D).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk, precision=jax.lax.Precision.HIGHEST)
+    s = s * scale + bias[None, None, :, :]
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard -inf (fully masked rows) against NaN in exp(m_prev - m_new)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    o_new = o_prev * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v_blk, precision=jax.lax.Precision.HIGHEST
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact multi-head attention with sequence sharded over ``axis_name``.
+
+    Call inside shard_map with q/k/v of per-chip shape (B, H, S/n, D); the
+    global sequence order is shard-major (chip r holds positions
+    [r*S/n, (r+1)*S/n)). Returns the per-chip output block (B, H, S/n, D).
+    """
+    b, h, s_local, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    my = jax.lax.axis_index(axis_name)
+
+    neg = jnp.float32(-jnp.inf)
+    q_pos = my * s_local + jnp.arange(s_local)  # global query positions
+
+    def body(t, carry):
+        k_blk, v_blk, m, l, o = carry
+        # block t came from chip (my + t) mod n  → its global offset
+        src = (my + t) % axis_size
+        k_pos = src * s_local + jnp.arange(s_local)
+        if causal:
+            bias = jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0, neg)
+        else:
+            bias = jnp.zeros((s_local, s_local), jnp.float32)
+        m, l, o = _online_softmax_block(q, k_blk, v_blk, bias, m, l, o, scale)
+        # rotate K/V one step around the ring (chip r receives from r+1, so
+        # after t rotations we hold the block that started at (my + t) mod n)
+        perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, o
+
+    m0 = jnp.full((b, h, s_local), neg, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    _, _, m, l, o = jax.lax.fori_loop(
+        0, axis_size, body, (k.astype(jnp.float32), v.astype(jnp.float32), m0, l0, o0)
+    )
+    out = o / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)[..., None]
+    return out.astype(q.dtype)
+
+
+def full_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-device exact attention (B, H, S, D) — the oracle ring_attention
+    must match, and the path used when no 'sp' axis is in play."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, precision=jax.lax.Precision.HIGHEST) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(q.dtype)
+
+
+def make_sequence_parallel_attention(mesh: Mesh, axis: str = "sp", causal: bool = True):
+    """shard_map-wrapped ring attention: (B, H, S, D) arrays sharded over
+    ``axis`` on the sequence dim; drop-in for full_attention at S too large
+    for one chip."""
+    n = mesh.shape[axis]
+
+    fn = partial(ring_attention, axis_name=axis, axis_size=n, causal=causal)
+    return jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=(P(None, None, axis, None),) * 3,
+            out_specs=P(None, None, axis, None),
+            check_vma=False,
+        )
+    )

@@ -1,6 +1,13 @@
 """Training runtimes: single-host trainer, replicated distributed trainer,
 optimizers, checkpointing."""
 
+from atomo_tpu.training.checkpoint import (  # noqa: F401
+    latest_step,
+    list_steps,
+    load_checkpoint,
+    load_params,
+    save_checkpoint,
+)
 from atomo_tpu.training.optim import make_optimizer, stepwise_shrink  # noqa: F401
 from atomo_tpu.training.trainer import (  # noqa: F401
     TrainState,

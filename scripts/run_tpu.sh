@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Canonical distributed recipe — the reference's src/run_pytorch.sh:1-20
+# (ResNet-18 / CIFAR-10, batch 128, lr 0.01 shrinking 0.95 per 50 steps,
+# momentum 0, SVD rank 3, sync replicas), re-expressed for an SPMD mesh.
+# No mpirun, no hostfile: every chip runs this same program; on a multi-host
+# pod the TPU runtime starts one process per host automatically.
+set -euo pipefail
+
+python -m atomo_tpu train \
+  --network ResNet18 \
+  --dataset Cifar10 \
+  --batch-size 128 \
+  --test-batch-size 1000 \
+  --max-steps 10000 \
+  --lr 0.01 \
+  --momentum 0.0 \
+  --lr-shrinkage 0.95 \
+  --code svd \
+  --svd-rank 3 \
+  --eval-freq 50 \
+  --train-dir "${TRAIN_DIR:-output/models/}" \
+  "$@"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# LR grid search — the reference's src/tune.sh:7-33 (ResNet-18/CIFAR-10,
+# lr in {2^-7 .. 2^-1}, 100 steps per candidate). Runs in-process instead of
+# spawning 17 MPI ranks per candidate; same scoring contract (mean loss over
+# the final logged steps, parsed from the worker log-line format).
+set -euo pipefail
+
+python -m atomo_tpu tune \
+  --network ResNet18 \
+  --dataset Cifar10 \
+  --batch-size 128 \
+  --code svd \
+  --svd-rank 3 \
+  --tuning-steps 100 \
+  "$@"

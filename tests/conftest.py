@@ -16,6 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# Harden against environments whose sitecustomize force-registers an
+# accelerator PJRT plugin by updating the jax_platforms *config* (which beats
+# the JAX_PLATFORMS env var): re-assert cpu at the config level too, so the
+# suite never dials external hardware.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 import pytest  # noqa: E402

@@ -1,0 +1,121 @@
+"""CLI surface tests: flag parity with src/distributed_nn.py:31-82, subcommand
+dispatch, end-to-end smoke train, tuning parser contract."""
+
+import warnings
+
+import pytest
+
+from atomo_tpu.cli import build_parser, main
+from atomo_tpu.tuning import DEFAULT_GRID, parse_worker_lines
+
+
+REFERENCE_FLAGS = [
+    # every flag the reference CLI accepts (distributed_nn.py:31-82)
+    "--batch-size", "--test-batch-size", "--max-steps", "--epochs", "--lr",
+    "--momentum", "--lr-shrinkage", "--no-cuda", "--seed", "--log-interval",
+    "--network", "--code", "--bucket-size", "--dataset", "--comm-type",
+    "--num-aggregate", "--eval-freq", "--train-dir", "--compress",
+    "--enable-gpu", "--svd-rank", "--quantization-level",
+]
+
+
+def test_reference_flag_parity():
+    parser = build_parser()
+    sub = next(
+        a for a in parser._actions if hasattr(a, "choices") and a.choices
+    )
+    train = sub.choices["train"]
+    known = {s for a in train._actions for s in a.option_strings}
+    missing = [f for f in REFERENCE_FLAGS if f not in known]
+    assert not missing, f"reference flags missing from CLI: {missing}"
+
+
+def test_bare_flags_behave_like_train(tmp_path):
+    """`python -m atomo_tpu --network LeNet ...` == reference invocation."""
+    rc = main([
+        "--network", "LeNet", "--dataset", "MNIST", "--synthetic",
+        "--batch-size", "8", "--max-steps", "2", "--eval-freq", "0",
+        "--log-interval", "0", "--train-dir", str(tmp_path), "--n-devices", "1",
+        "--momentum", "0.0",
+    ])
+    assert rc == 0
+
+
+def test_train_svd_smoke_with_checkpoint(tmp_path):
+    rc = main([
+        "train", "--network", "LeNet", "--dataset", "MNIST", "--synthetic",
+        "--batch-size", "8", "--max-steps", "2", "--eval-freq", "2",
+        "--save-freq", "2", "--log-interval", "0",
+        "--train-dir", str(tmp_path), "--n-devices", "1",
+        "--code", "svd", "--svd-rank", "2", "--momentum", "0.0",
+    ])
+    assert rc == 0
+    assert (tmp_path / "model_step_2").exists()  # reference naming
+
+
+def test_evaluate_subcommand(tmp_path):
+    main([
+        "train", "--network", "LeNet", "--dataset", "MNIST", "--synthetic",
+        "--batch-size", "8", "--max-steps", "2", "--save-freq", "2",
+        "--eval-freq", "0", "--log-interval", "0",
+        "--train-dir", str(tmp_path), "--n-devices", "1", "--momentum", "0.0",
+    ])
+    rc = main([
+        "evaluate", "--network", "LeNet", "--dataset", "MNIST", "--synthetic",
+        "--test-batch-size", "32", "--model-dir", str(tmp_path),
+        "--max-polls", "1", "--stop-when-idle", "--momentum", "0.0",
+    ])
+    assert rc == 0
+
+
+def test_dead_flags_warn_not_crash(tmp_path):
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rc = main([
+            "train", "--network", "LeNet", "--dataset", "MNIST", "--synthetic",
+            "--batch-size", "8", "--max-steps", "1", "--eval-freq", "0",
+            "--log-interval", "0", "--train-dir", str(tmp_path),
+            "--n-devices", "1", "--momentum", "0.0",
+            "--comm-type", "Isend", "--num-aggregate", "3", "--enable-gpu",
+        ])
+    assert rc == 0
+    text = " ".join(str(x.message) for x in w)
+    assert "comm-type" in text and "num-aggregate" in text
+
+
+def test_unknown_network_errors():
+    with pytest.raises(ValueError):
+        main([
+            "train", "--network", "NopeNet", "--dataset", "MNIST",
+            "--synthetic", "--max-steps", "1", "--n-devices", "1",
+        ])
+
+
+def test_tuning_parser_contract():
+    """The regex must parse StepMetrics.worker_line output — the contract the
+    reference's tiny_tuning_parser.py:17-19 relies on."""
+    from atomo_tpu.utils.metrics import StepMetrics
+
+    line = StepMetrics(
+        rank=1, step=42, epoch=3, samples_seen=128, dataset_size=1000,
+        loss=1.2345, time_cost=0.5, msg_bytes=1 << 20, prec1=55.0, prec5=90.0,
+    ).worker_line()
+    losses = parse_worker_lines(line, step=42)
+    assert losses == [1.2345]
+    assert parse_worker_lines(line, step=41) == []
+
+
+def test_default_grid_matches_reference():
+    # tune.sh:7 sweeps 2^-7 .. 2^-1
+    assert DEFAULT_GRID == [2.0**-k for k in range(7, 0, -1)]
+
+
+def test_tune_subcommand_smoke(capsys):
+    rc = main([
+        "tune", "--network", "LeNet", "--dataset", "MNIST", "--synthetic",
+        "--batch-size", "8", "--grid", "0.1,0.01", "--tuning-steps", "3",
+        "--window", "2", "--n-devices", "1", "--momentum", "0.0",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "best lr:" in out

@@ -75,7 +75,7 @@ def test_svd_unbiased(sample):
 
 
 def test_svd_fixed_k_payload_static_shape(rng):
-    codec = SvdCodec(rank=3)
+    codec = SvdCodec(rank=3, reshape="reference")
     grad = jax.random.normal(rng, (16, 8, 3, 3))
     p = codec.encode(rng, grad)
     # resize: (16*8/2, 2*9) = (64, 18); k = 3
@@ -84,6 +84,39 @@ def test_svd_fixed_k_payload_static_shape(rng):
     assert p.vt.shape == (3, 18)
     # bytes win vs dense
     assert payload_nbytes(p) < grad.size * 4
+
+
+def test_svd_square_policy_payload(rng):
+    """Default matricization is near-square pow2: (16,8,3,3) = 1152 elements
+    -> (32, 36); payload 3*(32+36+1) floats ≈ 18% of dense."""
+    codec = SvdCodec(rank=3)
+    grad = jax.random.normal(rng, (16, 8, 3, 3))
+    p = codec.encode(rng, grad)
+    assert p.u.shape == (32, 3) and p.vt.shape == (3, 36)
+    out = codec.decode(p, (16, 8, 3, 3))
+    assert out.shape == (16, 8, 3, 3)
+    assert payload_nbytes(p) * 5 < grad.size * 4
+
+
+def test_svd_square_policy_unbiased():
+    grad = jax.random.normal(jax.random.PRNGKey(9), (6, 6, 4, 4)) * 0.1
+    codec = SvdCodec(rank=3)
+    est = mean_decoded(codec, grad, n_keys=4000)
+    err = jnp.linalg.norm(est - grad) / jnp.linalg.norm(grad)
+    assert err < 0.15, f"relative bias {err:.3f}"
+
+
+def test_svd_dense_fallback_for_tiny_tensors(rng):
+    """BN-scale-sized tensors ship exact DensePayloads (SVD cannot win)."""
+    from atomo_tpu.codecs import DensePayload
+
+    codec = SvdCodec(rank=3)
+    g = jax.random.normal(rng, (32,))
+    p = codec.encode(rng, g)
+    assert isinstance(p, DensePayload)
+    np.testing.assert_allclose(
+        np.asarray(codec.decode(p, (32,))), np.asarray(g), atol=1e-6
+    )
 
 
 def test_svd_zero_grad(rng):
@@ -209,3 +242,24 @@ def test_codecs_jit_compile(rng):
         )
         out = fn(rng, g)
         assert out.shape == (64, 18)
+
+
+# ---------------------------------------------------------------- indicators
+
+
+def test_indicators_basis_choice():
+    """Low-rank gradients prefer spectral atoms; sparse ones entry-wise
+    (the reference's research decision rule, nn_ops.py:66-82)."""
+    from atomo_tpu.codecs import (
+        l1_indicator,
+        nuclear_indicator,
+        spectral_atoms_preferred,
+    )
+
+    u = jax.random.normal(jax.random.PRNGKey(0), (64, 1))
+    low_rank = (u @ u.T).reshape(64, 64)
+    assert bool(spectral_atoms_preferred(low_rank))
+
+    sparse = jnp.zeros((64, 64)).at[3, 5].set(10.0).at[10, 2].set(-7.0)
+    # entry-wise sparse but full-spread spectrum relative to L1
+    assert float(l1_indicator(sparse)) < float(nuclear_indicator(sparse)) * 10

@@ -26,7 +26,7 @@ from atomo_tpu.training import create_state, make_optimizer
 def _setup(model_name="lenet", dataset="mnist", batch=16, n_dev=8):
     mesh = make_mesh(n_dev)
     model = get_model(model_name, 10)
-    opt = make_optimizer("sgd", lr=0.05, momentum=0.9)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
     ds = synthetic_dataset(SPECS[dataset], True, size=256)
     it = BatchIterator(ds, batch, seed=0)
     images, labels = next(iter(it.epoch()))

@@ -1,0 +1,114 @@
+"""Ring attention + sequence-parallel LM tests on the CPU-simulated mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from atomo_tpu.codecs import SvdCodec
+from atomo_tpu.models.transformer import TransformerLM, lm_loss
+from atomo_tpu.parallel import make_mesh
+from atomo_tpu.parallel.lm import make_lm_train_step, shard_tokens
+from atomo_tpu.parallel.ring import (
+    full_attention,
+    make_sequence_parallel_attention,
+    ring_attention,
+)
+from atomo_tpu.training import create_state, make_optimizer
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(causal):
+    """Exactness: ring attention over 4 sequence shards == full attention."""
+    mesh = make_mesh(4, axes=(("sp", 4),))
+    b, h, s, d = 2, 3, 32, 8
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, s, d), jnp.float32)
+    k = jax.random.normal(kk, (b, h, s, d), jnp.float32)
+    v = jax.random.normal(kv, (b, h, s, d), jnp.float32)
+
+    expected = full_attention(q, k, v, causal=causal)
+    ring = make_sequence_parallel_attention(mesh, "sp", causal=causal)
+    got = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_ring_attention_single_shard_degenerates():
+    """axis_size=1: ring == full attention trivially (no ppermute traffic)."""
+    mesh = make_mesh(1, axes=(("sp", 1),))
+    q = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 16, 4))
+    out = make_sequence_parallel_attention(mesh, "sp", causal=True)(q, q, q)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(full_attention(q, q, q, causal=True)), atol=2e-5
+    )
+
+
+def _lm_cfg(max_len=64):
+    return dict(vocab_size=32, max_len=max_len, width=32, depth=2, num_heads=2)
+
+
+def test_transformer_forward_shapes():
+    model = TransformerLM(**_lm_cfg())
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(0)}, tokens)["params"]
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, 16, 32)
+    assert np.isfinite(float(lm_loss(logits, tokens)))
+
+
+def test_lm_dp_sp_step_runs_and_compresses():
+    """2x4 mesh: dp-compressed + sp-ring training step executes and the
+    payload bytes beat dense."""
+    mesh = make_mesh(8, axes=(("dp", 2), ("sp", 4)))
+    cfg = _lm_cfg(max_len=64)
+    opt = make_optimizer("sgd", lr=0.1, momentum=0.9)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 64), 0, 32)
+
+    model = TransformerLM(**cfg)
+    state = create_state(model, opt, jax.random.PRNGKey(1), tokens)
+    step = make_lm_train_step(cfg, opt, mesh, SvdCodec(rank=2))
+    st = shard_tokens(mesh, tokens)
+    state2, metrics = step(state, jax.random.PRNGKey(2), st)
+    assert int(state2.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(metrics["msg_bytes"]) < int(metrics["dense_bytes"])
+
+
+def test_lm_sharded_loss_matches_unsharded():
+    """The dp x sp dense step computes the same loss as a single-device
+    forward on the full batch (boundary-token handling is exact)."""
+    mesh = make_mesh(8, axes=(("dp", 2), ("sp", 4)))
+    cfg = _lm_cfg(max_len=64)
+    opt = make_optimizer("sgd", lr=0.0)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (4, 64), 0, 32)
+    model = TransformerLM(**cfg)
+    state = create_state(model, opt, jax.random.PRNGKey(1), tokens)
+
+    logits = model.apply({"params": state.params}, tokens)
+    expected = float(lm_loss(logits, tokens))
+
+    step = make_lm_train_step(cfg, opt, mesh, codec=None)
+    _, metrics = step(state, jax.random.PRNGKey(4), shard_tokens(mesh, tokens))
+    assert abs(float(metrics["loss"]) - expected) < 2e-3, (
+        float(metrics["loss"]),
+        expected,
+    )
+
+
+def test_lm_training_learns():
+    """A few compressed dp x sp steps reduce loss on a repeating pattern."""
+    mesh = make_mesh(8, axes=(("dp", 2), ("sp", 4)))
+    cfg = _lm_cfg(max_len=64)
+    opt = make_optimizer("adam", lr=0.01)
+    base = jnp.tile(jnp.arange(8, dtype=jnp.int32), 8)[None, :]
+    tokens = jnp.tile(base, (4, 1))  # (4, 64) periodic sequence
+    model = TransformerLM(**cfg)
+    state = create_state(model, opt, jax.random.PRNGKey(1), tokens)
+    step = make_lm_train_step(cfg, opt, mesh, SvdCodec(rank=2))
+    st = shard_tokens(mesh, tokens)
+    losses = []
+    for i in range(10):
+        state, m = step(state, jax.random.PRNGKey(5), st)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses
