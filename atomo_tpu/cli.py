@@ -9,9 +9,13 @@ mechanically. Deviations are honest:
                   in the reference too (README.md:111).
   --no-cuda /
   --enable-gpu    accepted, ignored — device selection belongs to JAX/XLA.
-  --num-aggregate accepted, ignored with a warning — the reference stores it
-                  but always waits for all workers
-                  (sync_replicas_master_nn.py:113,124; SURVEY.md §2.1).
+  --num-aggregate the reference stores this flag but always waits for all
+                  workers (sync_replicas_master_nn.py:113,124; SURVEY.md
+                  §2.1). Here it gets the partial-aggregation semantics it
+                  advertises: with compressed gather aggregation on a multi-
+                  device mesh, only a rotating K-of-N replica subset is
+                  averaged each step. Unset = aggregate all (the reference's
+                  actual behavior); inapplicable combinations warn.
   --compress      in the reference this flag is stored but never read in the
                   step path (SURVEY.md §5.6); here it controls lossless
                   checkpoint compression via the C++ native codec.
@@ -54,7 +58,10 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--bucket-size", type=int, default=512)
     g.add_argument("--dataset", type=str, default="MNIST", metavar="N")
     g.add_argument("--comm-type", type=str, default="Bcast", metavar="N")
-    g.add_argument("--num-aggregate", type=int, default=5, metavar="N")
+    g.add_argument("--num-aggregate", type=int, default=None, metavar="N",
+                   help="aggregate only K replicas per step (rotating subset; "
+                        "gather mode). The reference stores this flag but "
+                        "always aggregates all workers; unset = all.")
     g.add_argument("--eval-freq", type=int, default=50, metavar="N")
     g.add_argument("--train-dir", type=str, default="output/models/", metavar="N")
     g.add_argument("--compress", action="store_true", default=False,
@@ -72,6 +79,10 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
     t.add_argument("--sample", type=str, default="fixed_k",
                    choices=["fixed_k", "bernoulli", "topk"],
                    help="SVD atom sampling mode")
+    t.add_argument("--svd-algo", type=str, default="exact",
+                   choices=["exact", "randomized"],
+                   help="exact thin SVD, or the Halko sketch (faster encode, "
+                        "atoms restricted to the top-(rank+oversample) subspace)")
     t.add_argument("--optimizer", type=str, default="sgd", choices=["sgd", "adam"])
     t.add_argument("--weight-decay", type=float, default=0.0)
     t.add_argument("--nesterov", action="store_true", default=False)
@@ -92,11 +103,13 @@ def _warn_dead_flags(args: argparse.Namespace) -> None:
             "--comm-type is accepted for parity but ignored (it is a fake "
             "parameter in the reference too, README.md:111)"
         )
-    if args.num_aggregate != 5:
+    if args.num_aggregate is not None and (
+        args.aggregate != "gather" or args.code.lower() in ("sgd", "dense", "none")
+    ):
         warnings.warn(
-            "--num-aggregate is accepted for parity but ignored: the reference "
-            "always waits for all workers (sync_replicas_master_nn.py:113,124); "
-            "SPMD aggregation is likewise all-replica"
+            "--num-aggregate only applies to compressed gather aggregation "
+            "(a dense psum cannot subset replicas); ignoring it — note the "
+            "reference ignores it always (sync_replicas_master_nn.py:113,124)"
         )
     if args.enable_gpu or args.no_cuda:
         warnings.warn("--enable-gpu/--no-cuda are ignored: device selection is JAX's")
@@ -157,6 +170,7 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
         quantization_level=args.quantization_level,
         bucket_size=args.bucket_size,
         sample=args.sample,
+        algorithm=getattr(args, "svd_algo", "exact"),
     )
     if args.code.lower() in ("sgd", "dense", "none"):
         codec = None  # dense path: plain psum aggregation
@@ -179,9 +193,23 @@ def cmd_train(args: argparse.Namespace) -> int:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
 
         mesh = make_mesh(n_dev)
+        k_agg = 0
+        if (
+            args.num_aggregate is not None
+            and args.aggregate == "gather"
+            and codec is not None
+        ):
+            k_agg = args.num_aggregate
+            if not 0 < k_agg < n_dev:
+                warnings.warn(
+                    f"--num-aggregate {k_agg} is outside (0, {n_dev}) for this "
+                    f"{n_dev}-device mesh; aggregating all replicas"
+                )
+                k_agg = 0
         distributed_train_loop(
             model, optimizer, mesh, train_iter, test_iter,
             codec=codec, aggregate=args.aggregate, augment=augment,
+            num_aggregate=k_agg,
             max_steps=max_steps, eval_freq=args.eval_freq, seed=args.seed,
             train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
             compress_ckpt=args.compress, log_every=args.log_interval,
@@ -189,6 +217,11 @@ def cmd_train(args: argparse.Namespace) -> int:
     else:
         from atomo_tpu.training import train_loop
 
+        if args.num_aggregate is not None:
+            warnings.warn(
+                "--num-aggregate needs a multi-device mesh; single-device "
+                "training has no replicas to subset — ignoring it"
+            )
         train_loop(
             model, optimizer, train_iter, test_iter,
             codec=codec, augment=augment, max_steps=max_steps,

@@ -39,13 +39,14 @@ def get_codec(
     quantization_level: int = 2,
     bucket_size: int = 512,
     sample: str = "fixed_k",
+    algorithm: str = "exact",
 ):
     """Build a codec by CLI name (reference --code flag surface + terngrad)."""
     name = name.lower()
     if name in ("sgd", "dense", "none"):
         return DenseCodec()
     if name == "svd":
-        return SvdCodec(rank=svd_rank, sample=sample)
+        return SvdCodec(rank=svd_rank, sample=sample, algorithm=algorithm)
     if name == "qsgd":
         return QsgdCodec(bits=quantization_level, bucket_size=bucket_size)
     if name == "terngrad":

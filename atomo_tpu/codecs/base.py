@@ -75,7 +75,7 @@ class CodecStats:
 
 
 def encode_tree(
-    codec: Codec, key: PRNGKey, grads: Any
+    codec: Codec, key: PRNGKey, grads: Any, bucketed: bool = True
 ) -> tuple[Any, CodecStats]:
     """Encode every leaf of a gradient pytree with per-leaf folded keys.
 
@@ -83,12 +83,33 @@ def encode_tree(
     an independent stream while remaining deterministic given (key) — required
     for replicated-PS equivalence (every chip must be able to reproduce any
     other chip's sampling given its key).
+
+    ``bucketed=True`` groups same-shape leaves and encodes each group with one
+    vmapped call — the shape-bucketed batched-SVD mitigation of SURVEY.md §7
+    hard-part 2: a deep ResNet has many identically-shaped conv kernels, and
+    one batched SVD keeps the TPU busy where a chain of small SVDs would
+    serialize. Identical results to the unbucketed path (same per-leaf keys).
     """
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    payloads = [
-        codec.encode(jax.random.fold_in(key, i), leaf)
-        for i, leaf in enumerate(leaves)
-    ]
+    payloads: list = [None] * len(leaves)
+    if bucketed:
+        groups: dict = {}
+        for i, leaf in enumerate(leaves):
+            groups.setdefault((tuple(leaf.shape), str(leaf.dtype)), []).append(i)
+        for idxs in groups.values():
+            keys = jnp.stack([jax.random.fold_in(key, i) for i in idxs])
+            if len(idxs) == 1:
+                payloads[idxs[0]] = codec.encode(keys[0], leaves[idxs[0]])
+                continue
+            stacked = jnp.stack([leaves[i] for i in idxs])
+            batch = jax.vmap(codec.encode)(keys, stacked)
+            for j, i in enumerate(idxs):
+                payloads[i] = jax.tree.map(lambda a, j=j: a[j], batch)
+    else:
+        payloads = [
+            codec.encode(jax.random.fold_in(key, i), leaf)
+            for i, leaf in enumerate(leaves)
+        ]
     stats = CodecStats(
         dense_bytes=sum(l.size * l.dtype.itemsize for l in leaves),
         payload_bytes=sum(payload_nbytes(p) for p in payloads),

@@ -173,10 +173,37 @@ class SvdCodec:
     sample: str = "fixed_k"  # "fixed_k" | "bernoulli" | "topk"
     reshape: str = "square"  # "square" | "reference"
     max_min_dim: int = 512
+    algorithm: str = "exact"  # "exact" | "randomized"
+    oversample: int = 8  # sketch slack for the randomized algorithm
     name: str = "svd"
 
     def _resize(self, x: jax.Array):
         return resize_to_2d(x, policy=self.reshape, max_min_dim=self.max_min_dim)
+
+    def _svd(self, key: PRNGKey, mat: jax.Array):
+        """Thin SVD, exact (LAPACK-style, all min(m,n) triplets) or
+        randomized (Halko-Martinsson-Tropp sketch, MXU-friendly: two tall
+        matmuls + QR + an SVD of a (k+p, n) sliver).
+
+        The randomized path returns only the top (rank + oversample)
+        triplets; downstream sampling then draws atoms from the sketched
+        subspace. With fast-decaying gradient spectra the missed tail mass
+        is negligible, but the estimator is no longer exactly unbiased —
+        'randomized' is the opt-in speed mode, 'exact' the default.
+        """
+        if self.algorithm == "exact":
+            return jnp.linalg.svd(mat, full_matrices=False)
+        if self.algorithm != "randomized":
+            raise ValueError(f"unknown svd algorithm {self.algorithm!r}")
+        m, n = mat.shape
+        sketch = min(self.rank + self.oversample, min(m, n))
+        g = jax.random.normal(key, (n, sketch), mat.dtype)
+        y = jnp.matmul(mat, g, precision=jax.lax.Precision.HIGHEST)
+        q, _ = jnp.linalg.qr(y)  # (m, sketch)
+        b = jnp.matmul(q.T, mat, precision=jax.lax.Precision.HIGHEST)
+        ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        u = jnp.matmul(q, ub, precision=jax.lax.Precision.HIGHEST)
+        return u, s, vt
 
     def _dense_fallback(self, grad_shape: tuple[int, ...]) -> bool:
         if self.sample == "bernoulli":
@@ -198,8 +225,9 @@ class SvdCodec:
             return DensePayload(values=grad.astype(jnp.float32))
         mat, orig_shape, pad = self._resize(grad.astype(jnp.float32))
         m, n = mat.shape
-        r_full = min(m, n)
-        u, s, vt = jnp.linalg.svd(mat, full_matrices=False)
+        key, k_sketch = jax.random.split(key)
+        u, s, vt = self._svd(k_sketch, mat)
+        r_full = s.shape[0]  # randomized: only the sketched triplets exist
 
         if self.sample == "bernoulli":
             p = bernoulli_probs(s, self.rank)

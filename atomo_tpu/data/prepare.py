@@ -1,0 +1,82 @@
+"""Dataset preparation — the reference's src/data/data_prepare.py equivalent.
+
+The reference pre-downloads MNIST/CIFAR-10/CIFAR-100 via torchvision
+(data_prepare.py:9-45, driven by src/data_prepare.sh). This environment is
+offline-first, so preparation means: extract any standard archives found in
+the data root into the on-disk layouts the loaders parse (MNIST idx, CIFAR
+python pickles, SVHN .mat), then report per-dataset availability. Loaders
+fall back to the deterministic synthetic set when a dataset is absent, so
+`status` distinguishes real / synthetic-fallback explicitly.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import shutil
+import tarfile
+
+from atomo_tpu.data.datasets import SPECS, load_dataset
+
+_ARCHIVES = {
+    "cifar-10-python.tar.gz": "cifar10",
+    "cifar-100-python.tar.gz": "cifar100",
+}
+_MNIST_GZ = [
+    "train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte.gz",
+]
+
+
+def extract_archives(root: str, log_fn=print) -> list[str]:
+    """Unpack recognized dataset archives sitting in ``root``. Returns the
+    datasets touched."""
+    touched = []
+    for name, ds in _ARCHIVES.items():
+        path = os.path.join(root, name)
+        if os.path.exists(path):
+            log_fn(f"extracting {name}")
+            with tarfile.open(path, "r:gz") as tf:
+                tf.extractall(root, filter="data")
+            touched.append(ds)
+    for name in _MNIST_GZ:
+        gz = os.path.join(root, name)
+        out = os.path.join(root, name[:-3])
+        if os.path.exists(gz) and not os.path.exists(out):
+            log_fn(f"decompressing {name}")
+            with gzip.open(gz, "rb") as f_in, open(out + ".tmp", "wb") as f_out:
+                shutil.copyfileobj(f_in, f_out)
+            os.replace(out + ".tmp", out)
+            if "mnist" not in touched:
+                touched.append("mnist")
+    return touched
+
+
+def status(root: str) -> dict[str, str]:
+    """Per-dataset availability: 'real' when parseable files are on disk,
+    'synthetic-fallback' otherwise."""
+    out = {}
+    for name in SPECS:
+        try:
+            ds = load_dataset(name, root, train=False, synthetic_fallback=True)
+            out[name] = "synthetic-fallback" if ds.synthetic else "real"
+        except Exception as e:  # corrupt files: report, don't crash
+            out[name] = f"error: {e}"
+    return out
+
+
+def prepare(root: str = "./data", log_fn=print) -> dict[str, str]:
+    os.makedirs(root, exist_ok=True)
+    extract_archives(root, log_fn)
+    st = status(root)
+    for name, state in st.items():
+        log_fn(f"{name}: {state}")
+    return st
+
+
+if __name__ == "__main__":
+    import sys
+
+    prepare(sys.argv[1] if len(sys.argv) > 1 else "./data")

@@ -75,16 +75,30 @@ def make_distributed_train_step(
     axis: str = "dp",
     aggregate: str = "gather",
     augment: bool = False,
+    num_aggregate: int = 0,
 ):
     """Build the jitted SPMD train step over ``mesh``.
 
     Returns step(state, key, images, labels) -> (state, metrics); call with
     ``images``/``labels`` sharded over ``axis`` and ``state`` replicated.
+
+    ``num_aggregate`` (gather mode only): average the decoded payloads of
+    only K of the N replicas each step, rotating the subset with the step
+    counter so every replica contributes equally over time. This gives the
+    reference's --num-aggregate flag the partial-aggregation semantics it
+    advertises but never implements (the master always waits for all
+    workers, sync_replicas_master_nn.py:113,124 — SURVEY.md §2.1). 0 or
+    >= N means aggregate all.
     """
+    n_dev = mesh.shape[axis]
+    k_agg = num_aggregate if 0 < num_aggregate < n_dev else 0
+    if k_agg and (codec is None or aggregate != "gather"):
+        raise ValueError(
+            "num_aggregate requires a codec with aggregate='gather' "
+            "(a dense psum cannot subset replicas)"
+        )
     if codec is None and aggregate == "gather":
         aggregate = "psum"  # dense gather would be strictly worse than psum
-
-    n_dev = mesh.shape[axis]
 
     def spmd_step(state: TrainState, key, images, labels):
         my = jax.lax.axis_index(axis)
@@ -107,6 +121,13 @@ def make_distributed_train_step(
                 # factors on the wire: all_gather fixed-shape payloads,
                 # decode all replicas identically, mean.
                 gathered = jax.lax.all_gather(payloads, axis)  # leading axis n_dev
+                if k_agg:
+                    # deterministic rotating subset — identical on every
+                    # chip, so replicas stay bit-equal
+                    sel = (state.step + jnp.arange(k_agg)) % n_dev
+                    gathered = jax.tree.map(
+                        lambda a: jnp.take(a, sel, axis=0), gathered
+                    )
                 decoded = jax.vmap(
                     lambda p: decode_tree(codec, p, grads)
                 )(gathered)
@@ -190,6 +211,7 @@ def distributed_train_loop(
     codec=None,
     aggregate: str = "gather",
     augment: bool = False,
+    num_aggregate: int = 0,
     max_steps: int = 100,
     eval_freq: int = 0,
     seed: int = 0,
@@ -220,7 +242,8 @@ def distributed_train_loop(
         log_fn(f"Resumed from {train_dir} at step {start_step}")
     state = replicate_state(mesh, state)
     step_fn = make_distributed_train_step(
-        model, optimizer, mesh, codec, aggregate=aggregate, augment=augment
+        model, optimizer, mesh, codec, aggregate=aggregate, augment=augment,
+        num_aggregate=num_aggregate,
     )
     eval_fn = make_distributed_eval_step(model, mesh) if test_iter is not None else None
     key = jax.random.PRNGKey(seed + 1)

@@ -1,0 +1,84 @@
+"""Dataset loading + preparation tests (reference src/datasets.py,
+src/data/data_prepare.py)."""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from atomo_tpu.data import SPECS, BatchIterator, load_dataset, synthetic_dataset
+from atomo_tpu.data.prepare import prepare, status
+
+
+def _write_cifar10(root):
+    """Write a minimal real CIFAR-10 python-pickle layout."""
+    d = os.path.join(root, "cifar-10-batches-py")
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.RandomState(0)
+    for name, n in [(f"data_batch_{i}", 20) for i in range(1, 6)] + [("test_batch", 10)]:
+        blob = {
+            b"data": rng.randint(0, 255, (n, 3072), dtype=np.uint8),
+            b"labels": rng.randint(0, 10, n).tolist(),
+        }
+        with open(os.path.join(d, name), "wb") as f:
+            pickle.dump(blob, f)
+
+
+def _write_mnist_gz(root):
+    rng = np.random.RandomState(1)
+    for prefix, n in [("train", 30), ("t10k", 10)]:
+        images = rng.randint(0, 255, (n, 28, 28), dtype=np.uint8)
+        labels = rng.randint(0, 10, n, dtype=np.uint8)
+        with gzip.open(os.path.join(root, f"{prefix}-images-idx3-ubyte.gz"), "wb") as f:
+            f.write(struct.pack(">HBBIII", 0, 8, 3, n, 28, 28) + images.tobytes())
+        with gzip.open(os.path.join(root, f"{prefix}-labels-idx1-ubyte.gz"), "wb") as f:
+            f.write(struct.pack(">HBBI", 0, 8, 1, n) + labels.tobytes())
+
+
+def test_synthetic_is_deterministic():
+    a = synthetic_dataset(SPECS["cifar10"], True, size=32)
+    b = synthetic_dataset(SPECS["cifar10"], True, size=32)
+    np.testing.assert_array_equal(a.images, b.images)
+    np.testing.assert_array_equal(a.labels, b.labels)
+    assert a.synthetic
+
+
+def test_load_real_cifar10(tmp_path):
+    _write_cifar10(str(tmp_path))
+    ds = load_dataset("cifar10", str(tmp_path), train=True)
+    assert not ds.synthetic
+    assert ds.images.shape == (100, 32, 32, 3)  # 5 batches x 20
+    assert ds.images.dtype == np.float32 and ds.images.max() <= 1.0
+
+
+def test_prepare_extracts_mnist_and_reports(tmp_path):
+    _write_mnist_gz(str(tmp_path))
+    logs = []
+    st = prepare(str(tmp_path), log_fn=logs.append)
+    assert st["mnist"] == "real"
+    assert st["svhn"] == "synthetic-fallback"
+    ds = load_dataset("mnist", str(tmp_path), train=True)
+    assert not ds.synthetic and len(ds) == 30
+
+
+def test_prepare_extracts_cifar_archive(tmp_path):
+    # build the archive the reference's downloader would leave behind
+    inner = tmp_path / "stage"
+    inner.mkdir()
+    _write_cifar10(str(inner))
+    with tarfile.open(tmp_path / "cifar-10-python.tar.gz", "w:gz") as tf:
+        tf.add(inner / "cifar-10-batches-py", arcname="cifar-10-batches-py")
+    st = prepare(str(tmp_path), log_fn=lambda s: None)
+    assert st["cifar10"] == "real"
+
+
+def test_batch_iterator_epoch_covers_dataset():
+    ds = synthetic_dataset(SPECS["mnist"], True, size=70)
+    it = BatchIterator(ds, 32, seed=0, drop_last=True)
+    batches = list(it.epoch())
+    assert len(batches) == 2 and all(b[0].shape[0] == 32 for b in batches)
+    it2 = BatchIterator(ds, 32, seed=0, drop_last=False)
+    assert sum(b[0].shape[0] for b in it2.epoch()) == 70

@@ -147,3 +147,31 @@ def test_distributed_training_learns():
         state, m = step(state, key, si, sl)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_num_aggregate_subset():
+    """Honest --num-aggregate: K-of-N rotating subset aggregation keeps
+    replicas identical and still trains (SURVEY.md §2.1 'vestigial flag')."""
+    mesh, model, opt, it, state = _setup()
+    step = make_distributed_train_step(
+        model, opt, mesh, SvdCodec(rank=2), num_aggregate=3
+    )
+    key = jax.random.PRNGKey(13)
+    stream = it.forever()
+    for _ in range(2):
+        images, labels = next(stream)
+        si, sl = shard_batch(mesh, images, labels)
+        state, m = step(state, key, si, sl)
+    assert np.isfinite(float(m["loss"]))
+    leaf = jax.tree_util.tree_leaves(state.params)[0]
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+def test_num_aggregate_requires_gather():
+    mesh, model, opt, it, state = _setup()
+    with pytest.raises(ValueError, match="gather"):
+        make_distributed_train_step(
+            model, opt, mesh, SvdCodec(rank=2), aggregate="psum", num_aggregate=3
+        )
