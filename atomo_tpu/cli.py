@@ -77,8 +77,9 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                    choices=["gather", "psum"],
                    help="factor all_gather vs dense psum aggregation")
     t.add_argument("--sample", type=str, default="fixed_k",
-                   choices=["fixed_k", "bernoulli", "topk"],
-                   help="SVD atom sampling mode")
+                   choices=["fixed_k", "bernoulli_budget", "bernoulli", "topk"],
+                   help="SVD atom sampling mode (bernoulli_budget = reference "
+                        "Bernoulli keep semantics in a static rank+slack payload)")
     t.add_argument("--svd-algo", type=str, default="exact",
                    choices=["exact", "randomized"],
                    help="exact thin SVD, or the Halko sketch (faster encode, "
@@ -86,6 +87,21 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
     t.add_argument("--optimizer", type=str, default="sgd", choices=["sgd", "adam"])
     t.add_argument("--weight-decay", type=float, default=0.0)
     t.add_argument("--nesterov", action="store_true", default=False)
+    t.add_argument("--adam-beta1", type=float, default=0.9,
+                   help="Adam b1 (reference src/optim/adam.py betas default)")
+    t.add_argument("--adam-beta2", type=float, default=0.999)
+    t.add_argument("--adam-eps", type=float, default=1e-8)
+    t.add_argument("--amsgrad", action="store_true", default=False,
+                   help="AMSGrad variant (reference src/optim/adam.py:37-94)")
+    t.add_argument("--health-timeout", type=float, default=0.0,
+                   help="arm the step-heartbeat watchdog: interrupt the job "
+                        "if no step completes within this many seconds "
+                        "(0 = off); recovery = restart from last checkpoint")
+    t.add_argument("--phase-metrics", action="store_true", default=False,
+                   help="split the step into separately-jitted phases and "
+                        "log real Comp/Encode/Comm (+ master Gather/Decode) "
+                        "seconds — the reference's per-phase observability; "
+                        "costs fusion, so default off")
     t.add_argument("--shrinkage-freq", type=int, default=50,
                    help="steps between lr shrink (reference hardcodes 50)")
     t.add_argument("--data-root", type=str, default="./data")
@@ -134,7 +150,11 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
             train_ds = synthetic_dataset(SPECS[name], True)
         else:
             train_ds = load_dataset(name, args.data_root, train=True)
-        train_iter = BatchIterator(train_ds, args.batch_size, seed=args.seed)
+        # data_seed may differ per host (multi-process shuffling); args.seed
+        # itself must not — it also seeds model init and the SPMD step key
+        train_iter = BatchIterator(
+            train_ds, args.batch_size, seed=getattr(args, "data_seed", args.seed)
+        )
     if args.synthetic:
         test_ds = synthetic_dataset(SPECS[name], False)
     else:
@@ -151,6 +171,10 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
         momentum=args.momentum,
         nesterov=args.nesterov,
         weight_decay=args.weight_decay,
+        beta1=getattr(args, "adam_beta1", 0.9),
+        beta2=getattr(args, "adam_beta2", 0.999),
+        eps=getattr(args, "adam_eps", 1e-8),
+        amsgrad=getattr(args, "amsgrad", False),
     )
     svd_rank = args.svd_rank
     if svd_rank == 0 and args.sample != "bernoulli":
@@ -180,7 +204,27 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
 def cmd_train(args: argparse.Namespace) -> int:
     import jax
 
+    from atomo_tpu.parallel import launch
+
     _warn_dead_flags(args)
+    # Multi-host: form ONE jax.distributed world before any mesh/backend use
+    # (replaces the reference's mpirun rank dispatch,
+    # src/distributed_nn.py:86-88,243-259). No-op on a single host.
+    launch.initialize()
+    n_proc = jax.process_count()
+    if n_proc > 1:
+        if args.batch_size % n_proc:
+            raise SystemExit(
+                f"--batch-size {args.batch_size} must be divisible by the "
+                f"{n_proc} participating hosts"
+            )
+        # each host feeds its local slice of the global batch, shuffled with
+        # an independent DATA stream (the reference's workers also shuffle
+        # independently, src/distributed_nn.py:93-207). Only the data seed
+        # is offset: model init and the step key must stay identical across
+        # processes or the "replicated" state would silently diverge.
+        args.batch_size //= n_proc
+        args.data_seed = args.seed + jax.process_index()
     model, optimizer, codec, train_iter, test_iter, ds_name = _build_common(args)
     augment = ds_name.startswith("cifar") and not args.no_augment
     n_train = len(train_iter.dataset)
@@ -191,6 +235,7 @@ def cmd_train(args: argparse.Namespace) -> int:
     n_dev = args.n_devices or len(jax.devices())
     if n_dev > 1:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
+        from atomo_tpu.training import stepwise_shrink
 
         mesh = make_mesh(n_dev)
         k_agg = 0
@@ -213,6 +258,9 @@ def cmd_train(args: argparse.Namespace) -> int:
             max_steps=max_steps, eval_freq=args.eval_freq, seed=args.seed,
             train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
             compress_ckpt=args.compress, log_every=args.log_interval,
+            health_timeout=args.health_timeout,
+            phase_metrics=args.phase_metrics,
+            lr_fn=stepwise_shrink(args.lr, args.lr_shrinkage, args.shrinkage_freq),
         )
     else:
         from atomo_tpu.training import train_loop

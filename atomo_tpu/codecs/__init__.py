@@ -9,6 +9,7 @@ are reachable: sgd (dense), svd, qsgd, terngrad.
 from atomo_tpu.codecs.base import (  # noqa: F401
     Codec,
     CodecStats,
+    decode_mean_tree,
     decode_tree,
     encode_tree,
     payload_nbytes,
@@ -47,10 +48,12 @@ def get_codec(
         return DenseCodec()
     if name == "svd":
         return SvdCodec(rank=svd_rank, sample=sample, algorithm=algorithm)
+    if name == "svd_budget":  # shorthand: svd with the Bernoulli budget sampler
+        return SvdCodec(rank=svd_rank, sample="bernoulli_budget", algorithm=algorithm)
     if name == "qsgd":
         return QsgdCodec(bits=quantization_level, bucket_size=bucket_size)
     if name == "terngrad":
         return terngrad(bucket_size=bucket_size)
     raise ValueError(
-        f"unknown codec {name!r}; expected one of sgd|svd|qsgd|terngrad"
+        f"unknown codec {name!r}; expected one of sgd|svd|svd_budget|qsgd|terngrad"
     )

@@ -9,10 +9,21 @@ int(64/(2+b)) values per word (qsgd.py:52-79); decode unpacks masks in reverse
 
 TPU-first redesign: TPU vector units have no native 64-bit integer lanes
 (SURVEY.md §2.9), so the word layout is *uint32* with (1+b) bits per value —
-1 sign bit + b magnitude bits, floor(32/(1+b)) values per word. Packing and
-unpacking are pure vectorized shift/mask ops (no Python loops over values),
-jit-compiled, with shapes fixed by the input size. Stochastic rounding uses
-``jax.random`` instead of numpy (qsgd.py:47-50).
+1 sign bit + b magnitude bits, floor(32/(1+b)) values per word. Since round 2
+the wire format is *bucket-padded*: ``words`` has shape
+(n_buckets, words_per_bucket), each bucket padded to a whole number of words
+(≤ 1.5% overhead at the default bucket 512). That single layout is shared by
+two interchangeable encode/decode implementations:
+
+  * the jnp path — pure vectorized shift/mask ops, the test oracle;
+  * the fused Pallas kernels (atomo_tpu.ops.qsgd_kernels) — scale,
+    stochastic rounding, coding, and packing in one VMEM-resident pass,
+    the production path on TPU (``use_pallas=None`` auto-selects it).
+
+Payloads from either path decode identically on either path (VERDICT r1
+next-round #2). Stochastic rounding uses jax.random uniforms (bit-identical
+across paths when fed the same key) or, on real TPU, the kernel's on-core
+PRNG (zero extra HBM traffic; an equally valid QSGD stream).
 
 The whole encode (and decode) runs inside the compiled step function; the
 payload (words, scales) is what an all_gather moves over ICI.
@@ -21,7 +32,7 @@ payload (words, scales) is what an all_gather moves over ICI.
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +41,7 @@ from atomo_tpu.codecs.base import PRNGKey
 
 
 class QsgdPayload(NamedTuple):
-    words: jax.Array  # (n_words,) uint32 bit-packed sign+magnitude codes
+    words: jax.Array  # (n_buckets, words_per_bucket) uint32 packed codes
     scales: jax.Array  # (n_buckets,) float32 per-bucket scale
 
 
@@ -42,12 +53,18 @@ def _vals_per_word(bits: int) -> int:
     return 32 // _bits_per_value(bits)
 
 
+def padded_bucket(bucket_size: int, bits: int) -> int:
+    """Bucket size rounded up to a whole number of uint32 words."""
+    vpw = _vals_per_word(bits)
+    return -(-bucket_size // vpw) * vpw
+
+
 def pack_u32(codes: jax.Array, bits: int) -> jax.Array:
-    """Pack small unsigned codes (< 2^(bits+1)) into uint32 words.
+    """Pack a flat stream of small unsigned codes into uint32 words.
 
     Vectorized analogue of the reference's per-value uint64 shifting loop
-    (qsgd.py:52-79): reshape to (n_words, vals_per_word) and reduce with
-    per-lane shifts.
+    (qsgd.py:52-79). Building block for the bucketed layout below; also
+    useful standalone.
     """
     bpv = _bits_per_value(bits)
     vpw = _vals_per_word(bits)
@@ -70,6 +87,31 @@ def unpack_u32(words: jax.Array, bits: int, n: int) -> jax.Array:
     return lanes.reshape(-1)[:n]
 
 
+def pack_bucketed(codes: jax.Array, bits: int) -> jax.Array:
+    """(n_buckets, bucket_p) codes -> (n_buckets, bucket_p/vpw) uint32 words.
+
+    ``bucket_p`` must already be a multiple of vals-per-word (the caller
+    pads with zero codes). Lane j of a word sits at bit j*(1+bits) — the
+    same layout the Pallas kernel emits.
+    """
+    bpv = _bits_per_value(bits)
+    vpw = _vals_per_word(bits)
+    nb, bucket_p = codes.shape
+    lanes = codes.astype(jnp.uint32).reshape(nb, bucket_p // vpw, vpw)
+    shifts = (jnp.arange(vpw, dtype=jnp.uint32) * bpv)[None, None, :]
+    return jnp.sum(lanes << shifts, axis=2, dtype=jnp.uint32)
+
+
+def unpack_bucketed(words: jax.Array, bits: int) -> jax.Array:
+    """Inverse of :func:`pack_bucketed`: (nb, wpb) -> (nb, wpb*vpw) codes."""
+    bpv = _bits_per_value(bits)
+    vpw = _vals_per_word(bits)
+    mask = jnp.uint32((1 << bpv) - 1)
+    shifts = (jnp.arange(vpw, dtype=jnp.uint32) * bpv)[None, None, :]
+    lanes = (words[:, :, None] >> shifts) & mask
+    return lanes.reshape(words.shape[0], -1)
+
+
 @dataclasses.dataclass(frozen=True)
 class QsgdCodec:
     """Stochastic b-bit quantization with per-bucket scaling.
@@ -78,28 +120,65 @@ class QsgdCodec:
     bucket_size: values per scale (reference --bucket-size, default 512).
     scheme: "qsgd" (L2-norm scale) or "terngrad" (max-norm scale + 2.5-sigma
         clip, qsgd.py:212-216; terngrad implies bits=1 in the reference).
+    use_pallas: None = auto (fused kernels on TPU, jnp elsewhere);
+        True forces the kernels (interpreted off-TPU — slow, tests only);
+        False forces the jnp path. Both paths share one wire format.
     """
 
     bits: int = 2
     bucket_size: int = 512
     scheme: str = "qsgd"
+    use_pallas: Optional[bool] = None
     name: str = "qsgd"
 
     @property
     def levels(self) -> int:
         return (1 << self.bits) - 1
 
-    def encode(self, key: PRNGKey, grad: jax.Array) -> QsgdPayload:
-        x = grad.astype(jnp.float32).reshape(-1)
-        n = x.shape[0]
+    def _pallas(self) -> bool:
+        if self.use_pallas is None:
+            from atomo_tpu.ops.qsgd_kernels import is_tpu
+
+            return is_tpu()
+        return bool(self.use_pallas)
+
+    def _interpret(self) -> bool:
+        from atomo_tpu.ops.qsgd_kernels import is_tpu
+
+        return not is_tpu()
+
+    def _clip(self, x: jax.Array) -> jax.Array:
         if self.scheme == "terngrad":
             # clip at 2.5 sigma of the whole tensor (qsgd.py:212-216)
-            sigma = jnp.std(x)
-            limit = 2.5 * sigma
-            x = jnp.clip(x, -limit, limit)
+            limit = 2.5 * jnp.std(x)
+            return jnp.clip(x, -limit, limit)
+        return x
 
+    def encode(self, key: PRNGKey, grad: jax.Array) -> QsgdPayload:
+        x = self._clip(grad.astype(jnp.float32).reshape(-1))
+        n = x.shape[0]
         b = self.bucket_size
         n_buckets = -(-n // b)
+
+        if self._pallas():
+            from atomo_tpu.ops.qsgd_kernels import pallas_quantize_pack
+
+            interpret = self._interpret()
+            if interpret:
+                # interpreter stubs the on-core PRNG; feed jax.random
+                # uniforms — bit-identical to the jnp oracle
+                u = jax.random.uniform(key, (n_buckets, b), jnp.float32)
+                seed = jnp.zeros((), jnp.int32)
+            else:
+                u = None
+                seed = jax.random.randint(key, (), 0, jnp.iinfo(jnp.int32).max)
+            words, scales = pallas_quantize_pack(
+                x, seed, u,
+                bits=self.bits, bucket_size=b, scheme=self.scheme,
+                interpret=interpret,
+            )
+            return QsgdPayload(words=words, scales=scales)
+
         padded = jnp.zeros((n_buckets * b,), jnp.float32).at[:n].set(x)
         buckets = padded.reshape(n_buckets, b)
 
@@ -116,7 +195,9 @@ class QsgdCodec:
         level = jnp.clip(lo + (rnd < frac), 0, self.levels).astype(jnp.uint32)
         sign = (buckets < 0).astype(jnp.uint32)
         codes = (sign << self.bits) | level
-        words = pack_u32(codes.reshape(-1), self.bits)
+        bucket_p = padded_bucket(b, self.bits)
+        codes_p = jnp.zeros((n_buckets, bucket_p), jnp.uint32).at[:, :b].set(codes)
+        words = pack_bucketed(codes_p, self.bits)
         return QsgdPayload(words=words, scales=scales.astype(jnp.float32))
 
     def decode(
@@ -126,14 +207,27 @@ class QsgdCodec:
         for d in grad_shape:
             n *= d
         b = self.bucket_size
-        n_buckets = payload.scales.shape[0]
-        codes = unpack_u32(payload.words, self.bits, n_buckets * b).reshape(n_buckets, b)
+
+        if self._pallas():
+            from atomo_tpu.ops.qsgd_kernels import pallas_unpack_dequantize
+
+            vals = pallas_unpack_dequantize(
+                payload.words, payload.scales,
+                bits=self.bits, bucket_size=b, n=n,
+                interpret=self._interpret(),
+            )
+            return vals.reshape(grad_shape).astype(dtype)
+
+        codes = unpack_bucketed(payload.words, self.bits)[:, :b]
         level = (codes & jnp.uint32(self.levels)).astype(jnp.float32)
         sign = 1.0 - 2.0 * ((codes >> self.bits) & 1).astype(jnp.float32)
         vals = sign * level / self.levels * payload.scales[:, None]
         return vals.reshape(-1)[:n].reshape(grad_shape).astype(dtype)
 
 
-def terngrad(bucket_size: int = 512) -> QsgdCodec:
+def terngrad(bucket_size: int = 512, use_pallas: Optional[bool] = None) -> QsgdCodec:
     """TernGrad = 1-bit-magnitude QSGD with max-norm scale + sigma clip."""
-    return QsgdCodec(bits=1, bucket_size=bucket_size, scheme="terngrad", name="terngrad")
+    return QsgdCodec(
+        bits=1, bucket_size=bucket_size, scheme="terngrad",
+        use_pallas=use_pallas, name="terngrad",
+    )

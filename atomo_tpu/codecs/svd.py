@@ -7,9 +7,9 @@ singular triplets with probabilities proportional to their singular values
 nothing kept), rescale kept values by 1/p_i for unbiasedness, ship the kept
 (U, s, Vt) columns; decode = U @ diag(s) @ Vt reshaped back (svd.py:160-178).
 
-TPU-first redesign — two sampling modes, both unbiased:
+TPU-first redesign — three sampling modes, all unbiased:
 
-* ``fixed_k`` (the wire format): sample exactly ``rank`` atoms *with
+* ``fixed_k`` (default wire format): sample exactly ``rank`` atoms *with
   replacement*, atom i drawn with probability q_i = s_i / sum(s); estimator
   sum_j s_{i_j} / (rank * q_{i_j}) * u_{i_j} v_{i_j}^T. Unbiased
   (E = sum_i q_i * s_i/q_i u_i v_i^T / rank * rank = X) with a *static*
@@ -17,11 +17,20 @@ TPU-first redesign — two sampling modes, both unbiased:
   needs. The reference's variable-length Bernoulli keep-set cannot be
   expressed with static shapes without either padding to the full width or
   biased truncation.
-* ``bernoulli`` (reference-faithful semantics): the exact reference
-  probabilities p_i = min(1, rank * s_i / sum(s)) (or s/s[0] when rank==0,
-  svd.py:54-56), keep-mask applied to the *full-width* factors. Payload is
-  full-size (no bytes win) — used for in-process compression studies and as
-  the oracle in unbiasedness tests, mirroring how the reference master uses
+* ``bernoulli_budget``: the reference's Bernoulli keep-without-replacement
+  semantics (p_i = min(1, rank * s_i / sum(s)), kept atoms rescaled by
+  1/p_i) packed into a *static* budget of k_max = rank + budget_slack
+  atoms: sample the keep-mask, scatter the kept atoms into k_max padded
+  slots (zero coefficients mark empty slots), and redraw (bounded) only in
+  the Chernoff-rare event more than k_max atoms are kept. An empty keep is
+  shipped as a zero payload — unlike the reference's recursion-on-empty
+  (svd.py:61-63), which biases its estimator up by 1/(1-P(empty)). Real
+  bytes win (k_max*(m+n+1) on the wire) with the reference's exact
+  per-atom inclusion law.
+* ``bernoulli`` (reference-faithful, full width): the same probabilities,
+  keep-mask applied to the *full-width* factors. Payload is full-size (no
+  bytes win) — used for in-process compression studies and as the oracle
+  in unbiasedness tests, mirroring how the reference master uses
   deterministic top-k (random_sample=False, svd.py:109-113).
 
 Deviation notes (SURVEY.md §7 'reference bug compatibility'): the reference's
@@ -43,13 +52,15 @@ from atomo_tpu.codecs.dense import DensePayload
 
 
 class SvdPayload(NamedTuple):
-    """Fixed-shape wire format: ``rank`` sampled (and 1/p-rescaled) atoms."""
+    """Fixed-shape wire format: ``k`` sampled (and rescaled) atoms.
+
+    Shape metadata (original tensor shape, padding) is static and travels
+    out-of-band via the codec's decoder closure, never on the wire.
+    """
 
     u: jax.Array  # (m, k) sampled left singular vectors
-    coeff: jax.Array  # (k,) s_i / (k * q_i) importance-sampling coefficients
+    coeff: jax.Array  # (k,) importance-sampling coefficients
     vt: jax.Array  # (k, n) sampled right singular vectors
-    # static metadata (hashable python ints via dataclass? kept as arrays is
-    # wasteful — shape info travels out-of-band in `meta`)
 
 
 class SvdMaskedPayload(NamedTuple):
@@ -170,11 +181,13 @@ class SvdCodec:
     """
 
     rank: int = 3
-    sample: str = "fixed_k"  # "fixed_k" | "bernoulli" | "topk"
+    sample: str = "fixed_k"  # "fixed_k" | "bernoulli_budget" | "bernoulli" | "topk"
     reshape: str = "square"  # "square" | "reference"
     max_min_dim: int = 512
     algorithm: str = "exact"  # "exact" | "randomized"
     oversample: int = 8  # sketch slack for the randomized algorithm
+    budget_slack: int = 4  # extra atom slots for bernoulli_budget (k_max = rank + slack)
+    max_redraws: int = 4  # bounded resampling when the keep-set overflows k_max
     name: str = "svd"
 
     def _resize(self, x: jax.Array):
@@ -216,8 +229,16 @@ class SvdCodec:
             if self.reshape == "square"
             else resize_to_2d(jnp.zeros(grad_shape), self.reshape)[0].shape
         )
-        k = min(self.rank, min(probe_m, probe_n)) if self.rank > 0 else min(probe_m, probe_n)
+        k = self._payload_k(min(probe_m, probe_n))
         return k * (probe_m + probe_n + 1) >= total
+
+    def _payload_k(self, r_full: int) -> int:
+        """Static atom-slot count of the wire payload for this sampler."""
+        if self.rank <= 0:
+            return r_full
+        if self.sample == "bernoulli_budget":
+            return min(self.rank + self.budget_slack, r_full)
+        return min(self.rank, r_full)
 
     # -- encode ------------------------------------------------------------
     def encode(self, key: PRNGKey, grad: jax.Array):
@@ -234,6 +255,42 @@ class SvdCodec:
             keep = jax.random.bernoulli(key, p).astype(s.dtype)
             s_hat = jnp.where(p > 0, s * keep / jnp.maximum(p, jnp.finfo(s.dtype).tiny), 0.0)
             return SvdMaskedPayload(u=u, s=s_hat, vt=vt)
+
+        if self.sample == "bernoulli_budget":
+            # Reference inclusion law (src/codings/svd.py:49-67): atom i kept
+            # with p_i = min(1, rank*s_i/sum(s)), kept values rescaled 1/p_i.
+            # Packed into k_max static slots; empty slots carry coeff 0.
+            # Deviations from the reference, both toward exactness:
+            #  * an empty keep-set is SHIPPED as a zero payload (a valid
+            #    unbiased outcome) — the reference recurses on empty
+            #    (svd.py:61-63), which conditions the distribution and
+            #    biases E[decode] up by 1/(1-P(empty));
+            #  * a keep-set overflowing k_max is redrawn (bounded); with
+            #    slack >= 4 the overflow probability is Chernoff-small, so
+            #    the conditioning bias is negligible (statistically tested).
+            #    The last resort after max_redraws truncates to top-s kept.
+            k_max = self._payload_k(r_full)
+            p = bernoulli_probs(s, self.rank)
+            tiny = jnp.finfo(s.dtype).tiny
+
+            def draw(carry):
+                key_c, _, tries = carry
+                key_n, sub = jax.random.split(key_c)
+                return key_n, jax.random.bernoulli(sub, p), tries + 1
+
+            def need_redraw(carry):
+                _, keep, tries = carry
+                return (jnp.sum(keep) > k_max) & (tries < self.max_redraws)
+
+            carry = draw((key, jnp.zeros_like(s, bool), jnp.zeros((), jnp.int32)))
+            _, keep, _ = jax.lax.while_loop(need_redraw, draw, carry)
+            # kept atoms first (descending s — s is already SVD-sorted),
+            # then pad slots pointing at unkept atoms with coeff 0
+            order = jnp.argsort(jnp.where(keep, 0, 1), stable=True)
+            idx = order[:k_max]
+            valid = keep[idx]
+            coeff = jnp.where(valid, s[idx] / jnp.maximum(p[idx], tiny), 0.0)
+            return SvdPayload(u=u[:, idx], coeff=coeff, vt=vt[idx, :])
 
         k = min(self.rank, r_full) if self.rank > 0 else r_full
         if self.sample == "topk":
@@ -268,6 +325,38 @@ class SvdCodec:
     def decode(self, payload, grad_shape: tuple[int, ...], dtype=jnp.float32) -> jax.Array:
         """Reconstruct the gradient from a payload + static shape metadata."""
         return self.make_decoder(grad_shape, dtype)(payload)
+
+    def decode_mean(
+        self, gathered, grad_shape: tuple[int, ...], dtype, n_replicas: int
+    ):
+        """Fused mean-of-decodes for all_gather-ed payloads (leading axis N).
+
+        Concatenates the N rank-k factor blocks and reconstructs the mean
+        with ONE (m, N*k) @ (N*k, n) matmul — an MXU-sized contraction
+        instead of N thin slivers, and no N dense (m, n) intermediates.
+        The reference decodes each worker's message separately then sums
+        (src/sync_replicas_master_nn.py:292-296, src/codings/svd.py:160-178).
+        Returns None for payload types without a fused path (the caller
+        falls back to vmap-decode + mean).
+        """
+        if self._dense_fallback(tuple(grad_shape)):
+            return jnp.mean(gathered.values, axis=0).reshape(grad_shape).astype(dtype)
+        if isinstance(gathered, SvdMaskedPayload):
+            u, c, vt = gathered.u, gathered.s, gathered.vt
+        elif isinstance(gathered, SvdPayload):
+            u, c, vt = gathered.u, gathered.coeff, gathered.vt
+        else:
+            return None
+        n_rep, m, k = u.shape
+        n = vt.shape[2]
+        u_cat = jnp.transpose(u, (1, 0, 2)).reshape(m, n_rep * k)
+        scaled = u_cat * (c.reshape(n_rep * k) / n_rep)[None, :]
+        mat = jnp.matmul(
+            scaled, vt.reshape(n_rep * k, n), precision=jax.lax.Precision.HIGHEST
+        )
+        probe = jnp.zeros(grad_shape, dtype)
+        _, orig_shape, pad = self._resize(probe)
+        return undo_resize(mat, orig_shape, pad).astype(dtype)
 
     def make_decoder(self, grad_shape: tuple[int, ...], dtype=jnp.float32):
         """Return decode(payload) -> grad for a known gradient shape.

@@ -117,21 +117,27 @@ int64_t atomo_lz_decompress(const uint8_t* src, int64_t n, uint8_t* dst, int64_t
   const uint8_t* ip = src;
   const uint8_t* end = src + n;
   int64_t pos = 0;
+  if (n < 0 || cap < 0) return -1;
   while (ip < end) {
     uint8_t opcode = *ip++;
     uint64_t len;
     ip = get_varint(ip, end, &len);
     if (!ip) return -1;
+    // `len` is corruption-controlled (any varint up to ~2^64): compare it
+    // against the *remaining* unsigned spans before any pointer arithmetic
+    // or signed cast — `ip + len` could overflow the pointer and a
+    // len >= 2^63 would go negative through int64_t, bypassing both guards.
+    if (len > static_cast<uint64_t>(cap - pos)) return -1;
     if (opcode == 0x00) {
-      if (ip + len > end || pos + static_cast<int64_t>(len) > cap) return -1;
+      if (len > static_cast<uint64_t>(end - ip)) return -1;
       std::memcpy(dst + pos, ip, static_cast<size_t>(len));
       ip += len;
       pos += static_cast<int64_t>(len);
     } else if (opcode == 0x01) {
-      if (ip + 2 > end) return -1;
+      if (end - ip < 2) return -1;
       uint32_t off = static_cast<uint32_t>(ip[0]) | (static_cast<uint32_t>(ip[1]) << 8);
       ip += 2;
-      if (off == 0 || off > pos || pos + static_cast<int64_t>(len) > cap) return -1;
+      if (off == 0 || static_cast<int64_t>(off) > pos) return -1;
       // overlapping copy must run forward byte-by-byte
       for (uint64_t i = 0; i < len; ++i) dst[pos + i] = dst[pos + i - off];
       pos += static_cast<int64_t>(len);

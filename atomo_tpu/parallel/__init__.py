@@ -5,10 +5,17 @@ from atomo_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     replicated,
 )
+from atomo_tpu.parallel.launch import (  # noqa: F401
+    HealthMonitor,
+    HealthWatchdog,
+    global_mesh,
+    initialize,
+)
 from atomo_tpu.parallel.replicated import (  # noqa: F401
     distributed_train_loop,
     make_distributed_eval_step,
     make_distributed_train_step,
+    make_phase_train_steps,
     replicate_state,
     shard_batch,
 )

@@ -18,8 +18,9 @@ from the last checkpoint).
 from __future__ import annotations
 
 import os
+import threading
 import time
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 
@@ -89,3 +90,70 @@ class HealthMonitor:
                 f"(last completed step {self._last_step}); "
                 "restart from the latest checkpoint"
             )
+
+
+_EXIT_GRACE_S = 30.0
+
+
+def _default_failure(exc: RuntimeError) -> None:
+    """Kill the job: print the diagnosis, give the main thread one graceful
+    chance (KeyboardInterrupt at its next bytecode), and hard-exit after a
+    grace period. The hard exit matters: a main thread hung inside a C++
+    XLA collective never executes another bytecode, so interrupt_main alone
+    would reproduce the reference's hung-forever waitany (SURVEY.md §5.3).
+    os._exit lets the scheduler see a dead process and restart from the
+    last checkpoint."""
+    import _thread
+    import sys
+
+    print(f"HealthWatchdog: {exc}", file=sys.stderr, flush=True)
+    _thread.interrupt_main()
+    time.sleep(_EXIT_GRACE_S)
+    print(
+        f"HealthWatchdog: main thread did not exit within {_EXIT_GRACE_S}s "
+        "of interrupt (hung collective?); hard-exiting for scheduler restart",
+        file=sys.stderr, flush=True,
+    )
+    os._exit(13)
+
+
+class HealthWatchdog:
+    """Background thread that polls a :class:`HealthMonitor`.
+
+    The production wiring (VERDICT r1 next-round #5): the distributed train
+    loop ``beat()``s the monitor after every completed step; this thread
+    calls ``check()`` every ``interval`` seconds and invokes ``on_failure``
+    (default: print + interrupt the main thread) when the heartbeat stops —
+    the failure detection the reference lacks entirely (a dead MPI worker
+    hangs its master's waitany forever, SURVEY.md §5.3).
+    """
+
+    def __init__(
+        self,
+        monitor: HealthMonitor,
+        interval: float = 10.0,
+        on_failure: Optional[Callable[[RuntimeError], None]] = None,
+    ):
+        self.monitor = monitor
+        self.interval = interval
+        self.on_failure = on_failure or _default_failure
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HealthWatchdog":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.monitor.check()
+            except RuntimeError as exc:
+                self.on_failure(exc)
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from atomo_tpu.codecs import decode_tree, encode_tree, tree_nbytes
+from atomo_tpu.codecs import decode_mean_tree, encode_tree, tree_nbytes
 from atomo_tpu.models.transformer import TransformerLM
 from atomo_tpu.parallel.ring import ring_attention
 from atomo_tpu.training.trainer import TrainState
@@ -93,8 +93,9 @@ def make_lm_train_step(
             payloads, stats = encode_tree(codec, k_codec, grads)
             msg_bytes = stats.payload_bytes
             gathered = jax.lax.all_gather(payloads, dp_axis)
-            decoded = jax.vmap(lambda p: decode_tree(codec, p, grads))(gathered)
-            mean_grads = jax.tree.map(lambda g: jnp.mean(g, axis=0), decoded)
+            # fused decode_mean where the codec provides it (SVD: one
+            # (m, N·k)@(N·k, n) matmul), vmap-decode + mean otherwise
+            mean_grads = decode_mean_tree(codec, gathered, grads, n_dp)
 
         updates, new_opt = optimizer.update(mean_grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

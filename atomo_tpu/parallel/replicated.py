@@ -41,7 +41,13 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from atomo_tpu.codecs import decode_tree, encode_tree, payload_nbytes, tree_nbytes
+from atomo_tpu.codecs import (
+    decode_mean_tree,
+    decode_tree,
+    encode_tree,
+    payload_nbytes,
+    tree_nbytes,
+)
 from atomo_tpu.data.pipeline import augment_batch
 from atomo_tpu.parallel.mesh import batch_sharded, replicated
 from atomo_tpu.training.trainer import TrainState, cross_entropy_loss
@@ -89,6 +95,14 @@ def make_distributed_train_step(
     advertises but never implements (the master always waits for all
     workers, sync_replicas_master_nn.py:113,124 — SURVEY.md §2.1). 0 or
     >= N means aggregate all.
+
+    Caveat (honest): as *straggler mitigation* this is semantics-only. The
+    all_gather still moves all N payloads and the SPMD program still blocks
+    on the slowest chip — only the decode/average work shrinks to K. True
+    drop-the-straggler behavior needs host-level timeout machinery outside
+    the compiled step (XLA collectives have no partial-completion mode);
+    within SPMD the honest wins are the smaller decode cost and the
+    gradient-subsetting *noise* semantics, not wall-clock.
     """
     n_dev = mesh.shape[axis]
     k_agg = num_aggregate if 0 < num_aggregate < n_dev else 0
@@ -128,11 +142,12 @@ def make_distributed_train_step(
                     gathered = jax.tree.map(
                         lambda a: jnp.take(a, sel, axis=0), gathered
                     )
-                decoded = jax.vmap(
-                    lambda p: decode_tree(codec, p, grads)
-                )(gathered)
-                mean_grads = jax.tree.map(
-                    lambda g: jnp.mean(g, axis=0), decoded
+                # fused decode_mean where the codec provides it (SVD: the N
+                # rank-k factor blocks concatenate into ONE (m, N·k)@(N·k, n)
+                # matmul — MXU-sized, no N dense intermediates); vmap-decode
+                # + mean otherwise.
+                mean_grads = decode_mean_tree(
+                    codec, gathered, grads, k_agg or n_dev
                 )
             elif aggregate == "psum":
                 decoded = decode_tree(codec, payloads, grads)
@@ -174,6 +189,109 @@ def make_distributed_train_step(
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_phase_train_steps(
+    model,
+    optimizer,
+    mesh: Mesh,
+    codec=None,
+    *,
+    axis: str = "dp",
+    augment: bool = False,
+):
+    """Split the SPMD train step into four separately-jitted programs so the
+    host can time each phase — the observability the reference's log line
+    carries (worker Comp/Encode/Comm: src/distributed_worker.py:228-247;
+    master Gather/Decode: src/sync_replicas_master_nn.py:197-221) and which
+    the fused single-program step cannot expose (XLA interleaves everything).
+
+    Returns a dict of jitted callables:
+      comp(state, key, images, labels) -> (grads_x, new_stats, stats)
+      encode(state, key, grads_x)      -> (payloads_x, msg_bytes)   [codec]
+      comm(payloads_x or grads_x)      -> gathered (replicated)
+      update(state, gathered, new_stats) -> new_state
+
+    ``grads_x``/``payloads_x`` carry a leading per-replica axis sharded over
+    ``axis`` so per-chip values survive the program boundary. Opt-in via
+    --phase-metrics: the fused make_distributed_train_step remains the
+    default (faster — phase boundaries cost fusion and add host syncs).
+    """
+    n_dev = mesh.shape[axis]
+
+    def comp(state: TrainState, key, images, labels):
+        my = jax.lax.axis_index(axis)
+        step_key = jax.random.fold_in(key, state.step)
+        k_aug, k_drop, _ = jax.random.split(jax.random.fold_in(step_key, my), 3)
+        if augment:
+            images = augment_batch(k_aug, images)
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            partial(_loss_fn, model), has_aux=True
+        )(state.params, state.batch_stats, images, labels, k_drop)
+        prec1, prec5 = accuracy(logits, labels)
+        stats = {
+            "loss": jax.lax.pmean(loss, axis),
+            "prec1": jax.lax.pmean(prec1, axis),
+            "prec5": jax.lax.pmean(prec5, axis),
+        }
+        new_stats = jax.lax.pmean(new_stats, axis)
+        grads_x = jax.tree.map(lambda g: g[None], grads)
+        return grads_x, new_stats, stats
+
+    def encode(state: TrainState, key, grads_x):
+        my = jax.lax.axis_index(axis)
+        step_key = jax.random.fold_in(key, state.step)
+        _, _, k_codec = jax.random.split(jax.random.fold_in(step_key, my), 3)
+        grads = jax.tree.map(lambda g: g[0], grads_x)
+        payloads, stats = encode_tree(codec, k_codec, grads)
+        payloads_x = jax.tree.map(lambda p: p[None], payloads)
+        return payloads_x, jnp.asarray(stats.payload_bytes, jnp.int32)
+
+    def comm(tree_x):
+        local = jax.tree.map(lambda p: p[0], tree_x)
+        return jax.lax.all_gather(local, axis)
+
+    def comm_dense(grads_x):
+        local = jax.tree.map(lambda g: g[0], grads_x)
+        return jax.lax.pmean(local, axis)
+
+    def update(state: TrainState, gathered, new_stats):
+        if codec is None:
+            mean_grads = gathered  # already the pmean-ed dense gradient
+        else:
+            mean_grads = decode_mean_tree(codec, gathered, state.params, n_dev)
+        updates, new_opt = optimizer.update(mean_grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt,
+        )
+
+    def sm(fn, in_specs, out_specs, donate=()):
+        return jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            ),
+            donate_argnums=donate,
+        )
+
+    fns = {
+        "comp": sm(
+            comp,
+            (P(), P(), P(axis), P(axis)),
+            (P(axis), P(), P()),
+        ),
+        "comm": sm(comm_dense if codec is None else comm, (P(axis),), P()),
+        "update": sm(update, (P(), P(), P()), P(), donate=(0,)),
+    }
+    if codec is not None:
+        fns["encode"] = sm(
+            encode, (P(), P(), P(axis)), (P(axis), P())
+        )
+    return fns
 
 
 def make_distributed_eval_step(model, mesh: Mesh, axis: str = "dp"):
@@ -221,12 +339,28 @@ def distributed_train_loop(
     compress_ckpt: bool = True,
     log_fn=print,
     log_every: int = 1,
+    health_timeout: float = 0.0,
+    phase_metrics: bool = False,
+    lr_fn=None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
     checkpoint/resume (the master's _save_model slot,
     sync_replicas_master_nn.py:228-230,331-336 — there it is commented out;
-    here it works and also restores, closing the no-resume gap §5.4)."""
+    here it works and also restores, closing the no-resume gap §5.4).
+
+    ``health_timeout`` > 0 arms a :class:`HealthWatchdog`: every completed
+    step beats a HealthMonitor; a background thread raises the alarm (and
+    interrupts the job) if no step completes within the timeout — restart
+    from the last checkpoint is the recovery story (SURVEY.md §5.3: the
+    reference hangs forever on a dead worker).
+
+    ``phase_metrics`` swaps the fused step for the four separately-jitted
+    phase programs of :func:`make_phase_train_steps` and fills the log
+    line's Comp/Encode/Comm fields with real per-phase seconds, plus the
+    reference master line's Gather/Decode (``lr_fn(step)`` supplies its lr
+    column). Default off: the fused program is faster."""
+    from atomo_tpu.parallel.launch import HealthMonitor, HealthWatchdog
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint, save_checkpoint
     from atomo_tpu.training.trainer import create_state
     from atomo_tpu.utils.metrics import StepMetrics, Timer
@@ -241,19 +375,109 @@ def distributed_train_loop(
         start_step = int(state.step)
         log_fn(f"Resumed from {train_dir} at step {start_step}")
     state = replicate_state(mesh, state)
-    step_fn = make_distributed_train_step(
-        model, optimizer, mesh, codec, aggregate=aggregate, augment=augment,
-        num_aggregate=num_aggregate,
-    )
+    if phase_metrics:
+        import warnings
+
+        if num_aggregate:
+            warnings.warn(
+                "--phase-metrics uses full aggregation; ignoring --num-aggregate"
+            )
+        if codec is not None and aggregate != "gather":
+            warnings.warn(
+                "--phase-metrics always uses gather aggregation (its phase "
+                "split is gather/decode); ignoring --aggregate "
+                f"{aggregate!r} — drop --phase-metrics to time the psum path"
+            )
+        step_fn = _make_phased_step_fn(
+            model, optimizer, mesh, codec, augment=augment
+        )
+    else:
+        step_fn = make_distributed_train_step(
+            model, optimizer, mesh, codec, aggregate=aggregate, augment=augment,
+            num_aggregate=num_aggregate,
+        )
     eval_fn = make_distributed_eval_step(model, mesh) if test_iter is not None else None
     key = jax.random.PRNGKey(seed + 1)
     timer = Timer()
     stream = train_iter.forever()
     n_train = len(train_iter.dataset)
+    watchdog = None
+    monitor = None
+    if health_timeout > 0:
+        monitor = HealthMonitor(timeout=health_timeout)
+        watchdog = HealthWatchdog(
+            monitor, interval=min(health_timeout / 4, 10.0)
+        ).start()
+    try:
+        state = _distributed_steps(
+            state, step_fn, eval_fn, stream, train_iter, test_iter, mesh,
+            key, timer, n_train, start_step, max_steps, log_every, log_fn,
+            eval_freq, save_freq, train_dir, compress_ckpt, monitor, lr_fn,
+        )
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+    return state
+
+
+def _make_phased_step_fn(model, optimizer, mesh, codec, *, augment):
+    """Wrap make_phase_train_steps into a (state, key, si, sl) ->
+    (state, metrics, phase_seconds) callable with host-side phase timing."""
+    import time as _time
+
+    fns = make_phase_train_steps(model, optimizer, mesh, codec, augment=augment)
+    dense_bytes_cache = {}
+
+    def step_fn(state, key, si, sl):
+        ph = {}
+        t0 = _time.perf_counter()
+        grads_x, new_stats, stats = fns["comp"](state, key, si, sl)
+        jax.block_until_ready(stats["loss"])
+        ph["comp"] = _time.perf_counter() - t0
+        if codec is not None:
+            t0 = _time.perf_counter()
+            wire, msg_bytes = fns["encode"](state, key, grads_x)
+            jax.block_until_ready(msg_bytes)
+            ph["encode"] = _time.perf_counter() - t0
+            msg_bytes = int(msg_bytes)
+        else:
+            wire = grads_x
+            if "dense" not in dense_bytes_cache:
+                dense_bytes_cache["dense"] = tree_nbytes(state.params)
+            msg_bytes = dense_bytes_cache["dense"]
+            ph["encode"] = 0.0
+        t0 = _time.perf_counter()
+        gathered = fns["comm"](wire)
+        jax.block_until_ready(gathered)
+        ph["gather"] = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        state = fns["update"](state, gathered, new_stats)
+        jax.block_until_ready(state.params)
+        ph["decode"] = _time.perf_counter() - t0
+        metrics = dict(stats)
+        metrics["msg_bytes"] = msg_bytes
+        return state, metrics, ph
+
+    return step_fn
+
+
+def _distributed_steps(
+    state, step_fn, eval_fn, stream, train_iter, test_iter, mesh, key,
+    timer, n_train, start_step, max_steps, log_every, log_fn, eval_freq,
+    save_freq, train_dir, compress_ckpt, monitor, lr_fn=None,
+):
+    from atomo_tpu.training.checkpoint import save_checkpoint
+    from atomo_tpu.utils.metrics import StepMetrics, master_line
+
     for step in range(start_step + 1, max_steps + 1):
         images, labels = next(stream)
         si, sl = shard_batch(mesh, images, labels)
-        state, metrics = step_fn(state, key, si, sl)
+        out = step_fn(state, key, si, sl)
+        state, metrics = out[0], out[1]
+        phases = out[2] if len(out) > 2 else None
+        if monitor is not None:
+            jax.block_until_ready(metrics["loss"])
+            monitor.beat(step)
         if log_every and step % log_every == 0:
             rec = StepMetrics(
                 rank=0,
@@ -263,19 +487,35 @@ def distributed_train_loop(
                 dataset_size=n_train,
                 loss=float(metrics["loss"]),
                 time_cost=timer.lap(),
+                comp_dur=phases["comp"] if phases else 0.0,
+                encode_dur=phases["encode"] if phases else 0.0,
+                comm_dur=phases["gather"] if phases else 0.0,
                 msg_bytes=int(metrics["msg_bytes"]),
                 prec1=float(metrics["prec1"]),
                 prec5=float(metrics["prec5"]),
             )
             log_fn(rec.worker_line())
+            if phases:
+                log_fn(
+                    master_line(
+                        step,
+                        phases["decode"],
+                        float(lr_fn(step)) if lr_fn is not None else 0.0,
+                        phases["gather"],
+                    )
+                )
         if eval_freq and eval_fn is not None and step % eval_freq == 0:
             n_dev = mesh.shape["dp"]
             totals = {"loss": 0.0, "prec1": 0.0, "prec5": 0.0}
             n = 0
+            dropped = 0
             for ti, tl in test_iter.epoch():
                 # trim a trailing partial batch to a mesh multiple; metrics
-                # stay exact over the samples actually evaluated
+                # stay exact over the samples actually evaluated and the
+                # drop is reported (a silent drop changes the metric
+                # denominator for batch sizes not divisible by the mesh)
                 trim = (ti.shape[0] // n_dev) * n_dev
+                dropped += ti.shape[0] - trim
                 if trim == 0:
                     continue
                 sti, stl = shard_batch(mesh, ti[:trim], tl[:trim])
@@ -289,6 +529,12 @@ def distributed_train_loop(
                     totals["prec5"] / max(n, 1),
                 )
             )
+            if dropped:
+                log_fn(
+                    f"Validation: dropped {dropped} tail samples not divisible "
+                    f"by the {n_dev}-device mesh (evaluated {n}); pick a "
+                    "--test-batch-size that is a mesh multiple for exact totals"
+                )
         if save_freq and train_dir and step % save_freq == 0:
             save_checkpoint(train_dir, jax.device_get(state), step, compress=compress_ckpt)
     return state
@@ -296,6 +542,27 @@ def distributed_train_loop(
 
 def shard_batch(mesh: Mesh, images, labels, axis: str = "dp"):
     n_dev = mesh.shape[axis]
+    sh = batch_sharded(mesh, axis)
+    if jax.process_count() > 1:
+        # Multi-host SPMD: each process feeds its *local* shard (its own
+        # independently shuffled batch slice — the reference's workers also
+        # shuffle independently, distributed_nn.py:93-207) and the global
+        # array is assembled without cross-host copies.
+        import numpy as np
+
+        local_im, local_lb = np.asarray(images), np.asarray(labels)
+        n_local = sum(
+            1 for d in mesh.devices.flat if d.process_index == jax.process_index()
+        )
+        if n_local == 0 or local_im.shape[0] % n_local != 0:
+            raise ValueError(
+                f"local batch {local_im.shape[0]} is not divisible by this "
+                f"process's {n_local} mesh devices"
+            )
+        return (
+            jax.make_array_from_process_local_data(sh, local_im),
+            jax.make_array_from_process_local_data(sh, local_lb),
+        )
     bs = images.shape[0]
     if bs % n_dev != 0:
         raise ValueError(
@@ -303,7 +570,6 @@ def shard_batch(mesh: Mesh, images, labels, axis: str = "dp"):
             f"{axis!r} mesh axis; choose --batch-size as a multiple of the "
             "device count (or trim the batch)"
         )
-    sh = batch_sharded(mesh, axis)
     return jax.device_put(jnp.asarray(images), sh), jax.device_put(
         jnp.asarray(labels), sh
     )

@@ -1,64 +1,227 @@
-"""Headline benchmark: ResNet-18 / CIFAR-10 compressed training step.
+"""Headline benchmark: compressed training step on the local accelerator.
 
 Canonical recipe (reference src/run_pytorch.sh:1-20): ResNet-18, CIFAR-10,
-batch 128, SVD sparsification at rank 3. This bench times our jitted
-train step (forward + backward + SVD encode + decode + momentum-SGD update,
-one XLA program) on the local accelerator, and compares against a
-reference-equivalent pipeline measured on this host's CPU: a torch ResNet-18
-fwd/bwd plus the reference's per-layer numpy-SVD encode/decode hot path
-(src/distributed_worker.py:229-246 + src/codings/svd.py:79-178 semantics) —
-the same work the reference's m4.2xlarge CPU workers do each step.
+batch 128, SVD sparsification at rank 3. This bench times our jitted train
+step (forward + backward + encode + decode + momentum-SGD update, one XLA
+program) and compares against a reference-equivalent pipeline measured on
+this host's CPU: a torch ResNet-18 fwd/bwd plus the reference's per-layer
+numpy-SVD encode/decode hot path (src/distributed_worker.py:229-246 +
+src/codings/svd.py:79-178 semantics) — the same work the reference's
+m4.2xlarge CPU workers do each step.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
-vs_baseline = baseline_step_time / our_step_time (>1 means faster than the
-reference-equivalent pipeline).
+Robustness design (round-2): the measurement runs in a CHILD subprocess.
+The parent process never initializes jax, so a wedged/contended axon TPU
+tunnel cannot take the whole bench down: failed children are retried with
+backoff, then retried on the CPU backend, and if everything fails the
+parent still prints one parseable JSON line with an "error" field and
+exits 0.
+
+Prints ONE JSON line per config (default: the headline config 2):
+
+  {"metric": ..., "value": <ms/step>, "unit": "ms/step",
+   "vs_baseline": <baseline_s / ours_s or null>,    # TIME ratio only
+   "baseline": "torch-cpu-refpipe" | "none",
+   "byte_reduction": <dense_bytes / payload_bytes>, # the bytes win
+   "mfu": <fraction of peak or null>, "flops_per_step": ...,
+   "peak_tflops": ..., "platform": ..., "device": ...,
+   "timing": "warm-cache", "error": null | "..."}
+
+`vs_baseline` is strictly a step-time ratio (>1 = we are faster); the bytes
+win is reported separately in `byte_reduction` and is never substituted
+into the time field (round-1 ADVICE finding).
+
+Usage: python bench.py [--config N | --all] [--no-baseline]
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
-BATCH = 128
 WARMUP = 3
 STEPS = 10
-SVD_RANK = 3
+CHILD_TIMEOUT_S = 2400
+BACKEND_TIMEOUT_S = 300  # axon tunnel dial can wedge for tens of minutes
+RETRIES = 3
+
+# BASELINE.md config ladder. `ways` is the reference cluster width the config
+# models; payload bytes/chip/step do not depend on it, and step time is
+# measured on the locally available chip (the driver validates multi-chip
+# sharding separately via __graft_entry__.dryrun_multichip).
+CONFIGS = {
+    1: dict(metric="lenet_mnist_qsgd_step_time", network="lenet",
+            input=(28, 28, 1), batch=128, code="qsgd", ways=1),
+    2: dict(metric="resnet18_cifar10_svd3_step_time", network="resnet18",
+            input=(32, 32, 3), batch=128, code="svd", rank=3, ways=8,
+            torch_baseline=True),
+    3: dict(metric="vgg11_cifar10_svd5_step_time", network="vgg11",
+            input=(32, 32, 3), batch=128, code="svd", rank=5, ways=16,
+            dense_compare=True),
+    4: dict(metric="resnet50_cifar10_svd3_ckpt_step_time", network="resnet50",
+            input=(32, 32, 3), batch=128, code="svd", rank=3, ways=32,
+            ckpt=True),
+    5: dict(metric="resnet110_cifar10_svd3_budget_step_time", network="resnet110",
+            input=(32, 32, 3), batch=128, code="svd_budget", rank=3, ways=64),
+}
+
+# Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
+# f32 convs/matmuls by default on TPU), for the MFU denominator.
+_PEAK_TFLOPS = [
+    ("v6", 918.0), ("v5p", 459.0), ("v5 lite", 197.0), ("v5e", 197.0),
+    ("v5litepod", 197.0), ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+]
 
 
-def measure_ours() -> tuple[float, float]:
-    """Returns (seconds/step, gradient-byte reduction factor)."""
+def _peak_tflops(device_kind: str):
+    kind = device_kind.lower()
+    for tag, tf in _PEAK_TFLOPS:
+        if tag in kind:
+            return tf
+    return None
+
+
+# --------------------------------------------------------------------- child
+
+
+def _honor_platform_env() -> None:
+    """Explicit JAX_PLATFORMS env beats the sitecustomize-forced axon config."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def _flops_per_step(step_fn, *args):
+    """XLA's own FLOP estimate for the compiled step program."""
+    try:
+        compiled = step_fn.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+def measure_ours(cfg: dict) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from atomo_tpu.codecs import SvdCodec
+    from atomo_tpu.codecs import get_codec
     from atomo_tpu.models import get_model
     from atomo_tpu.training import create_state, make_optimizer, make_train_step
 
-    model = get_model("resnet18", 10)
+    model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
     rng = jax.random.PRNGKey(0)
-    images = jax.random.uniform(rng, (BATCH, 32, 32, 3), jnp.float32)
-    labels = jax.random.randint(rng, (BATCH,), 0, 10)
+    h, w, c = cfg["input"]
+    images = jax.random.uniform(rng, (cfg["batch"], h, w, c), jnp.float32)
+    labels = jax.random.randint(rng, (cfg["batch"],), 0, 10)
     state = create_state(model, opt, rng, images)
-    step = make_train_step(model, opt, codec=SvdCodec(rank=SVD_RANK))
+    codec = get_codec(cfg["code"], svd_rank=cfg.get("rank", 3),
+                      quantization_level=4)
+    step = make_train_step(model, opt, codec=codec)
     key = jax.random.PRNGKey(1)
 
-    metrics = None
-    for _ in range(WARMUP):
-        state, metrics = step(state, key, images, labels)
-    jax.block_until_ready(state.params)
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state, metrics = step(state, key, images, labels)
-    jax.block_until_ready(state.params)
-    dt = (time.perf_counter() - t0) / STEPS
+    flops = _flops_per_step(step, state, key, images, labels)
+
+    def timed(step_fn, st):
+        m = None
+        for _ in range(WARMUP):
+            st, m = step_fn(st, key, images, labels)
+        jax.block_until_ready(st.params)
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            st, m = step_fn(st, key, images, labels)
+        jax.block_until_ready(st.params)
+        return (time.perf_counter() - t0) / STEPS, st, m
+
+    dt, state, metrics = timed(step, state)
 
     dense = sum(
         l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(state.params)
     )
     reduction = dense / max(int(metrics["msg_bytes"]), 1)
-    return dt, reduction
+
+    dev = jax.devices()[0]
+    peak = _peak_tflops(dev.device_kind) if dev.platform == "tpu" else None
+    mfu = (flops / dt / (peak * 1e12)) if (flops and peak) else None
+
+    out = dict(
+        metric=cfg["metric"],
+        value=round(dt * 1e3, 3),
+        unit="ms/step",
+        byte_reduction=round(reduction, 2),
+        mfu=round(mfu, 4) if mfu is not None else None,
+        flops_per_step=flops,
+        peak_tflops=peak,
+        platform=dev.platform,
+        device=dev.device_kind,
+        ways=cfg.get("ways", 1),
+        timing="warm-cache",
+    )
+
+    if dev.platform == "tpu":
+        out.update(_qsgd_encode_compare())
+
+    if cfg.get("dense_compare"):
+        dense_step = make_train_step(model, opt, codec=None)
+        ddt, _, _ = timed(dense_step, create_state(model, opt, rng, images))
+        out["dense_ms_per_step"] = round(ddt * 1e3, 3)
+
+    if cfg.get("ckpt"):
+        import tempfile
+
+        from atomo_tpu.training.checkpoint import save_checkpoint
+
+        host_state = jax.device_get(state)
+        with tempfile.TemporaryDirectory() as td:
+            t0 = time.perf_counter()
+            save_checkpoint(td, host_state, 1, compress=True)
+            out["ckpt_save_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+            out["ckpt_bytes"] = sum(
+                os.path.getsize(os.path.join(dp, f))
+                for dp, _, fs in os.walk(td) for f in fs
+            )
+
+    return out
+
+
+def _qsgd_encode_compare() -> dict:
+    """Fused-Pallas vs jnp QSGD encode on a ResNet-18-sized flat gradient
+    (TPU only): the kernels are the production path there, and this is the
+    evidence (VERDICT r1 next-round #2)."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import QsgdCodec
+
+    n = 1 << 23  # ~8.4M f32 values ≈ a ResNet-18 gradient, flattened
+    g = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
+    key = jax.random.PRNGKey(4)
+    res = {}
+    try:
+        for tag, up in (("pallas", True), ("jnp", False)):
+            codec = QsgdCodec(bits=4, use_pallas=up)
+            enc = jax.jit(lambda k, x, c=codec: c.encode(k, x))
+            p = enc(key, g)
+            jax.block_until_ready(p)
+            t0 = time.perf_counter()
+            reps = 20
+            for _ in range(reps):
+                p = enc(key, g)
+            jax.block_until_ready(p)
+            res[f"qsgd_encode_{tag}_ms"] = round(
+                (time.perf_counter() - t0) / reps * 1e3, 3
+            )
+    except Exception as exc:  # never let the extra metric kill the headline
+        res["qsgd_encode_error"] = str(exc)[:200]
+    return res
 
 
 # ----------------------------------------------------------- torch baseline
@@ -131,7 +294,7 @@ def _numpy_svd_encode_decode(grad, rank: int):
     return (u[:, :k] * s[:k]) @ vt[:k, :]
 
 
-def measure_reference_cpu() -> float:
+def measure_reference_cpu(batch: int, rank: int) -> float:
     """Seconds/step of the reference-equivalent worker pipeline on CPU."""
     import numpy as np
     import torch
@@ -139,15 +302,15 @@ def measure_reference_cpu() -> float:
 
     torch.set_num_threads(max(torch.get_num_threads(), 4))
     net = _torch_resnet18()
-    x = torch.rand(BATCH, 3, 32, 32)
-    y = torch.randint(0, 10, (BATCH,))
+    x = torch.rand(batch, 3, 32, 32)
+    y = torch.randint(0, 10, (batch,))
 
     def one_step():
         net.zero_grad()
         loss = F.cross_entropy(net(x), y)
         loss.backward()
         for p in net.parameters():
-            _numpy_svd_encode_decode(p.grad.numpy().astype(np.float32), SVD_RANK)
+            _numpy_svd_encode_decode(p.grad.numpy().astype(np.float32), rank)
 
     one_step()  # warmup
     n = 2
@@ -157,31 +320,125 @@ def measure_reference_cpu() -> float:
     return (time.perf_counter() - t0) / n
 
 
-def main() -> None:
-    import os
+def _backend_or_die(timeout_s: int = BACKEND_TIMEOUT_S):
+    """Initialize the jax backend under a hard deadline. The axon TPU
+    tunnel is known to wedge for tens of minutes (round-1 failure mode);
+    a wedged child must die quickly so the parent's retry/fallback ladder
+    stays fast."""
+    import threading
 
-    if os.environ.get("JAX_PLATFORMS"):
-        # explicit env choice beats a sitecustomize-forced jax_platforms config
-        import jax
+    done = threading.Event()
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    ours_s, reduction = measure_ours()
+    def watchdog():
+        if not done.wait(timeout_s):
+            print(
+                f"backend init exceeded {timeout_s}s; aborting child",
+                file=sys.stderr, flush=True,
+            )
+            os._exit(17)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    import jax
+
+    devs = jax.devices()
+    done.set()
+    return devs
+
+
+def child_main(args) -> int:
+    _honor_platform_env()
+    _backend_or_die()
+    cfg = CONFIGS[args.config]
+    out = measure_ours(cfg)
+    # flush an intermediate row before the (slow, host-CPU) torch baseline:
+    # if the baseline is killed by the parent's timeout, the accelerator
+    # measurement above still reaches the parent (it parses the LAST line)
+    print(json.dumps({**out, "vs_baseline": None, "baseline": "pending", "error": None}), flush=True)
+    if cfg.get("torch_baseline") and not args.no_baseline:
+        try:
+            base_s = measure_reference_cpu(cfg["batch"], cfg.get("rank", 3))
+            out["vs_baseline"] = round(base_s / (out["value"] / 1e3), 3)
+            out["baseline"] = "torch-cpu-refpipe"
+        except Exception:
+            out["vs_baseline"] = None
+            out["baseline"] = "none"
+    else:
+        out["vs_baseline"] = None
+        out["baseline"] = "none"
+    out["error"] = None
+    print(json.dumps(out))
+    return 0
+
+
+# -------------------------------------------------------------------- parent
+
+
+def _run_child(argv_tail: list[str], env_extra: dict) -> tuple[dict | None, str]:
+    cmd = [sys.executable, "-u", os.path.abspath(__file__), "--child"] + argv_tail
+    env = {**os.environ, **env_extra}
     try:
-        base_s = measure_reference_cpu()
-        vs = base_s / ours_s
-    except Exception:
-        vs = reduction / 8.0  # fall back to the north-star bytes target
-    print(
-        json.dumps(
-            {
-                "metric": "resnet18_cifar10_svd3_step_time",
-                "value": round(ours_s * 1e3, 3),
-                "unit": "ms/step",
-                "vs_baseline": round(vs, 3),
-            }
+        p = subprocess.run(
+            cmd, capture_output=True, text=True, env=env, timeout=CHILD_TIMEOUT_S
         )
+        stdout = p.stdout or ""
+        rc = p.returncode
+        stderr = p.stderr or ""
+    except subprocess.TimeoutExpired as e:
+        # salvage any intermediate JSON the child already flushed
+        stdout = (e.stdout or b"")
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        rc, stderr = -1, f"child timed out after {CHILD_TIMEOUT_S}s"
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), ""
+            except json.JSONDecodeError:
+                continue
+    tail = (stderr or stdout or "").strip().splitlines()[-8:]
+    return None, f"rc={rc}: " + " | ".join(tail)
+
+
+def _bench_one(config: int, no_baseline: bool) -> dict:
+    tail = ["--config", str(config)]
+    if no_baseline:
+        tail.append("--no-baseline")
+    last_err = "unknown"
+    for attempt in range(RETRIES):
+        if attempt:
+            time.sleep(15 * attempt)  # axon tunnel contention backoff
+        parsed, err = _run_child(tail, {})
+        if parsed is not None:
+            return parsed
+        last_err = err
+    # final fallback: measure on the CPU backend rather than report nothing
+    parsed, err = _run_child(tail + ["--no-baseline"], {"JAX_PLATFORMS": "cpu"})
+    if parsed is not None:
+        parsed["error"] = f"tpu attempts failed ({last_err}); cpu fallback"
+        return parsed
+    cfg = CONFIGS[config]
+    return dict(
+        metric=cfg["metric"], value=None, unit="ms/step", vs_baseline=None,
+        baseline="none", byte_reduction=None, mfu=None, platform=None,
+        device=None, error=f"{last_err}; cpu fallback also failed: {err}",
     )
 
 
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, default=2, choices=sorted(CONFIGS))
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.child:
+        return child_main(args)
+    configs = sorted(CONFIGS) if args.all else [args.config]
+    for c in configs:
+        print(json.dumps(_bench_one(c, args.no_baseline)))
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

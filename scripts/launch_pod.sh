@@ -22,7 +22,22 @@ WORKDIR="${WORKDIR:-/tmp/atomo_tpu}"
 gcloud compute tpus tpu-vm scp --recurse --worker=all --zone="$ZONE" \
   "$(git rev-parse --show-toplevel)" "$TPU_NAME":"$WORKDIR"
 
-# run the same SPMD program on every host; jax.distributed picks up
-# coordinator/process-id from the TPU metadata automatically
-gcloud compute tpus tpu-vm ssh --worker=all --zone="$ZONE" "$TPU_NAME" \
-  --command="cd $WORKDIR && python -m atomo_tpu train $*"
+# run the same SPMD program on every host. On Cloud TPU jax.distributed
+# picks coordinator/process-id up from the TPU metadata automatically (one
+# ssh fan-out, no env needed). For other fabrics (or to override), export
+# JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES here: each worker then needs
+# its OWN JAX_PROCESS_ID, so ranks are assigned by per-worker ssh — the
+# replacement for the reference's `mpirun --hostfile` rank dispatch
+# (src/run_pytorch.sh:1, src/distributed_nn.py:86-88).
+if [[ -n "${JAX_COORDINATOR_ADDRESS:-}" ]]; then
+  NUM="${JAX_NUM_PROCESSES:?export JAX_NUM_PROCESSES with JAX_COORDINATOR_ADDRESS}"
+  for ((i = 0; i < NUM; i++)); do
+    gcloud compute tpus tpu-vm ssh --worker="$i" --zone="$ZONE" "$TPU_NAME" \
+      --command="cd $WORKDIR && env JAX_COORDINATOR_ADDRESS=$JAX_COORDINATOR_ADDRESS \
+JAX_NUM_PROCESSES=$NUM JAX_PROCESS_ID=$i python -m atomo_tpu train $*" &
+  done
+  wait
+else
+  gcloud compute tpus tpu-vm ssh --worker=all --zone="$ZONE" "$TPU_NAME" \
+    --command="cd $WORKDIR && python -m atomo_tpu train $*"
+fi

@@ -26,6 +26,12 @@ jax.config.update("jax_enable_x64", False)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long convergence runs, opt-in via ATOMO_RUN_SLOW=1"
+    )
+
+
 @pytest.fixture
 def rng():
     return jax.random.PRNGKey(0)

@@ -1,0 +1,90 @@
+"""Convergence parity: compressed training must track dense training.
+
+The quantitative version of the reference's methodology — the single-machine
+trainer is the oracle and distributed/compressed runs are judged by their
+loss curves against it (src/nn_ops.py:123-169, SURVEY.md §4). Here the
+contract is asserted, not eyeballed: after N steps, SVD-rank-3 compressed
+training's final loss must be within a stated tolerance of the dense run's.
+
+The in-CI test uses LeNet (fast on the 1-core CPU CI host). The ResNet-18 /
+CIFAR-10 variant of the same assertion — the reference's canonical recipe
+(src/run_pytorch.sh:1-20) — is slow-marked and runs when real CIFAR-10 data
+is present and ATOMO_RUN_SLOW is set.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from atomo_tpu.codecs import SvdCodec
+from atomo_tpu.data import SPECS, BatchIterator, synthetic_dataset
+from atomo_tpu.models import get_model
+from atomo_tpu.training import create_state, make_optimizer, make_train_step
+
+
+def _train(model, codec, it, steps, seed=0, lr=0.01, momentum=0.0):
+    # momentum 0 is the reference's canonical SVD recipe
+    # (src/run_pytorch.sh:1-20): momentum integrates the sampling noise of
+    # the unbiased estimator, so the compressed run needs the reference's
+    # momentum-free setting for a fair convergence comparison.
+    opt = make_optimizer("sgd", lr=lr, momentum=momentum)
+    images, labels = next(iter(it.epoch()))
+    state = create_state(model, opt, jax.random.PRNGKey(seed), jnp.asarray(images))
+    step = make_train_step(model, opt, codec=codec)
+    key = jax.random.PRNGKey(seed + 1)
+    stream = it.forever()
+    losses = []
+    for _ in range(steps):
+        images, labels = next(stream)
+        state, m = step(state, key, jnp.asarray(images), jnp.asarray(labels))
+        losses.append(float(m["loss"]))
+    return losses
+
+
+@pytest.mark.parametrize("sample", ["fixed_k", "bernoulli_budget"])
+def test_svd3_final_loss_tracks_dense(sample):
+    """300 LeNet steps: svd-rank-3 in-loop compression must land within 50%
+    of the dense final loss (mean over the last 20 steps), and both must
+    actually learn (final << initial). Calibrated headroom: measured ratios
+    are ~1.01 (fixed_k) and ~1.3 (bernoulli_budget) on this recipe."""
+    model = get_model("lenet", 10)
+    ds = synthetic_dataset(SPECS["mnist"], True, size=512)
+    steps = 300
+    dense = _train(model, None, BatchIterator(ds, 32, seed=0), steps)
+    svd = _train(
+        model, SvdCodec(rank=3, sample=sample), BatchIterator(ds, 32, seed=0), steps
+    )
+    d_final = float(np.mean(dense[-20:]))
+    s_final = float(np.mean(svd[-20:]))
+    assert d_final < dense[0] * 0.1, "dense run failed to learn"
+    assert s_final < svd[0] * 0.1, "compressed run failed to learn"
+    ratio = s_final / max(d_final, 1e-8)
+    assert ratio < 1.5, f"svd3 final loss {s_final:.4f} vs dense {d_final:.4f}"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not os.environ.get("ATOMO_RUN_SLOW"),
+    reason="long run; set ATOMO_RUN_SLOW=1 (uses real CIFAR-10 under ./data "
+    "when present, synthetic otherwise)",
+)
+def test_resnet18_cifar10_svd3_convergence_parity():
+    """The reference's canonical recipe (src/run_pytorch.sh:1-20): ResNet-18
+    CIFAR-10 batch 128, svd-rank 3 — 500 steps, final-loss ratio vs dense
+    within 35%."""
+    from atomo_tpu.data import load_dataset
+
+    model = get_model("resnet18", 10)
+    try:
+        ds = load_dataset("cifar10", "./data", train=True)
+    except Exception:
+        ds = synthetic_dataset(SPECS["cifar10"], True, size=2048)
+    steps = 500
+    dense = _train(model, None, BatchIterator(ds, 128, seed=0), steps)
+    svd = _train(model, SvdCodec(rank=3), BatchIterator(ds, 128, seed=0), steps)
+    d_final = float(np.mean(dense[-50:]))
+    s_final = float(np.mean(svd[-50:]))
+    assert s_final / max(d_final, 1e-8) < 1.35, (d_final, s_final)

@@ -53,3 +53,38 @@ def test_truncated_rejected():
     blob = lossless.compress(data, typesize=4)
     with pytest.raises(ValueError):
         lossless.decompress(blob[: len(blob) // 2])
+
+
+import struct
+
+
+def _alz_header(rawlen: int, flags: int = 0, typesize: int = 1) -> bytes:
+    return struct.pack("<4sBBQ", b"ALZ1", flags, typesize, rawlen)
+
+
+@pytest.mark.parametrize(
+    "length_varint",
+    [
+        # huge literal len: ip + len overflows a pointer; len fits uint64
+        b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01",
+        # len >= 2^63: static_cast<int64_t>(len) goes negative
+        b"\x80\x80\x80\x80\x80\x80\x80\x80\x80\x01",
+    ],
+    ids=["ptr-overflow", "int64-negative"],
+)
+def test_overflowing_varint_len_rejected(length_varint):
+    """Corruption-controlled varint lengths near 2^64 must fail closed
+    (ValueError), never read/write out of bounds (the ADVICE r1 finding)."""
+    for opcode in (b"\x00", b"\x01"):
+        stream = opcode + length_varint + b"\x01\x00" + b"A" * 16
+        blob = _alz_header(rawlen=64) + stream
+        with pytest.raises(ValueError):
+            lossless.decompress(blob)
+
+
+def test_match_beyond_cap_rejected():
+    # valid-looking match op whose len exceeds the declared raw size
+    stream = b"\x00\x04AAAA" + b"\x01\xff\x7f" + b"\x01\x00"
+    blob = _alz_header(rawlen=8) + stream
+    with pytest.raises(ValueError):
+        lossless.decompress(blob)

