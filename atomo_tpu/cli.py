@@ -102,6 +102,10 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                         "log real Comp/Encode/Comm (+ master Gather/Decode) "
                         "seconds — the reference's per-phase observability; "
                         "costs fusion, so default off")
+    t.add_argument("--profile-dir", type=str, default="",
+                   help="capture a jax.profiler device trace of a few "
+                        "steady-state steps into this dir (TensorBoard/XProf "
+                        "loadable) — phase cost inside the fused program")
     t.add_argument("--shrinkage-freq", type=int, default=50,
                    help="steps between lr shrink (reference hardcodes 50)")
     t.add_argument("--data-root", type=str, default="./data")
@@ -261,6 +265,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             health_timeout=args.health_timeout,
             phase_metrics=args.phase_metrics,
             lr_fn=stepwise_shrink(args.lr, args.lr_shrinkage, args.shrinkage_freq),
+            profile_dir=args.profile_dir or None,
         )
     else:
         from atomo_tpu.training import train_loop

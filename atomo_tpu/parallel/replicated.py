@@ -342,6 +342,8 @@ def distributed_train_loop(
     health_timeout: float = 0.0,
     phase_metrics: bool = False,
     lr_fn=None,
+    profile_dir: Optional[str] = None,
+    profile_steps: int = 3,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -359,7 +361,12 @@ def distributed_train_loop(
     phase programs of :func:`make_phase_train_steps` and fills the log
     line's Comp/Encode/Comm fields with real per-phase seconds, plus the
     reference master line's Gather/Decode (``lr_fn(step)`` supplies its lr
-    column). Default off: the fused program is faster."""
+    column). Default off: the fused program is faster.
+
+    ``profile_dir`` captures a jax.profiler device trace (TensorBoard /
+    XProf loadable) around ``profile_steps`` steady-state steps — the
+    honest way to see encode/decode cost INSIDE the fused program, where
+    host-side spans cannot reach (utils/tracing rationale)."""
     from atomo_tpu.parallel.launch import HealthMonitor, HealthWatchdog
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint, save_checkpoint
     from atomo_tpu.training.trainer import create_state
@@ -413,6 +420,7 @@ def distributed_train_loop(
             state, step_fn, eval_fn, stream, train_iter, test_iter, mesh,
             key, timer, n_train, start_step, max_steps, log_every, log_fn,
             eval_freq, save_freq, train_dir, compress_ckpt, monitor, lr_fn,
+            profile_dir, profile_steps,
         )
     finally:
         if watchdog is not None:
@@ -429,15 +437,19 @@ def _make_phased_step_fn(model, optimizer, mesh, codec, *, augment):
     dense_bytes_cache = {}
 
     def step_fn(state, key, si, sl):
+        from atomo_tpu.utils.tracing import annotate
+
         ph = {}
         t0 = _time.perf_counter()
-        grads_x, new_stats, stats = fns["comp"](state, key, si, sl)
-        jax.block_until_ready(stats["loss"])
+        with annotate("comp"):
+            grads_x, new_stats, stats = fns["comp"](state, key, si, sl)
+            jax.block_until_ready(stats["loss"])
         ph["comp"] = _time.perf_counter() - t0
         if codec is not None:
             t0 = _time.perf_counter()
-            wire, msg_bytes = fns["encode"](state, key, grads_x)
-            jax.block_until_ready(msg_bytes)
+            with annotate("encode"):
+                wire, msg_bytes = fns["encode"](state, key, grads_x)
+                jax.block_until_ready(msg_bytes)
             ph["encode"] = _time.perf_counter() - t0
             msg_bytes = int(msg_bytes)
         else:
@@ -447,12 +459,14 @@ def _make_phased_step_fn(model, optimizer, mesh, codec, *, augment):
             msg_bytes = dense_bytes_cache["dense"]
             ph["encode"] = 0.0
         t0 = _time.perf_counter()
-        gathered = fns["comm"](wire)
-        jax.block_until_ready(gathered)
+        with annotate("gather"):
+            gathered = fns["comm"](wire)
+            jax.block_until_ready(gathered)
         ph["gather"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
-        state = fns["update"](state, gathered, new_stats)
-        jax.block_until_ready(state.params)
+        with annotate("decode_update"):
+            state = fns["update"](state, gathered, new_stats)
+            jax.block_until_ready(state.params)
         ph["decode"] = _time.perf_counter() - t0
         metrics = dict(stats)
         metrics["msg_bytes"] = msg_bytes
@@ -465,14 +479,27 @@ def _distributed_steps(
     state, step_fn, eval_fn, stream, train_iter, test_iter, mesh, key,
     timer, n_train, start_step, max_steps, log_every, log_fn, eval_freq,
     save_freq, train_dir, compress_ckpt, monitor, lr_fn=None,
+    profile_dir=None, profile_steps=3,
 ):
     from atomo_tpu.training.checkpoint import save_checkpoint
     from atomo_tpu.utils.metrics import StepMetrics, master_line
+    from atomo_tpu.utils.tracing import profile
 
+    # trace steady-state steps only: step 1 is dominated by compilation
+    prof_first = start_step + 2 if profile_dir else None
+    prof_ctx = None
     for step in range(start_step + 1, max_steps + 1):
+        if prof_first is not None and step == prof_first:
+            prof_ctx = profile(profile_dir)
+            prof_ctx.__enter__()
+            log_fn(f"Profiling steps {step}..{step + profile_steps - 1} -> {profile_dir}")
         images, labels = next(stream)
         si, sl = shard_batch(mesh, images, labels)
         out = step_fn(state, key, si, sl)
+        if prof_ctx is not None and step >= prof_first + profile_steps - 1:
+            jax.block_until_ready(out[0].params)
+            prof_ctx.__exit__(None, None, None)
+            prof_ctx = None
         state, metrics = out[0], out[1]
         phases = out[2] if len(out) > 2 else None
         if monitor is not None:
@@ -537,6 +564,8 @@ def _distributed_steps(
                 )
         if save_freq and train_dir and step % save_freq == 0:
             save_checkpoint(train_dir, jax.device_get(state), step, compress=compress_ckpt)
+    if prof_ctx is not None:  # run shorter than the profiled window
+        prof_ctx.__exit__(None, None, None)
     return state
 
 

@@ -130,6 +130,35 @@ def test_global_mesh_spans_devices():
     assert mesh.devices.size == len(jax.devices())
 
 
+def test_profile_dir_captures_trace(tmp_path):
+    """--profile-dir must produce a jax.profiler trace of steady-state steps
+    (the fused-program observability story, utils/tracing docstring)."""
+    from atomo_tpu.codecs import SvdCodec
+    from atomo_tpu.data import BatchIterator, SPECS, synthetic_dataset
+    from atomo_tpu.models import get_model
+    from atomo_tpu.parallel import distributed_train_loop, make_mesh
+    from atomo_tpu.training import make_optimizer
+
+    ds = synthetic_dataset(SPECS["mnist"], True, size=64)
+    lines = []
+    distributed_train_loop(
+        get_model("lenet", 10),
+        make_optimizer("sgd", lr=0.01),
+        make_mesh(2),
+        BatchIterator(ds, 8, seed=0),
+        codec=SvdCodec(rank=2),
+        max_steps=4,
+        log_fn=lines.append,
+        profile_dir=str(tmp_path),
+        profile_steps=2,
+    )
+    assert any("Profiling steps 2..3" in l for l in lines)
+    trace_files = [
+        f for _, _, fs in __import__("os").walk(tmp_path) for f in fs
+    ]
+    assert trace_files, "no profiler trace written"
+
+
 def test_lr_schedule_parity():
     """lr = base * 0.95^(step//50) — sync_replicas_master_nn.py:106-107,232-234."""
     sched = stepwise_shrink(0.01, 0.95, 50)
