@@ -40,7 +40,7 @@ def get_codec(
     quantization_level: int = 2,
     bucket_size: int = 512,
     sample: str = "fixed_k",
-    algorithm: str = "exact",
+    algorithm: str = "auto",
 ):
     """Build a codec by CLI name (reference --code flag surface + terngrad)."""
     name = name.lower()

@@ -9,10 +9,13 @@ int(64/(2+b)) values per word (qsgd.py:52-79); decode unpacks masks in reverse
 
 TPU-first redesign: TPU vector units have no native 64-bit integer lanes
 (SURVEY.md §2.9), so the word layout is *uint32* with (1+b) bits per value —
-1 sign bit + b magnitude bits, floor(32/(1+b)) values per word. Since round 2
-the wire format is *bucket-padded*: ``words`` has shape
+1 sign bit + b magnitude bits, floor(32/(1+b)) values per word. The wire
+format is *bucket-padded and planar*: ``words`` has shape
 (n_buckets, words_per_bucket), each bucket padded to a whole number of words
-(≤ 1.5% overhead at the default bucket 512). That single layout is shared by
+(≤ 1.5% overhead at the default bucket 512), and bucket position
+p = j*n_words + w sits in word w at bit j*(1+b) — the planar field order is
+what real-TPU Mosaic can pack without a lane-splitting reshape (round-3
+hardware finding; see ops/qsgd_kernels.py). That single layout is shared by
 two interchangeable encode/decode implementations:
 
   * the jnp path — pure vectorized shift/mask ops, the test oracle;
@@ -91,15 +94,17 @@ def pack_bucketed(codes: jax.Array, bits: int) -> jax.Array:
     """(n_buckets, bucket_p) codes -> (n_buckets, bucket_p/vpw) uint32 words.
 
     ``bucket_p`` must already be a multiple of vals-per-word (the caller
-    pads with zero codes). Lane j of a word sits at bit j*(1+bits) — the
-    same layout the Pallas kernel emits.
+    pads with zero codes). *Planar* field layout (round 3, shared with the
+    Pallas kernels): bucket position p = j*n_words + w sits in word w at
+    bit j*(1+bits) — the layout real-TPU Mosaic can pack without a
+    lane-splitting reshape (see ops/qsgd_kernels.py module docstring).
     """
     bpv = _bits_per_value(bits)
     vpw = _vals_per_word(bits)
     nb, bucket_p = codes.shape
-    lanes = codes.astype(jnp.uint32).reshape(nb, bucket_p // vpw, vpw)
-    shifts = (jnp.arange(vpw, dtype=jnp.uint32) * bpv)[None, None, :]
-    return jnp.sum(lanes << shifts, axis=2, dtype=jnp.uint32)
+    lanes = codes.astype(jnp.uint32).reshape(nb, vpw, bucket_p // vpw)
+    shifts = (jnp.arange(vpw, dtype=jnp.uint32) * bpv)[None, :, None]
+    return jnp.sum(lanes << shifts, axis=1, dtype=jnp.uint32)
 
 
 def unpack_bucketed(words: jax.Array, bits: int) -> jax.Array:
@@ -107,8 +112,8 @@ def unpack_bucketed(words: jax.Array, bits: int) -> jax.Array:
     bpv = _bits_per_value(bits)
     vpw = _vals_per_word(bits)
     mask = jnp.uint32((1 << bpv) - 1)
-    shifts = (jnp.arange(vpw, dtype=jnp.uint32) * bpv)[None, None, :]
-    lanes = (words[:, :, None] >> shifts) & mask
+    shifts = (jnp.arange(vpw, dtype=jnp.uint32) * bpv)[None, :, None]
+    lanes = (words[:, None, :] >> shifts) & mask
     return lanes.reshape(words.shape[0], -1)
 
 

