@@ -1,0 +1,97 @@
+"""Worker for the real 2-process jax.distributed smoke test.
+
+Launched (never imported) by tests/test_multiprocess.py: two copies of this
+script form a 2-process jax.distributed job on localhost, each contributing
+2 virtual CPU devices to a global 4-device 'dp' mesh, and run ONE compressed
+SPMD training step end-to-end. This executes the code CI could previously
+only monkeypatch (VERDICT r2 next-round #5):
+
+  * launch.initialize()'s env path actually calling
+    jax.distributed.initialize (replaces the reference's mpirun rank
+    dispatch, src/distributed_nn.py:86-88,243-259);
+  * shard_batch's jax.make_array_from_process_local_data branch
+    (parallel/replicated.py) — each process feeds only its local shard;
+  * the gather-aggregate step with cross-process collectives.
+
+Prints one `RESULT {json}` line; the parent asserts both processes agree
+bit-for-bit on the post-step state (replicated-PS equivalence, SURVEY.md §7
+hard-part 4).
+"""
+
+import json
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from atomo_tpu.parallel import launch  # noqa: E402
+
+launch.initialize()  # env path: JAX_COORDINATOR_ADDRESS / _NUM_PROCESSES / _ID
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from atomo_tpu.codecs import SvdCodec  # noqa: E402
+from atomo_tpu.models import get_model  # noqa: E402
+from atomo_tpu.parallel.launch import global_mesh  # noqa: E402
+from atomo_tpu.parallel.replicated import (  # noqa: E402
+    make_distributed_train_step,
+    replicate_state,
+    shard_batch,
+)
+from atomo_tpu.training import create_state, make_optimizer  # noqa: E402
+
+
+def main() -> None:
+    assert jax.process_count() == 2, f"process_count={jax.process_count()}"
+    assert len(jax.devices()) == 4, f"global devices={len(jax.devices())}"
+    pid = jax.process_index()
+
+    mesh = global_mesh((("dp", 4),))
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.0)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((4, 28, 28, 1), jnp.float32)
+    state = replicate_state(mesh, create_state(model, opt, rng, sample))
+    step = make_distributed_train_step(
+        model, opt, mesh, codec=SvdCodec(rank=2), aggregate="gather"
+    )
+
+    # each process feeds its OWN local shard (2 local devices x 2 samples),
+    # independently generated — the reference's workers also shuffle
+    # independently (src/distributed_nn.py:93-207)
+    local_im = np.random.RandomState(pid).rand(4, 28, 28, 1).astype(np.float32)
+    local_lb = np.random.RandomState(100 + pid).randint(0, 10, (4,)).astype(np.int32)
+    gi, gl = shard_batch(mesh, local_im, local_lb)
+    assert gi.shape[0] == 8, gi.shape  # global batch = both processes' shards
+
+    state, metrics = step(state, jax.random.PRNGKey(1), gi, gl)
+    # fingerprint the post-step replicated params: all processes must agree
+    # exactly or the replicated-PS equivalence is broken
+    fp = float(
+        sum(jnp.sum(jnp.abs(l)) for l in jax.tree_util.tree_leaves(state.params))
+    )
+    print(
+        "RESULT "
+        + json.dumps(
+            {
+                "pid": int(pid),
+                "loss": float(metrics["loss"]),
+                "msg_bytes": int(metrics["msg_bytes"]),
+                "params_l1": fp,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

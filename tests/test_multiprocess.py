@@ -1,0 +1,79 @@
+"""Real 2-process jax.distributed smoke (VERDICT r2 next-round #5).
+
+Previously the multi-host path was tested only by monkeypatching
+jax.distributed.initialize; shard_batch's
+make_array_from_process_local_data branch had never executed. This test
+spawns TWO actual processes with a localhost coordinator and runs one
+compressed SPMD step through the whole stack (see tests/_mp_worker.py).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_mp_worker.py")
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TIMEOUT_S = 420
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_compressed_step():
+    port = _free_port()
+    env_base = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": "2",
+        # the workers import atomo_tpu from the repo root (pytest normally
+        # injects it via rootdir conftest; a bare subprocess does not)
+        "PYTHONPATH": _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER],
+            env={**env_base, "JAX_PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    results = {}
+    try:
+        # drain both children CONCURRENTLY: the workers block on each other
+        # inside collectives, so sequential communicate() could deadlock on
+        # a full stderr pipe of the not-yet-drained process
+        import concurrent.futures
+
+        with concurrent.futures.ThreadPoolExecutor(2) as pool:
+            outs = list(
+                pool.map(lambda p: p.communicate(timeout=_TIMEOUT_S), procs)
+            )
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            for line in out.splitlines():
+                if line.startswith("RESULT "):
+                    r = json.loads(line[len("RESULT "):])
+                    results[r["pid"]] = r
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert sorted(results) == [0, 1], f"missing RESULT lines: {results}"
+    r0, r1 = results[0], results[1]
+    # replicated-PS equivalence across REAL process boundaries: both
+    # controllers must hold bit-identical post-step state and metrics
+    assert r0["loss"] == pytest.approx(r1["loss"], abs=0.0), (r0, r1)
+    assert r0["params_l1"] == pytest.approx(r1["params_l1"], abs=0.0), (r0, r1)
+    # the codec actually ran: factor bytes, not dense bytes, on the wire
+    assert 0 < r0["msg_bytes"] == r1["msg_bytes"]
