@@ -108,6 +108,13 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                    help="capture a jax.profiler device trace of a few "
                         "steady-state steps into this dir (TensorBoard/XProf "
                         "loadable) — phase cost inside the fused program")
+    t.add_argument("--bf16", action="store_true", default=False,
+                   help="mixed precision: forward/backward compute in "
+                        "bfloat16 on the MXU (master params, optimizer "
+                        "state, gradients, loss, and BN stats stay f32). A "
+                        "TPU-native speed mode with no reference analogue "
+                        "(the all-f32 CPU-torch pipeline); codecs consume "
+                        "the f32 gradients, so wire formats are unchanged")
     t.add_argument("--shrinkage-freq", type=int, default=50,
                    help="steps between lr shrink (reference hardcodes 50)")
     t.add_argument("--data-root", type=str, default="./data")
@@ -209,6 +216,7 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
 
 def cmd_train(args: argparse.Namespace) -> int:
     import jax
+    import jax.numpy as jnp
 
     from atomo_tpu.parallel import launch
 
@@ -268,6 +276,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             phase_metrics=args.phase_metrics,
             lr_fn=stepwise_shrink(args.lr, args.lr_shrinkage, args.shrinkage_freq),
             profile_dir=args.profile_dir or None,
+            compute_dtype=jnp.bfloat16 if args.bf16 else None,
         )
     else:
         from atomo_tpu.training import train_loop
@@ -283,6 +292,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             eval_freq=args.eval_freq, seed=args.seed,
             train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
             compress_ckpt=args.compress, log_every=args.log_interval,
+            compute_dtype=jnp.bfloat16 if args.bf16 else None,
         )
     return 0
 

@@ -50,11 +50,20 @@ from atomo_tpu.codecs import (
 )
 from atomo_tpu.data.pipeline import augment_batch
 from atomo_tpu.parallel.mesh import batch_sharded, replicated
-from atomo_tpu.training.trainer import TrainState, cross_entropy_loss
+from atomo_tpu.training.trainer import (
+    TrainState,
+    cast_compute_inputs,
+    cast_compute_outputs,
+    cross_entropy_loss,
+)
 from atomo_tpu.utils.metrics import accuracy
 
 
-def _loss_fn(model, params, batch_stats, images, labels, dropout_key):
+def _loss_fn(model, params, batch_stats, images, labels, dropout_key,
+             compute_dtype=None):
+    if compute_dtype is not None:
+        # mixed precision: the one shared contract (trainer.cast_compute_*)
+        params, images = cast_compute_inputs(params, images, compute_dtype)
     variables = {"params": params}
     has_bn = bool(jax.tree_util.tree_leaves(batch_stats))
     if has_bn:
@@ -68,6 +77,8 @@ def _loss_fn(model, params, batch_stats, images, labels, dropout_key):
     )
     logits, mutated = out
     new_stats = mutated.get("batch_stats", batch_stats)
+    if compute_dtype is not None:
+        logits, new_stats = cast_compute_outputs(logits, new_stats)
     loss = cross_entropy_loss(logits, labels)
     return loss, (logits, new_stats)
 
@@ -82,6 +93,7 @@ def make_distributed_train_step(
     aggregate: str = "gather",
     augment: bool = False,
     num_aggregate: int = 0,
+    compute_dtype=None,
 ):
     """Build the jitted SPMD train step over ``mesh``.
 
@@ -121,7 +133,7 @@ def make_distributed_train_step(
         if augment:
             images = augment_batch(k_aug, images)
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
-            partial(_loss_fn, model), has_aux=True
+            partial(_loss_fn, model, compute_dtype=compute_dtype), has_aux=True
         )(state.params, state.batch_stats, images, labels, k_drop)
 
         dense_bytes = tree_nbytes(grads)
@@ -199,6 +211,7 @@ def make_phase_train_steps(
     *,
     axis: str = "dp",
     augment: bool = False,
+    compute_dtype=None,
 ):
     """Split the SPMD train step into four separately-jitted programs so the
     host can time each phase — the observability the reference's log line
@@ -226,7 +239,7 @@ def make_phase_train_steps(
         if augment:
             images = augment_batch(k_aug, images)
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
-            partial(_loss_fn, model), has_aux=True
+            partial(_loss_fn, model, compute_dtype=compute_dtype), has_aux=True
         )(state.params, state.batch_stats, images, labels, k_drop)
         prec1, prec5 = accuracy(logits, labels)
         stats = {
@@ -344,6 +357,7 @@ def distributed_train_loop(
     lr_fn=None,
     profile_dir: Optional[str] = None,
     profile_steps: int = 3,
+    compute_dtype=None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -396,12 +410,13 @@ def distributed_train_loop(
                 f"{aggregate!r} — drop --phase-metrics to time the psum path"
             )
         step_fn = _make_phased_step_fn(
-            model, optimizer, mesh, codec, augment=augment
+            model, optimizer, mesh, codec, augment=augment,
+            compute_dtype=compute_dtype,
         )
     else:
         step_fn = make_distributed_train_step(
             model, optimizer, mesh, codec, aggregate=aggregate, augment=augment,
-            num_aggregate=num_aggregate,
+            num_aggregate=num_aggregate, compute_dtype=compute_dtype,
         )
     eval_fn = make_distributed_eval_step(model, mesh) if test_iter is not None else None
     key = jax.random.PRNGKey(seed + 1)
@@ -428,12 +443,14 @@ def distributed_train_loop(
     return state
 
 
-def _make_phased_step_fn(model, optimizer, mesh, codec, *, augment):
+def _make_phased_step_fn(model, optimizer, mesh, codec, *, augment,
+                         compute_dtype=None):
     """Wrap make_phase_train_steps into a (state, key, si, sl) ->
     (state, metrics, phase_seconds) callable with host-side phase timing."""
     import time as _time
 
-    fns = make_phase_train_steps(model, optimizer, mesh, codec, augment=augment)
+    fns = make_phase_train_steps(model, optimizer, mesh, codec, augment=augment,
+                                 compute_dtype=compute_dtype)
     dense_bytes_cache = {}
 
     def step_fn(state, key, si, sl):

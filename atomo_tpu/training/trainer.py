@@ -50,6 +50,25 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def cast_compute_inputs(params, images, compute_dtype):
+    """Mixed-precision entry cast: params + images to ``compute_dtype``
+    (bf16 fwd/bwd on the MXU); the f32 master params stay outside. The
+    single contract shared by the single-host and SPMD loss functions."""
+    params = jax.tree_util.tree_map(
+        lambda a: a.astype(compute_dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        params,
+    )
+    return params, images.astype(compute_dtype)
+
+
+def cast_compute_outputs(logits, new_stats):
+    """Mixed-precision exit cast: loss/softmax and BN running stats in f32."""
+    return logits.astype(jnp.float32), jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.float32), new_stats
+    )
+
+
 def create_state(model, optimizer, rng, sample_input) -> TrainState:
     variables = model.init(
         {"params": rng, "dropout": jax.random.PRNGKey(0)}, sample_input, train=False
@@ -64,15 +83,24 @@ def create_state(model, optimizer, rng, sample_input) -> TrainState:
     )
 
 
-def make_train_step(model, optimizer, codec=None, augment: bool = False):
+def make_train_step(model, optimizer, codec=None, augment: bool = False,
+                    compute_dtype=None):
     """Build the jitted single-host train step.
 
     codec != None applies encode->decode to the gradient pytree in-graph
     (per-leaf folded PRNG keys) before the optimizer — the compression
     study path.
+
+    compute_dtype (e.g. jnp.bfloat16) selects mixed-precision compute:
+    master params, optimizer state, gradients, loss, and BN running stats
+    stay float32; the forward/backward matmuls and convs run in the given
+    dtype — the MXU's native bf16 path, a TPU capability the all-f32
+    CPU-torch reference has no analogue for. None = full f32.
     """
 
     def loss_fn(params, batch_stats, images, labels, dropout_key):
+        if compute_dtype is not None:
+            params, images = cast_compute_inputs(params, images, compute_dtype)
         variables = {"params": params}
         has_bn = bool(jax.tree_util.tree_leaves(batch_stats))
         if has_bn:
@@ -86,6 +114,8 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False):
         )
         logits, mutated = out
         new_stats = mutated.get("batch_stats", batch_stats)
+        if compute_dtype is not None:
+            logits, new_stats = cast_compute_outputs(logits, new_stats)
         loss = cross_entropy_loss(logits, labels)
         return loss, (logits, new_stats)
 
@@ -171,6 +201,7 @@ def train_loop(
     compress_ckpt: bool = True,
     log_fn=print,
     log_every: int = 1,
+    compute_dtype=None,
 ) -> TrainState:
     """The reference train_and_validate loop (nn_ops.py:123-169), jitted,
     plus working checkpoint/resume (gap §5.4)."""
@@ -185,7 +216,9 @@ def train_loop(
         state = load_checkpoint(train_dir, state)
         start_step = int(state.step)
         log_fn(f"Resumed from {train_dir} at step {start_step}")
-    step_fn = make_train_step(model, optimizer, codec=codec, augment=augment)
+    step_fn = make_train_step(
+        model, optimizer, codec=codec, augment=augment, compute_dtype=compute_dtype
+    )
     key = jax.random.PRNGKey(seed + 1)
     timer = Timer()
     stream = train_iter.forever()

@@ -73,7 +73,8 @@ CONFIGS = {
             input=(28, 28, 1), batch=128, code="qsgd", ways=1),
     2: dict(metric="resnet18_cifar10_svd3_step_time", network="resnet18",
             input=(32, 32, 3), batch=128, code="svd", rank=3, ways=8,
-            torch_baseline=True, dense_compare=True, qsgd_compare=True),
+            torch_baseline=True, dense_compare=True, qsgd_compare=True,
+            bf16_compare=True),
     3: dict(metric="vgg11_cifar10_svd5_step_time", network="vgg11",
             input=(32, 32, 3), batch=128, code="svd", rank=5, ways=16,
             dense_compare=True),
@@ -155,19 +156,55 @@ def measure_ours(cfg: dict) -> dict:
         block_until_ready does not wait on this backend — a scalar fetch
         from the final step's metrics is the only honest fence; the
         sequential state dependency makes it transitively fence all STEPS
-        steps)."""
+        steps).
+
+        Two measurements:
+          * scanned — STEPS steps under ONE lax.scan dispatch, the
+            idiomatic jitted-training-loop shape. This is pure device time
+            and the headline `value`.
+          * dispatch loop — one dispatch per step. On this axon tunnel
+            each dispatch costs ~3 ms of host/tunnel overhead regardless
+            of size (measured: a 128-float elementwise op and a 33 MB one
+            both take ~3 ms per call), so this number reflects the tunnel,
+            not the chip; emitted as `dispatch_ms_per_step` for
+            transparency.
+        """
+
+        @jax.jit
+        def multi(s0, k, im, lb):
+            def body(s, _):
+                s, m = step_fn(s, k, im, lb)
+                return s, m["loss"]
+            s_out, losses = jax.lax.scan(body, s0, None, length=STEPS)
+            return s_out, losses[-1]
+
         m = None
         for _ in range(WARMUP):
             st, m = step_fn(st, key, images, labels)
-        float(m["loss"])  # drain warmup + compile before the clock starts
+        float(m["loss"])  # drain warmup + per-step compile
         t0 = time.perf_counter()
         for _ in range(STEPS):
             st, m = step_fn(st, key, images, labels)
-        sync = float(m["loss"])  # the fence
-        dt = (time.perf_counter() - t0) / STEPS
-        return dt, st, m, sync
+        disp_sync = float(m["loss"])  # the fence
+        disp_dt = (time.perf_counter() - t0) / STEPS
 
-    dt, state, metrics, sync = timed(step, state)
+        st, last = multi(st, key, images, labels)
+        float(last)  # compile + warm the scanned program
+        # best-of-3: this chip is shared — contention inflates individual
+        # runs ~5x (measured: the same 33 MB elementwise op at 0.28 ms and
+        # 1.41 ms minutes apart); the MIN is the standard contention-robust
+        # estimator of true device time
+        dt, scan_sync = float("inf"), float("nan")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            st, last = multi(st, key, images, labels)
+            scan_sync = float(last)  # one dispatch fences all STEPS steps
+            dt = min(dt, (time.perf_counter() - t0) / STEPS)
+
+        sync = scan_sync if math.isfinite(disp_sync) else disp_sync
+        return dt, disp_dt, st, m, sync
+
+    dt, disp_dt, state, metrics, sync = timed(step, state)
 
     dense = sum(
         l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(state.params)
@@ -197,11 +234,12 @@ def measure_ours(cfg: dict) -> dict:
         platform=dev.platform,
         device=dev.device_kind,
         ways=cfg.get("ways", 1),
+        dispatch_ms_per_step=round(disp_dt * 1e3, 3),
         chips_measured=1,  # step time measured on the one locally attached
         # chip; `ways` is only the reference cluster width this config models
         measurement_valid=valid,
         invalid_reason=invalid_reason,
-        timing="warm-cache-scalar-sync",
+        timing="scan-fenced",  # value = device time of a scanned step loop
     )
 
     if cfg.get("qsgd_compare") and dev.platform == "tpu":
@@ -215,9 +253,22 @@ def measure_ours(cfg: dict) -> dict:
                 "production QSGD pallas path failed: " + cmp_res["qsgd_encode_error"]
             )
 
+    if cfg.get("bf16_compare"):
+        # the TPU-native mixed-precision mode (no reference analogue): same
+        # codec, bf16 fwd/bwd on the MXU, f32 master state
+        bf16_step = make_train_step(model, opt, codec=codec,
+                                    compute_dtype=jnp.bfloat16)
+        bdt, _, _, _, bsync = timed(bf16_step, create_state(model, opt, rng, images))
+        out["bf16_ms_per_step"] = round(bdt * 1e3, 3)
+        if not math.isfinite(bsync):
+            out["measurement_valid"] = False
+            reason = f"bf16 sync scalar not finite: {bsync}"
+            prior = out.get("invalid_reason")
+            out["invalid_reason"] = f"{prior}; {reason}" if prior else reason
+
     if cfg.get("dense_compare"):
         dense_step = make_train_step(model, opt, codec=None)
-        ddt, _, _, dsync = timed(dense_step, create_state(model, opt, rng, images))
+        ddt, _, _, _, dsync = timed(dense_step, create_state(model, opt, rng, images))
         out["dense_ms_per_step"] = round(ddt * 1e3, 3)
         if not math.isfinite(dsync):  # same validity discipline as the headline
             out["measurement_valid"] = False
@@ -259,22 +310,32 @@ def _qsgd_encode_compare() -> dict:
     n = 1 << 23  # ~8.4M f32 values ≈ a ResNet-18 gradient, flattened
     g = jax.random.normal(jax.random.PRNGKey(3), (n,), jnp.float32)
     key = jax.random.PRNGKey(4)
+    reps = 30
     res = {}
     for tag, up in (("jnp", False), ("pallas", True)):
         try:
             codec = QsgdCodec(bits=4, use_pallas=up)
-            enc = jax.jit(lambda k, x, c=codec: c.encode(k, x))
-            p = enc(key, g)
-            float(p.scales[0])  # real fence (block_until_ready is a no-op here)
-            t0 = time.perf_counter()
-            reps = 20
-            for _ in range(reps):
-                p = enc(key, g)
-            # single device stream: syncing the last dispatch syncs them all
-            float(p.scales[0])
-            res[f"qsgd_encode_{tag}_ms"] = round(
-                (time.perf_counter() - t0) / reps * 1e3, 3
-            )
+
+            # scan the encodes under ONE dispatch: per-call dispatch costs
+            # ~3 ms on this tunnel, swamping a ~1.7 ms device-time encode
+            @jax.jit
+            def many(k, x, c=codec):
+                def body(acc, i):
+                    p = c.encode(jax.random.fold_in(k, i), x)
+                    # consume outputs so no encode is dead-code-eliminated
+                    return acc + p.scales[0] + jnp.float32(p.words[0, 0] & 1), None
+                acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(reps))
+                return acc
+
+            float(many(key, g))  # compile + warm
+            best = float("inf")
+            for _ in range(3):  # best-of-3 (shared-chip contention)
+                t0 = time.perf_counter()
+                sync = float(many(key, g))  # one dispatch, scalar fence
+                best = min(best, (time.perf_counter() - t0) / reps)
+                if not math.isfinite(sync):
+                    raise RuntimeError(f"{tag} encode sync scalar not finite: {sync}")
+            res[f"qsgd_encode_{tag}_ms"] = round(best * 1e3, 3)
         except Exception as exc:
             if up:  # the production path on TPU — escalated by the caller
                 res["qsgd_encode_error"] = str(exc)[:200]

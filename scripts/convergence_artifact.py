@@ -122,9 +122,14 @@ def main() -> int:
         json.dump(record, f, indent=1)
 
     def sparkline(xs, buckets=40):
+        # log10 scale: training loss decays exponentially, so a linear
+        # bucketing collapses everything after the first steps to one glyph
         blocks = " .:-=+*#%@"
         chunk = max(1, len(xs) // buckets)
-        means = [float(np.mean(xs[i : i + chunk])) for i in range(0, len(xs), chunk)]
+        means = [
+            float(np.log10(max(np.mean(xs[i : i + chunk]), 1e-8)))
+            for i in range(0, len(xs), chunk)
+        ]
         lo, hi = min(means), max(means)
         span = max(hi - lo, 1e-9)
         return "".join(blocks[int((x - lo) / span * (len(blocks) - 1))] for x in means)

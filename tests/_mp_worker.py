@@ -74,11 +74,14 @@ def main() -> None:
     assert gi.shape[0] == 8, gi.shape  # global batch = both processes' shards
 
     state, metrics = step(state, jax.random.PRNGKey(1), gi, gl)
-    # fingerprint the post-step replicated params: all processes must agree
-    # exactly or the replicated-PS equivalence is broken
-    fp = float(
-        sum(jnp.sum(jnp.abs(l)) for l in jax.tree_util.tree_leaves(state.params))
-    )
+    # fingerprint the post-step replicated params: a cryptographic hash of
+    # the raw bytes — an L1-sum scalar would absorb sub-rounding or
+    # compensating divergences and defeat the bit-for-bit claim
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        h.update(np.asarray(jax.device_get(leaf)).tobytes())
     print(
         "RESULT "
         + json.dumps(
@@ -86,7 +89,7 @@ def main() -> None:
                 "pid": int(pid),
                 "loss": float(metrics["loss"]),
                 "msg_bytes": int(metrics["msg_bytes"]),
-                "params_l1": fp,
+                "params_sha256": h.hexdigest(),
             }
         ),
         flush=True,

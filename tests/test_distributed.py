@@ -246,3 +246,22 @@ def test_phase_metrics_loop_logs_nonzero_phases():
     comp, enc, comm = (float(g) for g in m.groups())
     assert comp > 0 and enc > 0 and comm > 0
     assert "Cur lr 0.01" in master[-1]
+
+
+def test_bf16_distributed_replicas_stay_identical():
+    """Mixed precision under SPMD: the bf16 step must keep the replicated-PS
+    equivalence contract (f32 master state bit-identical across replicas)."""
+    mesh, model, opt, it, state = _setup()
+    step = make_distributed_train_step(
+        model, opt, mesh, SvdCodec(rank=2), compute_dtype=jnp.bfloat16
+    )
+    images, labels = next(iter(it.epoch()))
+    si, sl = shard_batch(mesh, images, labels)
+    for k in range(3):
+        state, metrics = step(state, jax.random.PRNGKey(7), si, sl)
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32
+        per_dev = np.stack([np.asarray(s.data) for s in leaf.addressable_shards])
+        for r in range(1, per_dev.shape[0]):
+            np.testing.assert_array_equal(per_dev[0], per_dev[r])
+    assert np.isfinite(float(metrics["loss"]))
