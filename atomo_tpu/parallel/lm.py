@@ -29,7 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from atomo_tpu.codecs import decode_mean_tree, encode_tree, tree_nbytes
 from atomo_tpu.models.transformer import TransformerLM
-from atomo_tpu.parallel.ring import ring_attention
+from atomo_tpu.parallel.ring import ATTENTION_IMPLS
 from atomo_tpu.training.trainer import TrainState
 
 
@@ -41,10 +41,19 @@ def make_lm_train_step(
     *,
     dp_axis: str = "dp",
     sp_axis: str = "sp",
+    attn_impl: str = "ring",
 ):
     """Jitted (state, key, tokens) -> (state, metrics) with tokens (B, S)
     sharded batch-over-dp and sequence-over-sp. ``lm_config`` are
-    TransformerLM kwargs (attention_fn is injected here)."""
+    TransformerLM kwargs (attention_fn is injected here). ``attn_impl``
+    selects the sequence-parallel strategy: "ring" (ppermute K/V rotation,
+    O(S/n) memory) or "ulysses" (two all_to_all collectives, blockwise
+    local attention on H/n heads — see parallel.ring.ulysses_attention)."""
+    if attn_impl not in ATTENTION_IMPLS:
+        raise ValueError(
+            f"unknown attn_impl {attn_impl!r}; expected one of "
+            f"{sorted(ATTENTION_IMPLS)}"
+        )
     n_sp = mesh.shape[sp_axis]
     n_dp = mesh.shape[dp_axis]
 
@@ -52,7 +61,8 @@ def make_lm_train_step(
         model = TransformerLM(
             **lm_config,
             attention_fn=partial(
-                ring_attention, axis_name=sp_axis, axis_size=n_sp, causal=True
+                ATTENTION_IMPLS[attn_impl], axis_name=sp_axis,
+                axis_size=n_sp, causal=True,
             ),
         )
         my_dp = jax.lax.axis_index(dp_axis)

@@ -453,6 +453,16 @@ def _make_phased_step_fn(model, optimizer, mesh, codec, *, augment,
                                  compute_dtype=compute_dtype)
     dense_bytes_cache = {}
 
+    def _fence(tree):
+        """Device->host scalar fetch on one leaf: the only fence that works
+        on every backend — jax.block_until_ready returns WITHOUT waiting on
+        tunneled backends (the axon finding behind VERDICT r2 finding 2),
+        which would turn every phase second below into a dispatch artifact.
+        One program runs at a time per device, so fencing any output of the
+        phase program fences the whole phase."""
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        float(jnp.sum(leaf).astype(jnp.float32))
+
     def step_fn(state, key, si, sl):
         from atomo_tpu.utils.tracing import annotate
 
@@ -460,15 +470,15 @@ def _make_phased_step_fn(model, optimizer, mesh, codec, *, augment,
         t0 = _time.perf_counter()
         with annotate("comp"):
             grads_x, new_stats, stats = fns["comp"](state, key, si, sl)
-            jax.block_until_ready(stats["loss"])
+            _fence(stats["loss"])
         ph["comp"] = _time.perf_counter() - t0
         if codec is not None:
             t0 = _time.perf_counter()
             with annotate("encode"):
                 wire, msg_bytes = fns["encode"](state, key, grads_x)
-                jax.block_until_ready(msg_bytes)
+                # the int() fetch IS the fence (blocking scalar transfer)
+                msg_bytes = int(msg_bytes)
             ph["encode"] = _time.perf_counter() - t0
-            msg_bytes = int(msg_bytes)
         else:
             wire = grads_x
             if "dense" not in dense_bytes_cache:
@@ -478,12 +488,12 @@ def _make_phased_step_fn(model, optimizer, mesh, codec, *, augment,
         t0 = _time.perf_counter()
         with annotate("gather"):
             gathered = fns["comm"](wire)
-            jax.block_until_ready(gathered)
+            _fence(gathered)
         ph["gather"] = _time.perf_counter() - t0
         t0 = _time.perf_counter()
         with annotate("decode_update"):
             state = fns["update"](state, gathered, new_stats)
-            jax.block_until_ready(state.params)
+            _fence(state.params)
         ph["decode"] = _time.perf_counter() - t0
         metrics = dict(stats)
         metrics["msg_bytes"] = msg_bytes

@@ -122,13 +122,119 @@ def full_attention(
     ).astype(q.dtype)
 
 
-def make_sequence_parallel_attention(mesh: Mesh, axis: str = "sp", causal: bool = True):
-    """shard_map-wrapped ring attention: (B, H, S, D) arrays sharded over
-    ``axis`` on the sequence dim; drop-in for full_attention at S too large
-    for one chip."""
+def blockwise_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_size: int = 512,
+) -> jax.Array:
+    """Single-device exact attention that never materializes the S×S score
+    matrix: streams K/V blocks through the same online-softmax update the
+    ring uses, O(Sq·block) score memory. Equals full_attention (tested)."""
+    b, h, s, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    blk = min(block_size, s)
+    n_blocks = -(-s // blk)
+    pad = n_blocks * blk - s
+    neg = jnp.float32(-jnp.inf)
+    kf, vf = k.astype(jnp.float32), v.astype(jnp.float32)
+    if pad:  # pad keys with fully-masked positions
+        kf = jnp.pad(kf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    q_pos = jnp.arange(s)
+
+    def body(t, carry):
+        m, l, o = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(kf, t * blk, blk, axis=2)
+        v_blk = jax.lax.dynamic_slice_in_dim(vf, t * blk, blk, axis=2)
+        k_pos = t * blk + jnp.arange(blk)
+        valid = k_pos[None, :] < s
+        if causal:
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        bias = jnp.where(valid, 0.0, neg)
+        return _online_softmax_block(q, k_blk, v_blk, bias, m, l, o, scale)
+
+    m0 = jnp.full((b, h, s), neg, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    o0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m, l, o = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, o0))
+    out = o / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_size: int = 512,
+) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism: swap the
+    sequence sharding for a *head* sharding with one ``all_to_all``, run
+    blockwise exact attention on whole sequences for H/n local heads, and
+    swap back. The second first-class long-context strategy next to
+    :func:`ring_attention`:
+
+      * ring — n ppermute hops of K/V around the ICI torus, O(S/n)
+        sequence activations per chip; best when S is huge and H is small.
+      * ulysses — TWO all_to_all collectives total (q/k/v ride one stacked
+        collective in, the output one out — vs n hops), and the local
+        attention is blockwise (no S×S matrix; O(S·block) score memory,
+        O(S/n · H) activations after the swap); needs H divisible by n.
+
+    Same contract as ring_attention: call inside shard_map with per-chip
+    (B, H, S/n, D), shard-major global sequence order; returns the per-chip
+    (B, H, S/n, D) output block. Exactness is tested against
+    full_attention, and gradient parity against ring
+    (tests/test_ring.py).
+    """
+    b, h, s_local, d = q.shape
+    if h % axis_size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({h}) divisible by the {axis_name!r} "
+            f"axis ({axis_size}); use ring_attention otherwise"
+        )
+
+    # ONE collective for all three operands: stack -> (3, B, H, S/n, D),
+    # split heads (axis 2), concat sequence (axis 3)
+    qkv = jnp.stack([q, k, v])
+    qkv = jax.lax.all_to_all(qkv, axis_name, split_axis=2, concat_axis=3, tiled=True)
+    q_g, k_g, v_g = qkv[0], qkv[1], qkv[2]  # (B, H/n, S, D)
+    out = blockwise_attention(
+        q_g, k_g, v_g, causal=causal, scale=scale, block_size=block_size
+    )
+    # (B, H/n, S, D) -> (B, H, S/n, D): split the sequence, regather heads
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+ATTENTION_IMPLS = {"ring": ring_attention, "ulysses": ulysses_attention}
+
+
+def make_sequence_parallel_attention(
+    mesh: Mesh, axis: str = "sp", causal: bool = True, impl: str = "ring"
+):
+    """shard_map-wrapped sequence-parallel attention: (B, H, S, D) arrays
+    sharded over ``axis`` on the sequence dim; drop-in for full_attention
+    at S too large for one chip. ``impl`` picks the strategy ("ring" |
+    "ulysses" — see ulysses_attention for the tradeoff)."""
+    if impl not in ATTENTION_IMPLS:
+        raise ValueError(
+            f"unknown attention impl {impl!r}; expected one of "
+            f"{sorted(ATTENTION_IMPLS)}"
+        )
     n = mesh.shape[axis]
 
-    fn = partial(ring_attention, axis_name=axis, axis_size=n, causal=causal)
+    fn = partial(ATTENTION_IMPLS[impl], axis_name=axis, axis_size=n, causal=causal)
     return jax.jit(
         jax.shard_map(
             fn,
