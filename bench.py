@@ -420,7 +420,15 @@ def measure_reference_cpu(batch: int, rank: int) -> float:
     import torch
     import torch.nn.functional as F
 
-    torch.set_num_threads(max(torch.get_num_threads(), 4))
+    # cap threads at the actually-usable core count: this box exposes many
+    # CPUs but schedules ~1; forcing 4 threads oversubscribes and SLOWS the
+    # baseline (observed 12+ CPU-minutes for 3 steps)
+    usable = (
+        len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity")  # Linux-only API
+        else (os.cpu_count() or 1)
+    )
+    torch.set_num_threads(min(torch.get_num_threads(), usable))
     net = _torch_resnet18()
     x = torch.rand(batch, 3, 32, 32)
     y = torch.randint(0, 10, (batch,))
@@ -432,12 +440,21 @@ def measure_reference_cpu(batch: int, rank: int) -> float:
         for p in net.parameters():
             _numpy_svd_encode_decode(p.grad.numpy().astype(np.float32), rank)
 
-    one_step()  # warmup
+    t0 = time.perf_counter()
+    one_step()  # warmup doubles as a cost probe
+    warm = time.perf_counter() - t0
+    if warm > 300:
+        # on a 1-core host a single reference step can run for many minutes;
+        # at that scale the warmup IS the measurement (the comparison is
+        # off by orders of magnitude either way) and burning 2 more steps
+        # only risks the child timeout. The protocol marker travels into
+        # the JSON so the cold-step inflation is visible to consumers.
+        return warm, "1-cold-step"
     n = 2
     t0 = time.perf_counter()
     for _ in range(n):
         one_step()
-    return (time.perf_counter() - t0) / n
+    return (time.perf_counter() - t0) / n, "2-step-mean"
 
 
 def _backend_or_die(timeout_s: int = BACKEND_TIMEOUT_S):
@@ -466,19 +483,39 @@ def _backend_or_die(timeout_s: int = BACKEND_TIMEOUT_S):
 
 
 def child_main(args) -> int:
+    global STEPS, WARMUP
+    # fast mode (set by the parent's CPU-fallback path): a ResNet config at
+    # the full 30-step x best-of-3 protocol cannot finish on this box's one
+    # CPU core inside the child timeout — trade precision for existence
+    STEPS = int(os.environ.get("ATOMO_BENCH_STEPS", STEPS))
+    WARMUP = int(os.environ.get("ATOMO_BENCH_WARMUP", WARMUP))
     _honor_platform_env()
     _backend_or_die()
-    cfg = CONFIGS[args.config if args.config is not None else 2]
+    cfg = dict(CONFIGS[args.config if args.config is not None else 2])
+    fast = os.environ.get("ATOMO_BENCH_FAST") == "1"
+    if fast:
+        # side-compares are TPU evidence; in CPU-fallback mode they only
+        # multiply the time to a already-degraded number
+        for k in ("dense_compare", "bf16_compare", "qsgd_compare", "ckpt"):
+            cfg.pop(k, None)
     out = measure_ours(cfg)
+    if fast:
+        # the metric NAME is kept stable for consumers, so mark explicitly
+        # which protocol parts were dropped (e.g. config 4's ckpt timing)
+        out["degraded_protocol"] = (
+            f"cpu-fallback fast mode: {STEPS} steps, side-compares "
+            "(dense/bf16/qsgd/ckpt) skipped"
+        )
     # flush an intermediate row before the (slow, host-CPU) torch baseline:
     # if the baseline is killed by the parent's timeout, the accelerator
     # measurement above still reaches the parent (it parses the LAST line)
     print(json.dumps({**out, "vs_baseline": None, "baseline": "pending", "error": None}), flush=True)
     if cfg.get("torch_baseline") and not args.no_baseline:
         try:
-            base_s = measure_reference_cpu(cfg["batch"], cfg.get("rank", 3))
+            base_s, proto = measure_reference_cpu(cfg["batch"], cfg.get("rank", 3))
             out["vs_baseline"] = round(base_s / (out["value"] / 1e3), 3)
             out["baseline"] = "torch-cpu-refpipe"
+            out["baseline_protocol"] = proto
         except Exception:
             out["vs_baseline"] = None
             out["baseline"] = "none"
@@ -533,7 +570,13 @@ def _bench_one(config: int, no_baseline: bool) -> dict:
             return parsed
         last_err = err
     # final fallback: measure on the CPU backend rather than report nothing
-    parsed, err = _run_child(tail + ["--no-baseline"], {"JAX_PLATFORMS": "cpu"})
+    # (fast mode: 4 steps, no side-compares — existence beats precision on
+    # a 1-core host; the row carries the degraded-protocol marker in error)
+    parsed, err = _run_child(
+        tail + ["--no-baseline"],
+        {"JAX_PLATFORMS": "cpu", "ATOMO_BENCH_FAST": "1",
+         "ATOMO_BENCH_STEPS": "4", "ATOMO_BENCH_WARMUP": "1"},
+    )
     if parsed is not None:
         parsed["error"] = f"tpu attempts failed ({last_err}); cpu fallback"
         return parsed
@@ -563,16 +606,21 @@ def main() -> int:
         print(json.dumps(_bench_one(args.config, args.no_baseline)))
         return 0
     # default: the whole BASELINE.md ladder (VERDICT r2 next-round #4) —
-    # one row per config as it completes, then ONE aggregate headline line
-    # (config 2's fields + all rows under "configs") as the LAST line,
-    # which is what the driver records.
+    # one row per config as it completes, then an aggregate headline line
+    # (config 2's fields + all rows so far under "configs"). The aggregate
+    # re-emits after every config from 2 on, so if the caller times the
+    # bench out mid-ladder, the LAST stdout line (what the driver records)
+    # is still a valid headline row rather than whichever config happened
+    # to finish last.
     rows = {}
     for c in sorted(CONFIGS):
         rows[c] = _bench_one(c, args.no_baseline)
         print(json.dumps(rows[c]), flush=True)
-    headline = dict(rows[2])
-    headline["configs"] = [rows[c] for c in sorted(rows)]
-    print(json.dumps(headline))
+        if 2 in rows:
+            headline = dict(rows[2])
+            headline["configs"] = [rows[k] for k in sorted(rows)]
+            headline["configs_complete"] = len(rows) == len(CONFIGS)
+            print(json.dumps(headline), flush=True)
     return 0
 
 

@@ -1,0 +1,83 @@
+"""Real-TPU compile + correctness coverage for the SVD codec hot path and
+the distributed step program.
+
+The CPU suite proves semantics; these prove the SAME programs lower through
+XLA:TPU — the class of gap round 2 exposed for QSGD (code that only runs on
+hardware had zero hardware coverage). Everything here auto-skips off-TPU
+(tests_tpu/conftest.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from atomo_tpu.codecs import SvdCodec, encode_tree, decode_tree
+from atomo_tpu.models import get_model
+from atomo_tpu.training import create_state, make_optimizer, make_train_step
+
+
+def test_default_svd_codec_roundtrip_on_chip():
+    """The default codec config (auto sketch + residual probes) on a
+    conv-sized gradient: encode → decode on the chip, sane output."""
+    codec = SvdCodec(rank=3)
+    g = jax.random.normal(jax.random.PRNGKey(0), (512, 512), jnp.float32)
+    rt = jax.jit(
+        lambda k, x: codec.decode(codec.encode(k, x), (512, 512))
+    )
+    out = np.asarray(rt(jax.random.PRNGKey(1), g))
+    assert np.isfinite(out).all()
+    # rank-3+2probes of a noise matrix: reconstruction is sparse in energy
+    # but must correlate positively in expectation over keys
+    acc = np.zeros_like(out)
+    for i in range(16):
+        acc += np.asarray(rt(jax.random.PRNGKey(10 + i), g))
+    corr = np.corrcoef(acc.ravel(), np.asarray(g).ravel())[0, 1]
+    assert corr > 0.1, f"mean decode uncorrelated with input: {corr}"
+
+
+def test_resnet18_compressed_train_step_on_chip():
+    """One full compressed train step (fwd/bwd + encode_tree + decode_tree +
+    update) compiles and runs on the chip with finite loss."""
+    model = get_model("resnet18", 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (16, 32, 32, 3), jnp.float32)
+    labels = jax.random.randint(rng, (16,), 0, 10)
+    state = create_state(model, opt, rng, images)
+    step = make_train_step(model, opt, codec=SvdCodec(rank=3))
+    state, m = step(state, jax.random.PRNGKey(1), images, labels)
+    assert np.isfinite(float(m["loss"]))
+    assert int(m["msg_bytes"]) > 0
+
+
+def test_bf16_train_step_on_chip():
+    """The --bf16 step (bf16 MXU compute, f32 master state) on hardware."""
+    model = get_model("resnet18", 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (16, 32, 32, 3), jnp.float32)
+    labels = jax.random.randint(rng, (16,), 0, 10)
+    state = create_state(model, opt, rng, images)
+    step = make_train_step(
+        model, opt, codec=SvdCodec(rank=3), compute_dtype=jnp.bfloat16
+    )
+    state, m = step(state, jax.random.PRNGKey(1), images, labels)
+    assert np.isfinite(float(m["loss"]))
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32
+
+
+def test_encode_tree_bucketed_on_chip():
+    """The production bucketed/vmapped encode over a small pytree."""
+    rng = jax.random.PRNGKey(5)
+    params = {
+        "a": jax.random.normal(rng, (64, 64)),
+        "b": jax.random.normal(jax.random.fold_in(rng, 1), (64, 64)),
+        "c": jax.random.normal(jax.random.fold_in(rng, 2), (40,)),
+    }
+    codec = SvdCodec(rank=2)
+    payloads, stats = encode_tree(codec, rng, params)
+    decoded = decode_tree(codec, payloads, params)
+    for leaf in jax.tree_util.tree_leaves(decoded):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert stats.payload_bytes < stats.dense_bytes
