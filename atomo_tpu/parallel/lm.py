@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from atomo_tpu.codecs import decode_mean_tree, encode_tree, tree_nbytes
 from atomo_tpu.models.transformer import TransformerLM
 from atomo_tpu.parallel.ring import ATTENTION_IMPLS
-from atomo_tpu.training.trainer import TrainState
+from atomo_tpu.training.trainer import TrainState, cast_params
 
 
 def make_lm_train_step(
@@ -42,6 +42,7 @@ def make_lm_train_step(
     dp_axis: str = "dp",
     sp_axis: str = "sp",
     attn_impl: str = "ring",
+    compute_dtype=None,
 ):
     """Jitted (state, key, tokens) -> (state, metrics) with tokens (B, S)
     sharded batch-over-dp and sequence-over-sp. ``lm_config`` are
@@ -71,6 +72,10 @@ def make_lm_train_step(
         )
 
         def loss_fn(params):
+            if compute_dtype is not None:
+                # bf16 MXU compute, f32 master state; token ids are integer
+                # inputs, so only the params need the cast
+                params = cast_params(params, compute_dtype)
             s_local = tokens.shape[1]
             logits = model.apply(
                 {"params": params},
@@ -78,6 +83,8 @@ def make_lm_train_step(
                 train=True,
                 pos_offset=jax.lax.axis_index(sp_axis) * s_local,
             )
+            if compute_dtype is not None:
+                logits = logits.astype(jnp.float32)
             # boundary target: first token of the next sequence shard
             nxt = jax.lax.ppermute(
                 tokens[:, :1], sp_axis,

@@ -50,16 +50,22 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
-def cast_compute_inputs(params, images, compute_dtype):
-    """Mixed-precision entry cast: params + images to ``compute_dtype``
-    (bf16 fwd/bwd on the MXU); the f32 master params stay outside. The
-    single contract shared by the single-host and SPMD loss functions."""
-    params = jax.tree_util.tree_map(
+def cast_params(params, compute_dtype):
+    """Mixed-precision entry cast of the parameter tree: floating leaves to
+    ``compute_dtype`` (bf16 fwd/bwd on the MXU); the f32 master params stay
+    outside. The single contract shared by every loss function — CV paths
+    also cast their input images (cast_compute_inputs), token-id paths use
+    this alone (integer inputs have nothing to cast)."""
+    return jax.tree_util.tree_map(
         lambda a: a.astype(compute_dtype)
         if jnp.issubdtype(a.dtype, jnp.floating) else a,
         params,
     )
-    return params, images.astype(compute_dtype)
+
+
+def cast_compute_inputs(params, images, compute_dtype):
+    """cast_params plus the image batch (see cast_params)."""
+    return cast_params(params, compute_dtype), images.astype(compute_dtype)
 
 
 def cast_compute_outputs(logits, new_stats):

@@ -414,8 +414,10 @@ def _numpy_svd_encode_decode(grad, rank: int):
     return (u[:, :k] * s[:k]) @ vt[:k, :]
 
 
-def measure_reference_cpu(batch: int, rank: int) -> float:
-    """Seconds/step of the reference-equivalent worker pipeline on CPU."""
+def measure_reference_cpu(batch: int, rank: int) -> tuple[float, str]:
+    """(seconds/step, protocol) of the reference-equivalent worker pipeline
+    on CPU; protocol is "2-step-mean" or, when a single step already runs
+    past 300s, "1-cold-step" (the warmup probe IS the measurement)."""
     import numpy as np
     import torch
     import torch.nn.functional as F
@@ -484,16 +486,18 @@ def _backend_or_die(timeout_s: int = BACKEND_TIMEOUT_S):
 
 def child_main(args) -> int:
     global STEPS, WARMUP
-    # fast mode (set by the parent's CPU-fallback path): a ResNet config at
-    # the full 30-step x best-of-3 protocol cannot finish on this box's one
-    # CPU core inside the child timeout — trade precision for existence
-    STEPS = int(os.environ.get("ATOMO_BENCH_STEPS", STEPS))
-    WARMUP = int(os.environ.get("ATOMO_BENCH_WARMUP", WARMUP))
     _honor_platform_env()
     _backend_or_die()
     cfg = dict(CONFIGS[args.config if args.config is not None else 2])
     fast = os.environ.get("ATOMO_BENCH_FAST") == "1"
     if fast:
+        # fast mode (set by the parent's CPU-fallback path): a ResNet config
+        # at the full 30-step x best-of-3 protocol cannot finish on this
+        # box's one CPU core inside the child timeout — trade precision for
+        # existence. The step/warmup overrides are honored ONLY here so a
+        # stray env var cannot silently change the normal TPU protocol.
+        STEPS = int(os.environ.get("ATOMO_BENCH_STEPS", STEPS))
+        WARMUP = int(os.environ.get("ATOMO_BENCH_WARMUP", WARMUP))
         # side-compares are TPU evidence; in CPU-fallback mode they only
         # multiply the time to a already-degraded number
         for k in ("dense_compare", "bf16_compare", "qsgd_compare", "ckpt"):
@@ -515,7 +519,11 @@ def child_main(args) -> int:
             base_s, proto = measure_reference_cpu(cfg["batch"], cfg.get("rank", 3))
             out["vs_baseline"] = round(base_s / (out["value"] / 1e3), 3)
             out["baseline"] = "torch-cpu-refpipe"
-            out["baseline_protocol"] = proto
+            # protocol travels WITH the ratio: "1-cold-step" means the
+            # numerator is a single unwarmed reference step (lazy torch
+            # init included) and the ratio is not comparable with
+            # "2-step-mean" rows
+            out["vs_baseline_protocol"] = proto
         except Exception:
             out["vs_baseline"] = None
             out["baseline"] = "none"

