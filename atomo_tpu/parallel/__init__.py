@@ -19,3 +19,8 @@ from atomo_tpu.parallel.replicated import (  # noqa: F401
     replicate_state,
     shard_batch,
 )
+from atomo_tpu.parallel.tp import (  # noqa: F401
+    create_tp_lm_state,
+    make_tp_lm_train_step,
+    shard_tp_tokens,
+)

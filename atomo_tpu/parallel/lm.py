@@ -28,9 +28,57 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from atomo_tpu.codecs import decode_mean_tree, encode_tree, tree_nbytes
-from atomo_tpu.models.transformer import TransformerLM
 from atomo_tpu.parallel.ring import ATTENTION_IMPLS
 from atomo_tpu.training.trainer import TrainState, cast_params
+
+
+def compressed_dp_update(
+    optimizer,
+    codec,
+    state: TrainState,
+    k_codec,
+    grads,
+    loss,
+    *,
+    dp_axis: str,
+    n_dp: int,
+):
+    """The shared per-shard tail of every compressed-DP train step: encode
+    this replica's (already-completed) gradient, all_gather payloads over
+    dp, decode+mean identically everywhere, apply the optimizer — or dense
+    pmean when ``codec`` is None. Returns (new_state, metrics). Used by the
+    dp x sp (make_lm_train_step) and dp x tp (parallel.tp) steps; gradients
+    may be model-sharded on other mesh axes — each shard exchanges its own
+    slice over dp, so compression composes with model sharding."""
+    dense_bytes = tree_nbytes(grads)
+    if codec is None:
+        mean_grads = jax.lax.pmean(grads, dp_axis)
+        msg_bytes = dense_bytes
+    else:
+        payloads, stats = encode_tree(codec, k_codec, grads)
+        msg_bytes = stats.payload_bytes
+        gathered = jax.lax.all_gather(payloads, dp_axis)
+        # fused decode_mean where the codec provides it (SVD: one
+        # (m, N·k)@(N·k, n) matmul), vmap-decode + mean otherwise
+        mean_grads = decode_mean_tree(codec, gathered, grads, n_dp)
+
+    updates, new_opt = optimizer.update(mean_grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    metrics = {
+        "loss": jax.lax.pmean(loss, dp_axis),
+        # float32, not int32: byte counts are static Python ints at trace
+        # time and a >=2 GiB per-shard gradient (the large-model regime tp
+        # exists for) would overflow int32 at jit time
+        "msg_bytes": jnp.asarray(msg_bytes, jnp.float32),
+        "dense_bytes": jnp.asarray(dense_bytes, jnp.float32),
+    }
+    new_state = TrainState(
+        step=state.step + 1,
+        params=new_params,
+        batch_stats=state.batch_stats,
+        opt_state=new_opt,
+    )
+    return new_state, metrics
 
 
 def make_lm_train_step(
@@ -55,6 +103,11 @@ def make_lm_train_step(
             f"unknown attn_impl {attn_impl!r}; expected one of "
             f"{sorted(ATTENTION_IMPLS)}"
         )
+    # lazy: models.transformer imports parallel.ring, so a module-level
+    # import here would cycle through parallel/__init__ (which exports tp,
+    # which imports this module)
+    from atomo_tpu.models.transformer import TransformerLM
+
     n_sp = mesh.shape[sp_axis]
     n_dp = mesh.shape[dp_axis]
 
@@ -99,36 +152,17 @@ def make_lm_train_step(
             return jax.lax.psum(jnp.sum(ce * valid), sp_axis) / total
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        # sp-psum completes THIS replica's gradient (intra-replica, dense)
-        grads = jax.lax.psum(grads, sp_axis)
+        # sp-PMEAN completes THIS replica's gradient (intra-replica, dense).
+        # Mean, not sum: under shard_map the transpose of the loss psum is
+        # itself a psum, so each shard's per-shard grads already carry an
+        # n_sp factor (the replicated seed is summed across shards); summing
+        # them again would scale the gradient by n_sp — a silent effective-LR
+        # inflation verified empirically (tests/test_ring.py oracle parity).
+        grads = jax.lax.pmean(grads, sp_axis)
 
-        dense_bytes = tree_nbytes(grads)
-        if codec is None:
-            mean_grads = jax.lax.pmean(grads, dp_axis)
-            msg_bytes = dense_bytes
-        else:
-            payloads, stats = encode_tree(codec, k_codec, grads)
-            msg_bytes = stats.payload_bytes
-            gathered = jax.lax.all_gather(payloads, dp_axis)
-            # fused decode_mean where the codec provides it (SVD: one
-            # (m, N·k)@(N·k, n) matmul), vmap-decode + mean otherwise
-            mean_grads = decode_mean_tree(codec, gathered, grads, n_dp)
-
-        updates, new_opt = optimizer.update(mean_grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        metrics = {
-            "loss": jax.lax.pmean(loss, dp_axis),
-            "msg_bytes": jnp.asarray(msg_bytes, jnp.int32),
-            "dense_bytes": jnp.asarray(dense_bytes, jnp.int32),
-        }
-        return (
-            TrainState(
-                step=state.step + 1,
-                params=new_params,
-                batch_stats=state.batch_stats,
-                opt_state=new_opt,
-            ),
-            metrics,
+        return compressed_dp_update(
+            optimizer, codec, state, k_codec, grads, loss,
+            dp_axis=dp_axis, n_dp=n_dp,
         )
 
     sharded = jax.shard_map(

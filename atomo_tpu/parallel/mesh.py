@@ -7,8 +7,8 @@ same compiled program; the 'parameter server' is the replicated update.
 Axis taxonomy (forward-looking — the reference is DP-only, SURVEY.md §2.1):
 
   dp  data parallelism (the reference's workers)           — first-class
-  sp  sequence/context parallelism (ring attention)        — atomo_tpu.parallel.ring
-  tp  tensor parallelism                                   — reserved
+  sp  sequence/context parallelism (ring/Ulysses)          — atomo_tpu.parallel.ring
+  tp  tensor parallelism (Megatron-style sharded blocks)   — atomo_tpu.parallel.tp
 """
 
 from __future__ import annotations

@@ -178,8 +178,10 @@ def make_distributed_train_step(
             "loss": jax.lax.pmean(loss, axis),
             "prec1": jax.lax.pmean(prec1, axis),
             "prec5": jax.lax.pmean(prec5, axis),
-            "msg_bytes": jnp.asarray(msg_bytes, jnp.int32),
-            "dense_bytes": jnp.asarray(dense_bytes, jnp.int32),
+            # float32: static trace-time ints; int32 would overflow at jit
+            # time for >=2 GiB per-shard gradients
+            "msg_bytes": jnp.asarray(msg_bytes, jnp.float32),
+            "dense_bytes": jnp.asarray(dense_bytes, jnp.float32),
         }
         new_state = TrainState(
             step=state.step + 1,

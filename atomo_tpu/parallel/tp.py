@@ -1,0 +1,341 @@
+"""Tensor parallelism: Megatron-style sharded transformer + compressed DP.
+
+The reference is DP-only (SURVEY.md §2.1 — "full model per process",
+src/distributed_worker.py:139-164); a model too large for one worker simply
+cannot run there. This module extends the framework with the second model-
+sharding axis: a 2-D ('dp', 'tp') mesh where
+
+  tp — attention heads, MLP hidden width, and the vocab projection are
+       sharded over the axis; every block costs exactly two ``psum``s in
+       forward (after the attention output projection and after the MLP
+       down-projection), the classic Megatron cut riding ICI.
+  dp — batch replicas exchanging ATOMO-compressed gradients, identical to
+       parallel.replicated: each (dp, tp) shard encodes ITS slice of the
+       gradient, all_gathers payloads over dp only, and decode+means — so
+       gradient compression composes with model sharding instead of being
+       an alternative to it.
+
+Design choices (TPU-first):
+  * The parameter tree is the stock ``TransformerLM`` tree re-laid-out so
+    every sharded matmul is a clean slice: the packed qkv kernel
+    (W, 3·H·D) becomes (W, 3, H, D) sharded on H, the output projection
+    (H·D, W) becomes (H, D, W) sharded on H. ``lm_params_to_tp`` /
+    ``tp_params_to_lm`` are pure reshapes, so checkpoints interchange with
+    the single-device model.
+  * The LM head is vocab-sharded and the full (B, S, V) logits are NEVER
+    materialized: cross-entropy runs on local vocab slices via the
+    psum-logsumexp identity (pmax for the max, psum for the partition
+    function and the target logit).
+  * Gradient completion: under shard_map the transpose of psum is psum, so
+    per-shard grads come out uniformly n_tp-scaled — sharded leaves are
+    divided by n_tp, tp-replicated leaves (embeddings, LayerNorm scales)
+    take a pmean over tp (see the in-code derivation in
+    make_tp_lm_train_step and the matching pmean fix in parallel.lm).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from atomo_tpu.parallel.lm import compressed_dp_update
+from atomo_tpu.parallel.ring import full_attention
+from atomo_tpu.training.trainer import TrainState, cast_params
+
+# ---------------------------------------------------------------------------
+# parameter layout: stock TransformerLM tree <-> TP tree (pure reshapes)
+# ---------------------------------------------------------------------------
+
+
+def _blocks(params) -> list[str]:
+    return sorted(
+        (k for k in params if k.startswith("block")),
+        key=lambda k: int(k.removeprefix("block")),
+    )
+
+
+def lm_params_to_tp(params: Any, num_heads: int) -> Any:
+    """Re-lay-out a TransformerLM param tree for head-sliced sharding.
+
+    qkv kernel (W, 3·H·D) -> (W, 3, H, D); proj kernel (H·D, W) ->
+    (H, D, W). Everything else unchanged. Inverse: :func:`tp_params_to_lm`.
+    """
+    out = jax.tree_util.tree_map(lambda x: x, params)  # shallow-ish copy
+    for blk in _blocks(out):
+        attn = out[blk]["MultiHeadAttention_0"]
+        qkv = attn["qkv"]["kernel"]
+        w = qkv.shape[0]
+        d = qkv.shape[1] // (3 * num_heads)
+        attn["qkv"]["kernel"] = qkv.reshape(w, 3, num_heads, d)
+        proj = attn["proj"]["kernel"]
+        attn["proj"]["kernel"] = proj.reshape(num_heads, d, proj.shape[1])
+    return out
+
+
+def tp_params_to_lm(params: Any, num_heads: int) -> Any:
+    out = jax.tree_util.tree_map(lambda x: x, params)
+    for blk in _blocks(out):
+        attn = out[blk]["MultiHeadAttention_0"]
+        qkv = attn["qkv"]["kernel"]
+        w, _, h, d = qkv.shape
+        attn["qkv"]["kernel"] = qkv.reshape(w, 3 * h * d)
+        proj = attn["proj"]["kernel"]
+        attn["proj"]["kernel"] = proj.reshape(h * d, proj.shape[2])
+    return out
+
+
+def tp_param_specs(tp_params: Any, tp_axis: str = "tp") -> Any:
+    """PartitionSpec tree for a TP-laid-out param tree.
+
+    Sharded: qkv on heads, proj on heads, MLP up on hidden, MLP down on
+    hidden, head on vocab. Replicated: embeddings, LayerNorm scales.
+    """
+
+    def spec(path, leaf) -> P:
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if "MultiHeadAttention_0" in names:
+            if "qkv" in names:
+                return P(None, None, tp_axis, None)
+            if "proj" in names:
+                return P(tp_axis, None, None)
+        if "up" in names:
+            return P(None, tp_axis)
+        if "down" in names:
+            return P(tp_axis, None)
+        if "head" in names:
+            return P(None, tp_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, tp_params)
+
+
+def _params_like_subtrees_specs(opt_state: Any, params: Any, param_specs: Any) -> Any:
+    """Specs for an optax state: subtrees structurally identical to the param
+    tree (momentum / mu / nu mirrors) inherit the param specs; every other
+    leaf (step counts, scalars) is replicated."""
+    pdef = jax.tree_util.tree_structure(params)
+
+    def params_like(sub) -> bool:
+        try:
+            return jax.tree_util.tree_structure(sub) == pdef
+        except Exception:
+            return False
+
+    return jax.tree_util.tree_map(
+        lambda sub: param_specs if params_like(sub) else P(),
+        opt_state,
+        is_leaf=lambda sub: params_like(sub)
+        or not isinstance(sub, (tuple, list, dict)),
+    )
+
+
+def make_tp_state_specs(state: TrainState, param_specs: Any) -> TrainState:
+    """A TrainState of PartitionSpecs matching ``state`` leaf-for-leaf."""
+    return TrainState(
+        step=P(),
+        params=param_specs,
+        batch_stats=jax.tree_util.tree_map(lambda _: P(), state.batch_stats),
+        opt_state=_params_like_subtrees_specs(
+            state.opt_state, state.params, param_specs
+        ),
+    )
+
+
+def shard_tp_state(mesh: Mesh, state: TrainState, state_specs: TrainState) -> TrainState:
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), state_specs
+    )
+    return jax.device_put(state, shardings)
+
+
+def create_tp_lm_state(
+    mesh: Mesh, lm_config: dict, optimizer, rng, *, tp_axis: str = "tp"
+) -> tuple[TrainState, TrainState]:
+    """Init a TransformerLM, re-lay-out for TP, shard over ``mesh``.
+
+    Returns (state, state_specs); pass both to make_tp_lm_train_step.
+    """
+    n_tp = mesh.shape[tp_axis]
+    if lm_config["num_heads"] % n_tp:
+        raise ValueError(
+            f"num_heads {lm_config['num_heads']} not divisible by tp={n_tp}"
+        )
+    if lm_config["vocab_size"] % n_tp:
+        raise ValueError(
+            f"vocab_size {lm_config['vocab_size']} not divisible by tp={n_tp}"
+        )
+    if 4 * lm_config["width"] % n_tp:  # Block hardcodes mlp_ratio=4
+        raise ValueError("MLP hidden width not divisible by tp")
+    # lazy: models.transformer imports parallel.ring, so a module-level
+    # import here would cycle through parallel/__init__
+    from atomo_tpu.models.transformer import TransformerLM
+
+    lm = TransformerLM(**lm_config)
+    sample = jnp.zeros((1, min(8, lm_config["max_len"])), jnp.int32)
+    params = lm.init(rng, sample)["params"]
+    tp_params = lm_params_to_tp(params, lm_config["num_heads"])
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=tp_params,
+        batch_stats={},
+        opt_state=optimizer.init(tp_params),
+    )
+    specs = make_tp_state_specs(state, tp_param_specs(tp_params, tp_axis))
+    return shard_tp_state(mesh, state, specs), specs
+
+
+# ---------------------------------------------------------------------------
+# TP forward: exact math parity with TransformerLM.apply on the re-laid tree
+# ---------------------------------------------------------------------------
+
+
+def _layernorm(x, scale, eps: float = 1e-6):
+    """flax.linen.LayerNorm(use_bias=False) semantics: mean2 - mean^2 var."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    mean2 = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * scale
+
+
+def tp_lm_forward(
+    params: Any, tokens: jax.Array, *, pos_offset=0, tp_axis=None
+) -> jax.Array:
+    """Per-shard TP forward on a TP-laid (and possibly head/hidden/vocab-
+    SLICED) param tree. With ``tp_axis`` set (inside shard_map over sliced
+    params) each block does the two Megatron psums — after the attention
+    output projection and after the MLP down-projection — so the residual
+    stream is the full sum over heads/hidden on every shard. With
+    ``tp_axis=None`` and unsliced params this equals TransformerLM.apply on
+    the equivalent stock tree (tested). Returns LOCAL vocab-slice logits
+    (B, S, V_local)."""
+    b, s = tokens.shape
+
+    def _g(t):  # parallel-region exit: all-reduce the partial sums
+        return t if tp_axis is None else jax.lax.psum(t, tp_axis)
+
+    x = params["tok_emb"]["embedding"][tokens]
+    x = x + params["pos_emb"]["embedding"][pos_offset + jnp.arange(s)][None]
+    for blk in _blocks(params):
+        p = params[blk]
+        y = _layernorm(x, p["ln1"]["scale"])
+        qkv_k = p["MultiHeadAttention_0"]["qkv"]["kernel"]  # (W, 3, Hl, D)
+        qkv = jnp.einsum("bsw,wthd->tbhsd", y, qkv_k)
+        out = full_attention(qkv[0], qkv[1], qkv[2], causal=True)
+        proj_k = p["MultiHeadAttention_0"]["proj"]["kernel"]  # (Hl, D, W)
+        x = x + _g(jnp.einsum("bhsd,hdw->bsw", out, proj_k))
+        y = _layernorm(x, p["ln2"]["scale"])
+        h = jax.nn.gelu(jnp.einsum("bsw,wf->bsf", y, p["up"]["kernel"]))
+        x = x + _g(jnp.einsum("bsf,fw->bsw", h, p["down"]["kernel"]))
+    x = _layernorm(x, params["ln_f"]["scale"])
+    return jnp.einsum("bsw,wv->bsv", x, params["head"]["kernel"])
+
+
+def tp_sharded_ce(
+    logits_local: jax.Array, targets: jax.Array, tp_axis: str, v_local: int
+) -> jax.Array:
+    """Mean next-token CE over a vocab-sharded logits slice (B, S, V_local)
+    without materializing full logits: psum-logsumexp over the tp axis.
+
+    ``targets`` are global token ids aligned with logits positions."""
+    my = jax.lax.axis_index(tp_axis)
+    m_local = jnp.max(logits_local, axis=-1)
+    # stop_gradient BEFORE pmax: the max shift is AD-invariant and pmax has
+    # no differentiation rule, so keep it out of the tangent graph entirely
+    m = jax.lax.pmax(jax.lax.stop_gradient(m_local), tp_axis)
+    z = jax.lax.psum(
+        jnp.sum(jnp.exp(logits_local - m[..., None]), axis=-1), tp_axis
+    )
+    lse = jnp.log(z) + m
+    t_local = targets - my * v_local
+    in_range = (t_local >= 0) & (t_local < v_local)
+    t_clip = jnp.clip(t_local, 0, v_local - 1)
+    picked = jnp.take_along_axis(logits_local, t_clip[..., None], axis=-1)[..., 0]
+    correct = jax.lax.psum(jnp.where(in_range, picked, 0.0), tp_axis)
+    return jnp.mean(lse - correct)
+
+
+# ---------------------------------------------------------------------------
+# the dp x tp train step
+# ---------------------------------------------------------------------------
+
+
+def make_tp_lm_train_step(
+    lm_config: dict,
+    optimizer,
+    mesh: Mesh,
+    state_specs: TrainState,
+    codec=None,
+    *,
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+    compute_dtype=None,
+):
+    """Jitted (state, key, tokens) -> (state, metrics): Megatron-TP forward/
+    backward with ATOMO-compressed gradient exchange over dp.
+
+    tokens are (B, S) sharded batch-over-dp, replicated over tp. ``state``
+    and ``state_specs`` come from :func:`create_tp_lm_state`.
+    """
+    n_dp = mesh.shape[dp_axis]
+    n_tp = mesh.shape[tp_axis]
+    v_local = lm_config["vocab_size"] // n_tp
+    param_specs = state_specs.params
+
+    def _is_tp_sharded(spec: P) -> bool:
+        return any(ax == tp_axis for ax in spec if ax is not None)
+
+    def spmd_step(state: TrainState, key, tokens):
+        my_dp = jax.lax.axis_index(dp_axis)
+        k_codec = jax.random.fold_in(jax.random.fold_in(key, state.step), my_dp)
+
+        def loss_fn(params):
+            if compute_dtype is not None:
+                params = cast_params(params, compute_dtype)
+            logits_local = tp_lm_forward(params, tokens, tp_axis=tp_axis)
+            if compute_dtype is not None:
+                logits_local = logits_local.astype(jnp.float32)
+            return tp_sharded_ce(
+                logits_local[:, :-1], tokens[:, 1:], tp_axis, v_local
+            )
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        # Per-shard grad completion. Under shard_map the transpose of psum is
+        # psum, and every loss->leaf path crosses exactly one parallel-region
+        # psum (block exits, or the loss logsumexp psums for the head), so
+        # per-shard cotangents of replicated activations SUM over tp to
+        # n_tp x the true cotangent (verified empirically; see the pmean fix
+        # in parallel.lm for the sp-axis instance). Hence: sharded leaves are
+        # n_tp x their exact slice grad -> divide by n_tp; tp-replicated
+        # leaves (embeddings, LN scales) hold shard-partial contributions
+        # summing to n_tp x truth -> pmean (psum then / n_tp).
+        grads = jax.tree_util.tree_map(
+            lambda g, sp: (
+                g if _is_tp_sharded(sp) else jax.lax.psum(g, tp_axis)
+            )
+            / n_tp,
+            grads,
+            param_specs,
+        )
+
+        return compressed_dp_update(
+            optimizer, codec, state, k_codec, grads, loss,
+            dp_axis=dp_axis, n_dp=n_dp,
+        )
+
+    sharded = jax.shard_map(
+        spmd_step,
+        mesh=mesh,
+        in_specs=(state_specs, P(), P(dp_axis, None)),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def shard_tp_tokens(mesh: Mesh, tokens, dp_axis: str = "dp"):
+    return jax.device_put(
+        jnp.asarray(tokens), NamedSharding(mesh, P(dp_axis, None))
+    )

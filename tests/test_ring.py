@@ -219,3 +219,39 @@ def test_lm_bf16_step_runs_and_keeps_f32_state():
     assert np.isfinite(float(m["loss"]))
     for leaf in jax.tree_util.tree_leaves(state.params):
         assert leaf.dtype == jnp.float32
+
+
+def test_lm_sharded_grads_match_unsharded_oracle():
+    """Regression: one dense dp=1 x sp=4 update step lands on the same params
+    as single-device AD + SGD. Catches the sp-axis gradient inflation class
+    of bug (grads psum'd over sp where the psum-transposes-to-psum rule
+    demands a pmean: the sharded step would silently train with an
+    effective LR of n_sp x the configured one)."""
+    import optax
+
+    cfg = _lm_cfg(max_len=16)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 16), 0, 32)
+    model = TransformerLM(**cfg)
+    opt = optax.sgd(0.1)
+    params0 = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    def loss_fn(p):
+        return lm_loss(model.apply({"params": p}, tokens), tokens)
+
+    grads = jax.grad(loss_fn)(params0)
+    want = jax.device_get(
+        optax.apply_updates(params0, opt.update(grads, opt.init(params0), params0)[0])
+    )
+
+    mesh = make_mesh(4, axes=(("dp", 1), ("sp", 4)))
+    state = create_state(model, opt, jax.random.PRNGKey(0), tokens)
+    step = make_lm_train_step(cfg, opt, mesh, codec=None)
+    state2, _ = step(state, jax.random.PRNGKey(1), shard_tokens(mesh, tokens))
+    got = jax.device_get(state2.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-4
+        ),
+        got,
+        want,
+    )
