@@ -24,3 +24,8 @@ from atomo_tpu.parallel.tp import (  # noqa: F401
     make_tp_lm_train_step,
     shard_tp_tokens,
 )
+from atomo_tpu.parallel.moe import (  # noqa: F401
+    create_moe_lm_state,
+    make_moe_lm_train_step,
+    shard_moe_tokens,
+)

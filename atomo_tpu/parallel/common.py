@@ -1,0 +1,61 @@
+"""Shared helpers for the model-sharded train steps (tp, moe).
+
+Kept free of model/codec imports so any parallel module can use them
+without import cycles.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from atomo_tpu.training.trainer import TrainState
+
+
+def layernorm(x, scale, eps: float = 1e-6):
+    """flax.linen.LayerNorm(use_bias=False) semantics: mean2 - mean^2 var."""
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    mean2 = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * scale
+
+
+def opt_state_specs_like(opt_state: Any, params: Any, param_specs: Any) -> Any:
+    """Specs for an optax state: subtrees structurally identical to the param
+    tree (momentum / mu / nu mirrors) inherit the param specs; every other
+    leaf (step counts, scalars) is replicated."""
+    pdef = jax.tree_util.tree_structure(params)
+
+    def params_like(sub) -> bool:
+        try:
+            return jax.tree_util.tree_structure(sub) == pdef
+        except Exception:
+            return False
+
+    return jax.tree_util.tree_map(
+        lambda sub: param_specs if params_like(sub) else P(),
+        opt_state,
+        is_leaf=lambda sub: params_like(sub)
+        or not isinstance(sub, (tuple, list, dict)),
+    )
+
+
+def make_state_specs(state: TrainState, param_specs: Any) -> TrainState:
+    """A TrainState of PartitionSpecs matching ``state`` leaf-for-leaf."""
+    return TrainState(
+        step=P(),
+        params=param_specs,
+        batch_stats=jax.tree_util.tree_map(lambda _: P(), state.batch_stats),
+        opt_state=opt_state_specs_like(state.opt_state, state.params, param_specs),
+    )
+
+
+def shard_state(mesh: Mesh, state: TrainState, state_specs: TrainState) -> TrainState:
+    """device_put every leaf of ``state`` with its NamedSharding."""
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), state_specs
+    )
+    return jax.device_put(state, shardings)

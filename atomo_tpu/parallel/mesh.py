@@ -9,6 +9,7 @@ Axis taxonomy (forward-looking — the reference is DP-only, SURVEY.md §2.1):
   dp  data parallelism (the reference's workers)           — first-class
   sp  sequence/context parallelism (ring/Ulysses)          — atomo_tpu.parallel.ring
   tp  tensor parallelism (Megatron-style sharded blocks)   — atomo_tpu.parallel.tp
+  ep  expert parallelism (switch-MoE, a2a dispatch)        — atomo_tpu.parallel.moe
 """
 
 from __future__ import annotations

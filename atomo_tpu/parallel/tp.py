@@ -41,6 +41,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from atomo_tpu.parallel.common import (
+    layernorm as _layernorm,
+    make_state_specs,
+    shard_state,
+)
 from atomo_tpu.parallel.lm import compressed_dp_update
 from atomo_tpu.parallel.ring import full_attention
 from atomo_tpu.training.trainer import TrainState, cast_params
@@ -112,43 +117,10 @@ def tp_param_specs(tp_params: Any, tp_axis: str = "tp") -> Any:
     return jax.tree_util.tree_map_with_path(spec, tp_params)
 
 
-def _params_like_subtrees_specs(opt_state: Any, params: Any, param_specs: Any) -> Any:
-    """Specs for an optax state: subtrees structurally identical to the param
-    tree (momentum / mu / nu mirrors) inherit the param specs; every other
-    leaf (step counts, scalars) is replicated."""
-    pdef = jax.tree_util.tree_structure(params)
-
-    def params_like(sub) -> bool:
-        try:
-            return jax.tree_util.tree_structure(sub) == pdef
-        except Exception:
-            return False
-
-    return jax.tree_util.tree_map(
-        lambda sub: param_specs if params_like(sub) else P(),
-        opt_state,
-        is_leaf=lambda sub: params_like(sub)
-        or not isinstance(sub, (tuple, list, dict)),
-    )
-
-
-def make_tp_state_specs(state: TrainState, param_specs: Any) -> TrainState:
-    """A TrainState of PartitionSpecs matching ``state`` leaf-for-leaf."""
-    return TrainState(
-        step=P(),
-        params=param_specs,
-        batch_stats=jax.tree_util.tree_map(lambda _: P(), state.batch_stats),
-        opt_state=_params_like_subtrees_specs(
-            state.opt_state, state.params, param_specs
-        ),
-    )
-
-
-def shard_tp_state(mesh: Mesh, state: TrainState, state_specs: TrainState) -> TrainState:
-    shardings = jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), state_specs
-    )
-    return jax.device_put(state, shardings)
+# state-spec construction and sharding live in parallel.common (shared with
+# parallel.moe); these aliases are tp's public names for them
+make_tp_state_specs = make_state_specs
+shard_tp_state = shard_state
 
 
 def create_tp_lm_state(
@@ -190,14 +162,6 @@ def create_tp_lm_state(
 # ---------------------------------------------------------------------------
 # TP forward: exact math parity with TransformerLM.apply on the re-laid tree
 # ---------------------------------------------------------------------------
-
-
-def _layernorm(x, scale, eps: float = 1e-6):
-    """flax.linen.LayerNorm(use_bias=False) semantics: mean2 - mean^2 var."""
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    mean2 = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
-    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
-    return (x - mean) * jax.lax.rsqrt(var + eps) * scale
 
 
 def tp_lm_forward(
