@@ -29,3 +29,8 @@ from atomo_tpu.parallel.moe import (  # noqa: F401
     make_moe_lm_train_step,
     shard_moe_tokens,
 )
+from atomo_tpu.parallel.pp import (  # noqa: F401
+    create_pp_lm_state,
+    make_pp_lm_train_step,
+    shard_pp_tokens,
+)

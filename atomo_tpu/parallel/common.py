@@ -6,6 +6,7 @@ without import cycles.
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import jax
@@ -15,12 +16,37 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from atomo_tpu.training.trainer import TrainState
 
 
+def dense_init(key, shape, in_axis: int = 0):
+    """Plain normal scaled by 1/sqrt(fan_in) of the contracted axis
+    (lecun-style variance, untruncated — NOT bit-identical to flax's
+    truncated lecun_normal)."""
+    fan_in = shape[in_axis]
+    return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
+
+
 def layernorm(x, scale, eps: float = 1e-6):
     """flax.linen.LayerNorm(use_bias=False) semantics: mean2 - mean^2 var."""
     mean = jnp.mean(x, axis=-1, keepdims=True)
     mean2 = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
     return (x - mean) * jax.lax.rsqrt(var + eps) * scale
+
+
+def attention_sublayer(bp, x, num_heads: int):
+    """Pre-LN causal attention sublayer on stock-layout block params
+    (keys ln1/qkv/proj, qkv kernel (W, 3·H·D)): returns x + proj(attn).
+    Shared by the moe and pp forwards; tp has its own head-sliced variant."""
+    from atomo_tpu.parallel.ring import full_attention
+
+    b, s, w = x.shape
+    h = num_heads
+    d = w // h
+    y = layernorm(x, bp["ln1"]["scale"])
+    qkv = (y @ bp["qkv"]["kernel"]).reshape(b, s, 3, h, d)
+    q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
+    att = full_attention(q, k, v, causal=True)
+    att = att.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+    return x + att @ bp["proj"]["kernel"]
 
 
 def opt_state_specs_like(opt_state: Any, params: Any, param_specs: Any) -> Any:

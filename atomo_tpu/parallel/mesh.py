@@ -10,6 +10,7 @@ Axis taxonomy (forward-looking — the reference is DP-only, SURVEY.md §2.1):
   sp  sequence/context parallelism (ring/Ulysses)          — atomo_tpu.parallel.ring
   tp  tensor parallelism (Megatron-style sharded blocks)   — atomo_tpu.parallel.tp
   ep  expert parallelism (switch-MoE, a2a dispatch)        — atomo_tpu.parallel.moe
+  pp  pipeline parallelism (GPipe microbatch schedule)     — atomo_tpu.parallel.pp
 """
 
 from __future__ import annotations

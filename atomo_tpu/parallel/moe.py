@@ -39,25 +39,18 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from atomo_tpu.parallel.common import (
+    attention_sublayer,
+    dense_init as _dense_init,
     layernorm,
     make_state_specs,
     shard_state,
 )
 from atomo_tpu.parallel.lm import compressed_dp_update
-from atomo_tpu.parallel.ring import full_attention
 from atomo_tpu.training.trainer import TrainState
 
 # ---------------------------------------------------------------------------
 # init
 # ---------------------------------------------------------------------------
-
-
-def _dense_init(key, shape, in_axis: int = 0):
-    """Plain normal scaled by 1/sqrt(fan_in) of the contracted axis
-    (lecun-style variance, untruncated — NOT bit-identical to flax's
-    truncated lecun_normal)."""
-    fan_in = shape[in_axis]
-    return jax.random.normal(key, shape, jnp.float32) / math.sqrt(fan_in)
 
 
 def init_moe_lm_params(key, cfg: dict) -> Any:
@@ -205,19 +198,12 @@ def moe_lm_forward(
     """(B, S) int tokens -> (logits (B, S, V), mean aux loss). Attention is
     local (full sequences per chip); only the MoE MLP crosses chips."""
     b, s = tokens.shape
-    h = cfg["num_heads"]
-    d = cfg["width"] // h
     x = params["tok_emb"]["embedding"][tokens]
     x = x + params["pos_emb"]["embedding"][jnp.arange(s)][None]
     aux_total = 0.0
     for i in range(cfg["depth"]):
         p = params[f"block{i}"]
-        y = layernorm(x, p["ln1"]["scale"])
-        qkv = (y @ p["qkv"]["kernel"]).reshape(b, s, 3, h, d)
-        q, k, v = (qkv[:, :, j].transpose(0, 2, 1, 3) for j in range(3))
-        att = full_attention(q, k, v, causal=True)
-        att = att.transpose(0, 2, 1, 3).reshape(b, s, h * d)
-        x = x + att @ p["proj"]["kernel"]
+        x = attention_sublayer(p, x, cfg["num_heads"])
         y = layernorm(x, p["ln2"]["scale"])
         moe_out, aux = moe_mlp(
             p, y.reshape(b * s, -1), capacity=capacity, ep_axis=ep_axis

@@ -1,0 +1,264 @@
+"""Pipeline parallelism: GPipe-style staged transformer over a 'pp' axis.
+
+The reference's nearest relative is the *split-backward* models — per-layer
+manual backward interleaved with per-layer sends INSIDE one process
+(SURVEY.md §2.1 "Pipeline parallelism: No"; resnet_split.py:259-361) — i.e.
+comm/compute overlap, never multi-device pipelining. This module is the real
+thing, TPU-native: transformer blocks are stacked on a leading depth axis
+and sharded over 'pp' (depth/n blocks per chip = one stage); microbatches
+march through stages with one ``ppermute`` hop per tick on the ICI torus,
+and the classic GPipe schedule (M + n_pp - 1 ticks for M microbatches) runs
+as a single ``lax.scan`` — static shapes, no Python-level pipeline engine.
+
+SPMD uniformity: every chip executes the same tick program; stage identity
+enters only through ``where(stage == 0, embedded_microbatch, received)`` at
+the pipe head and a masked loss at the pipe tail. The backward schedule
+falls out of AD: the transpose of ppermute is the inverse rotation, so
+cotangents flow tail -> head with the same overlap, no hand-scheduling.
+
+Gradient discipline (cf. parallel.tp/moe derivations): the loss path
+crosses NO psum — only ppermute, whose transpose is exact. Stage-sharded
+block grads arrive exact via the rotation transpose chain; pp-replicated
+leaves (embeddings on the head stage, final-LN/head on the tail stage) hold
+nonzero grads only on the stage that used them, so one psum over pp
+completes them with no n-scaling. Compressed gradient exchange rides dp via
+parallel.lm.compressed_dp_update, composing with the stage sharding.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from atomo_tpu.parallel.common import (
+    attention_sublayer,
+    dense_init as _dense_init,
+    layernorm,
+    make_state_specs,
+    shard_state,
+)
+from atomo_tpu.parallel.lm import compressed_dp_update
+from atomo_tpu.training.trainer import TrainState
+
+# ---------------------------------------------------------------------------
+# params: blocks stacked on a leading depth axis (shardable over pp)
+# ---------------------------------------------------------------------------
+
+
+def init_pp_lm_params(key, cfg: dict) -> Any:
+    """Param tree with all transformer blocks STACKED on a leading depth
+    axis. ``cfg``: vocab_size, max_len, width, depth, num_heads,
+    mlp_ratio (default 4)."""
+    w = cfg["width"]
+    dep = cfg["depth"]
+    f = cfg.get("mlp_ratio", 4) * w
+    h, d = cfg["num_heads"], w // cfg["num_heads"]
+    ks = jax.random.split(key, 7)
+
+    def stacked(k, shape, in_axis):
+        return jax.vmap(
+            lambda kk: _dense_init(kk, shape, in_axis=in_axis)
+        )(jax.random.split(k, dep))
+
+    return {
+        "tok_emb": {"embedding": jax.random.normal(ks[0], (cfg["vocab_size"], w)) / math.sqrt(w)},
+        "pos_emb": {"embedding": jax.random.normal(ks[1], (cfg["max_len"], w)) / math.sqrt(w)},
+        "blocks": {
+            "ln1": {"scale": jnp.ones((dep, w), jnp.float32)},
+            "qkv": {"kernel": stacked(ks[2], (w, 3 * h * d), 0)},
+            "proj": {"kernel": stacked(ks[3], (h * d, w), 0)},
+            "ln2": {"scale": jnp.ones((dep, w), jnp.float32)},
+            "up": {"kernel": stacked(ks[4], (w, f), 0)},
+            "down": {"kernel": stacked(ks[5], (f, w), 0)},
+        },
+        "ln_f": {"scale": jnp.ones((w,), jnp.float32)},
+        "head": {"kernel": _dense_init(ks[6], (w, cfg["vocab_size"]))},
+    }
+
+
+def pp_param_specs(params: Any, pp_axis: str = "pp") -> Any:
+    """Stacked block leaves sharded on their leading depth axis; embeddings,
+    final LN and head replicated (used only on the head/tail stages but
+    co-located everywhere for SPMD uniformity)."""
+
+    def spec(path, leaf) -> P:
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if "blocks" in names:
+            return P(pp_axis, *([None] * (leaf.ndim - 1)))
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+make_pp_state_specs = make_state_specs
+shard_pp_state = shard_state
+
+
+def create_pp_lm_state(
+    mesh: Mesh, cfg: dict, optimizer, rng, *, pp_axis: str = "pp"
+) -> tuple[TrainState, TrainState]:
+    n_pp = mesh.shape[pp_axis]
+    if cfg["depth"] % n_pp:
+        raise ValueError(f"depth {cfg['depth']} not divisible by pp={n_pp}")
+    params = init_pp_lm_params(rng, cfg)
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=optimizer.init(params),
+    )
+    specs = make_pp_state_specs(state, pp_param_specs(params, pp_axis))
+    return shard_pp_state(mesh, state, specs), specs
+
+
+# ---------------------------------------------------------------------------
+# block stack + single-device reference
+# ---------------------------------------------------------------------------
+
+
+def _one_block(bp: Any, x: jax.Array, num_heads: int) -> jax.Array:
+    """One pre-LN block on UNSTACKED block params (leaves without the depth
+    axis). Same math as parallel.tp's blocks / models.transformer.Block."""
+    x = attention_sublayer(bp, x, num_heads)
+    y = layernorm(x, bp["ln2"]["scale"])
+    return x + jax.nn.gelu(y @ bp["up"]["kernel"]) @ bp["down"]["kernel"]
+
+
+def _block_stack(stacked: Any, x: jax.Array, num_heads: int) -> jax.Array:
+    """Apply a (local) stack of blocks via lax.scan over the depth axis."""
+
+    def body(xc, bp):
+        return _one_block(bp, xc, num_heads), None
+
+    out, _ = jax.lax.scan(body, x, stacked)
+    return out
+
+
+def _embed(params: Any, tokens: jax.Array) -> jax.Array:
+    s = tokens.shape[1]
+    return (
+        params["tok_emb"]["embedding"][tokens]
+        + params["pos_emb"]["embedding"][jnp.arange(s)][None]
+    )
+
+
+def _head(params: Any, x: jax.Array) -> jax.Array:
+    return layernorm(x, params["ln_f"]["scale"]) @ params["head"]["kernel"]
+
+
+def pp_lm_forward_reference(params: Any, tokens: jax.Array, cfg: dict) -> jax.Array:
+    """Single-device oracle: the exact function the pipeline distributes."""
+    x = _embed(params, tokens)
+    x = _block_stack(params["blocks"], x, cfg["num_heads"])
+    return _head(params, x)
+
+
+# ---------------------------------------------------------------------------
+# the dp x pp train step
+# ---------------------------------------------------------------------------
+
+
+def make_pp_lm_train_step(
+    cfg: dict,
+    optimizer,
+    mesh: Mesh,
+    state_specs: TrainState,
+    codec=None,
+    *,
+    dp_axis: str = "dp",
+    pp_axis: str = "pp",
+    num_microbatches: int = 2,
+):
+    """Jitted (state, key, tokens) -> (state, metrics): GPipe pipeline over
+    pp with ATOMO-compressed gradient exchange over dp.
+
+    tokens (B, S) are sharded over dp only (each dp replica's full
+    minibatch is cut into ``num_microbatches`` microbatches that flow
+    through the pp stages)."""
+    n_dp = mesh.shape[dp_axis]
+    n_pp = mesh.shape[pp_axis]
+    m = num_microbatches
+    param_specs = state_specs.params
+
+    def _is_pp_sharded(spec: P) -> bool:
+        return any(ax == pp_axis for ax in spec if ax is not None)
+
+    def spmd_step(state: TrainState, key, tokens):
+        b_local, s = tokens.shape
+        if b_local % m:
+            raise ValueError(
+                f"per-replica batch {b_local} not divisible by "
+                f"num_microbatches={m}"
+            )
+        mb = b_local // m
+        stage = jax.lax.axis_index(pp_axis)
+        is_head = stage == 0
+        is_tail = stage == n_pp - 1
+        fwd_perm = [(i, (i + 1) % n_pp) for i in range(n_pp)]
+        my_dp = jax.lax.axis_index(dp_axis)
+        k_codec = jax.random.fold_in(jax.random.fold_in(key, state.step), my_dp)
+
+        def loss_fn(params):
+            local_blocks = params["blocks"]  # (depth/n_pp, ...) slices
+
+            def tick(carry, t):
+                acts = carry
+                # pipe head: microbatch t enters (other stages use received)
+                in_idx = jnp.clip(t, 0, m - 1) * mb
+                toks_in = jax.lax.dynamic_slice_in_dim(tokens, in_idx, mb, 0)
+                x_in = jnp.where(is_head, _embed(params, toks_in), acts)
+                y = _block_stack(local_blocks, x_in, cfg["num_heads"])
+                return jax.lax.ppermute(y, pp_axis, fwd_perm), y
+
+            acts0 = jnp.zeros((mb, s, cfg["width"]), jnp.float32)
+            _, ys = jax.lax.scan(
+                tick, acts0, jnp.arange(m + n_pp - 1)
+            )
+            # head + CE ONCE, post-scan, on the m live tail ticks only
+            # (microbatch i exits the tail at tick n_pp-1+i) — the drained
+            # ticks' outputs are dropped instead of pushed through a masked
+            # vocab matmul every tick
+            y_live = ys[n_pp - 1 :].reshape(b_local, s, cfg["width"])
+            logits = _head(params, y_live)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], tokens[:, 1:]
+            )
+            # sum / replica token count: nonzero only on the tail stage
+            # (other stages' y_live is pipeline garbage, masked out here);
+            # see module docstring for why no psum belongs inside the loss
+            return jnp.where(is_tail, jnp.sum(ce), 0.0) / (b_local * (s - 1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        # pp-replicated leaves carry nonzero grads only on the stage that
+        # used them (embeddings: head; ln_f/head: tail) — psum completes
+        # them; stage-sharded block slices are exact as-is
+        grads = jax.tree_util.tree_map(
+            lambda g, sp: g if _is_pp_sharded(sp) else jax.lax.psum(g, pp_axis),
+            grads,
+            param_specs,
+        )
+        replica_loss = jax.lax.psum(loss, pp_axis)
+        return compressed_dp_update(
+            optimizer, codec, state, k_codec, grads, replica_loss,
+            dp_axis=dp_axis, n_dp=n_dp,
+        )
+
+    sharded = jax.shard_map(
+        spmd_step,
+        mesh=mesh,
+        in_specs=(state_specs, P(), P(dp_axis, None)),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def shard_pp_tokens(mesh: Mesh, tokens, dp_axis: str = "dp"):
+    return jax.device_put(
+        jnp.asarray(tokens), NamedSharding(mesh, P(dp_axis, None))
+    )

@@ -128,7 +128,7 @@ def test_moe_step_matches_single_device():
         return total
 
     def oracle_loss(p):
-        reps = tokens.reshape(n_dp, n_dp and tokens.shape[0] // n_dp, -1)
+        reps = tokens.reshape(n_dp, tokens.shape[0] // n_dp, -1)
         return (replica_loss(p, reps[0]) + replica_loss(p, reps[1])) / 2.0
 
     grads = jax.grad(oracle_loss)(params0)
