@@ -27,6 +27,9 @@ Subcommands:
              reference, distributed_nn.py:243-259, collapses to --n-devices)
   evaluate   checkpoint-polling evaluator (src/distributed_evaluator.py)
   tune       LR grid search (src/tune.sh + src/tiny_tuning_parser.py)
+  lm         LM training over any parallelism layout — dp, dp-sp (ring or
+             Ulysses), dp-tp (Megatron), dp-ep (switch-MoE), dp-pp (GPipe);
+             no reference analogue (DP-only, CV-only)
 
 `python -m atomo_tpu.cli <flags>` with no subcommand behaves like `train`,
 matching `python distributed_nn.py <flags>`.
@@ -37,6 +40,10 @@ from __future__ import annotations
 import argparse
 import sys
 import warnings
+
+# --code values that mean "no compression, dense psum aggregation"; must
+# match the aliases get_codec maps to DenseCodec (codecs/__init__.py)
+DENSE_CODES = ("sgd", "dense", "none")
 
 
 def _add_fit_args(parser: argparse.ArgumentParser) -> None:
@@ -133,7 +140,7 @@ def _warn_dead_flags(args: argparse.Namespace) -> None:
             "parameter in the reference too, README.md:111)"
         )
     if args.num_aggregate is not None and (
-        args.aggregate != "gather" or args.code.lower() in ("sgd", "dense", "none")
+        args.aggregate != "gather" or args.code.lower() in DENSE_CODES
     ):
         warnings.warn(
             "--num-aggregate only applies to compressed gather aggregation "
@@ -209,7 +216,7 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
         sample=args.sample,
         algorithm=getattr(args, "svd_algo", "auto"),
     )
-    if args.code.lower() in ("sgd", "dense", "none"):
+    if args.code.lower() in DENSE_CODES:
         codec = None  # dense path: plain psum aggregation
     return model, optimizer, codec, train_iter, test_iter, name
 
@@ -297,6 +304,145 @@ def cmd_train(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_lm(args: argparse.Namespace) -> int:
+    """Long-context / model-sharded LM training: every parallelism layout
+    the framework supports, drivable from the CLI (no reference analogue —
+    the reference is DP-only and CV-only, SURVEY.md §2.1/§5.7).
+
+    --layout picks the mesh composition; --ways sizes the model axis:
+      dp     pure compressed data parallelism
+      dp-sp  sequence parallelism (ring or Ulysses attention, --attn-impl)
+      dp-tp  Megatron tensor parallelism
+      dp-ep  switch-MoE expert parallelism
+      dp-pp  GPipe pipeline parallelism
+    """
+    import jax
+    import numpy as np
+
+    from atomo_tpu.codecs import get_codec
+    from atomo_tpu.parallel import launch, make_mesh
+    from atomo_tpu.training import make_optimizer
+
+    launch.initialize()
+    n_dev = args.n_devices or len(jax.devices())
+    layout = args.layout
+    ways = 1 if layout == "dp" else args.ways
+    if n_dev % ways:
+        raise SystemExit(f"--ways {ways} does not divide {n_dev} devices")
+    dp = n_dev // ways
+    if args.batch_size % n_dev and layout == "dp-ep":
+        raise SystemExit(
+            f"--batch-size {args.batch_size} must divide over all "
+            f"{n_dev} chips for dp-ep"
+        )
+    if args.batch_size % dp:
+        raise SystemExit(f"--batch-size {args.batch_size} not divisible by dp={dp}")
+
+    codec = None
+    if args.code.lower() not in DENSE_CODES:
+        codec = get_codec(
+            args.code,
+            svd_rank=args.svd_rank,
+            quantization_level=args.quantization_level,
+            bucket_size=args.bucket_size,
+        )
+    optimizer = make_optimizer(
+        args.optimizer, lr=args.lr, lr_shrinkage=args.lr_shrinkage,
+        shrinkage_freq=args.shrinkage_freq, momentum=args.momentum,
+        nesterov=args.nesterov, weight_decay=args.weight_decay,
+    )
+    cfg = dict(
+        vocab_size=args.vocab_size, max_len=args.seq_len, width=args.width,
+        depth=args.depth, num_heads=args.num_heads,
+    )
+    key = jax.random.PRNGKey(args.seed)
+
+    if layout in ("dp", "dp-sp"):
+        from atomo_tpu.models.transformer import TransformerLM
+        from atomo_tpu.parallel.lm import make_lm_train_step, shard_tokens
+        from atomo_tpu.parallel.replicated import replicate_state
+        from atomo_tpu.training import create_state
+
+        if args.seq_len % ways:
+            raise SystemExit(f"--seq-len must be divisible by sp ways={ways}")
+        mesh = make_mesh(n_dev, axes=(("dp", dp), ("sp", ways)))
+        sample = jax.numpy.zeros((1, args.seq_len), jax.numpy.int32)
+        state = create_state(TransformerLM(**cfg), optimizer, key, sample)
+        state = replicate_state(mesh, state)
+        step = make_lm_train_step(
+            cfg, optimizer, mesh, codec, attn_impl=args.attn_impl
+        )
+        shard = lambda t: shard_tokens(mesh, t)  # noqa: E731
+    elif layout == "dp-tp":
+        from atomo_tpu.parallel.tp import (
+            create_tp_lm_state, make_tp_lm_train_step, shard_tp_tokens,
+        )
+
+        mesh = make_mesh(n_dev, axes=(("dp", dp), ("tp", ways)))
+        state, specs = create_tp_lm_state(mesh, cfg, optimizer, key)
+        step = make_tp_lm_train_step(cfg, optimizer, mesh, specs, codec)
+        shard = lambda t: shard_tp_tokens(mesh, t)  # noqa: E731
+    elif layout == "dp-ep":
+        from atomo_tpu.parallel.moe import (
+            create_moe_lm_state, make_moe_lm_train_step, shard_moe_tokens,
+        )
+
+        cfg["num_experts"] = args.num_experts
+        mesh = make_mesh(n_dev, axes=(("dp", dp), ("ep", ways)))
+        state, specs = create_moe_lm_state(mesh, cfg, optimizer, key)
+        step = make_moe_lm_train_step(cfg, optimizer, mesh, specs, codec)
+        shard = lambda t: shard_moe_tokens(mesh, t)  # noqa: E731
+    elif layout == "dp-pp":
+        from atomo_tpu.parallel.pp import (
+            create_pp_lm_state, make_pp_lm_train_step, shard_pp_tokens,
+        )
+
+        if args.depth % ways:
+            raise SystemExit(
+                f"--depth {args.depth} must be divisible by pp ways={ways}"
+            )
+        if (args.batch_size // dp) % args.microbatches:
+            raise SystemExit(
+                f"per-replica batch {args.batch_size // dp} not divisible "
+                f"by --microbatches {args.microbatches}"
+            )
+        mesh = make_mesh(n_dev, axes=(("dp", dp), ("pp", ways)))
+        state, specs = create_pp_lm_state(mesh, cfg, optimizer, key)
+        step = make_pp_lm_train_step(
+            cfg, optimizer, mesh, specs, codec,
+            num_microbatches=args.microbatches,
+        )
+        shard = lambda t: shard_pp_tokens(mesh, t)  # noqa: E731
+    else:  # pragma: no cover - argparse choices guard this
+        raise SystemExit(f"unknown --layout {layout}")
+
+    # deterministic learnable token streams: arithmetic progressions with
+    # random starts/strides (the LM data analogue of --synthetic)
+    rng = np.random.default_rng(args.seed)
+
+    def next_batch():
+        starts = rng.integers(0, args.vocab_size, size=(args.batch_size, 1))
+        strides = rng.integers(1, 4, size=(args.batch_size, 1))
+        seq = (starts + strides * np.arange(args.seq_len)) % args.vocab_size
+        return shard(seq.astype(np.int32))
+
+    import time
+
+    for i in range(1, args.max_steps + 1):
+        t0 = time.time()
+        state, metrics = step(state, jax.random.fold_in(key, i), next_batch())
+        loss = float(metrics["loss"])  # device sync: honest step timing
+        if i % args.log_interval == 0 or i == args.max_steps:
+            print(
+                f"LM: Step: {i}, Layout: {layout}({dp}x{ways}), "
+                f"Loss: {loss:.4f}, Time Cost: {time.time() - t0:.4f}, "
+                f"Msg(MB): {float(metrics['msg_bytes']) / 1e6:.4f}, "
+                f"Dense(MB): {float(metrics['dense_bytes']) / 1e6:.4f}",
+                flush=True,
+            )
+    return 0
+
+
 def cmd_evaluate(args: argparse.Namespace) -> int:
     from atomo_tpu.training.evaluator import CheckpointEvaluator
 
@@ -340,6 +486,41 @@ def build_parser() -> argparse.ArgumentParser:
     p_eval.add_argument("--stop-when-idle", action="store_true", default=False)
     p_eval.set_defaults(fn=cmd_evaluate)
 
+    p_lm = sub.add_parser(
+        "lm",
+        help="LM training over any parallelism layout (dp/sp/tp/ep/pp)",
+    )
+    p_lm.add_argument("--layout", type=str, default="dp",
+                      choices=["dp", "dp-sp", "dp-tp", "dp-ep", "dp-pp"])
+    p_lm.add_argument("--ways", type=int, default=2, metavar="N",
+                      help="model-axis size (sp/tp/ep/pp shards)")
+    p_lm.add_argument("--attn-impl", type=str, default="ring",
+                      choices=["ring", "ulysses"])
+    p_lm.add_argument("--vocab-size", type=int, default=256)
+    p_lm.add_argument("--seq-len", type=int, default=128)
+    p_lm.add_argument("--width", type=int, default=128)
+    p_lm.add_argument("--depth", type=int, default=4)
+    p_lm.add_argument("--num-heads", type=int, default=4)
+    p_lm.add_argument("--num-experts", type=int, default=8)
+    p_lm.add_argument("--microbatches", type=int, default=2)
+    p_lm.add_argument("--batch-size", type=int, default=8)
+    p_lm.add_argument("--max-steps", type=int, default=50)
+    p_lm.add_argument("--log-interval", type=int, default=10)
+    p_lm.add_argument("--n-devices", type=int, default=0, help="0 = all")
+    p_lm.add_argument("--seed", type=int, default=0)
+    p_lm.add_argument("--lr", type=float, default=0.1)
+    p_lm.add_argument("--momentum", type=float, default=0.9)
+    p_lm.add_argument("--nesterov", action="store_true", default=False)
+    p_lm.add_argument("--weight-decay", type=float, default=0.0)
+    p_lm.add_argument("--lr-shrinkage", type=float, default=1.0)
+    p_lm.add_argument("--shrinkage-freq", type=int, default=50)
+    p_lm.add_argument("--optimizer", type=str, default="sgd")
+    p_lm.add_argument("--code", type=str, default="svd")
+    p_lm.add_argument("--svd-rank", type=int, default=3)
+    p_lm.add_argument("--quantization-level", type=int, default=2)
+    p_lm.add_argument("--bucket-size", type=int, default=512)
+    p_lm.set_defaults(fn=cmd_lm)
+
     p_tune = sub.add_parser("tune", help="LR grid search (src/tune.sh parity)")
     _add_fit_args(p_tune)
     p_tune.add_argument("--grid", type=str, default="",
@@ -370,7 +551,7 @@ def _honor_platform_env() -> None:
 def main(argv=None) -> int:
     _honor_platform_env()
     argv = list(sys.argv[1:] if argv is None else argv)
-    known = {"train", "evaluate", "tune", "-h", "--help"}
+    known = {"train", "evaluate", "tune", "lm", "-h", "--help"}
     if argv and argv[0] not in known:
         argv = ["train"] + argv  # bare flags behave like the reference CLI
     elif not argv:

@@ -32,6 +32,12 @@ def layernorm(x, scale, eps: float = 1e-6):
     return (x - mean) * jax.lax.rsqrt(var + eps) * scale
 
 
+def shard_tokens_with_spec(mesh: Mesh, tokens, spec: P):
+    """device_put an int token batch with the given PartitionSpec — the one
+    shared sharding helper behind every *_tokens entry point (tp/moe/pp)."""
+    return jax.device_put(jnp.asarray(tokens), NamedSharding(mesh, spec))
+
+
 def attention_sublayer(bp, x, num_heads: int):
     """Pre-LN causal attention sublayer on stock-layout block params
     (keys ln1/qkv/proj, qkv kernel (W, 3·H·D)): returns x + proj(attn).

@@ -44,6 +44,7 @@ from atomo_tpu.parallel.common import (
     layernorm,
     make_state_specs,
     shard_state,
+    shard_tokens_with_spec,
 )
 from atomo_tpu.parallel.lm import compressed_dp_update
 from atomo_tpu.training.trainer import TrainState
@@ -296,6 +297,4 @@ def make_moe_lm_train_step(
 def shard_moe_tokens(
     mesh: Mesh, tokens, dp_axis: str = "dp", ep_axis: str = "ep"
 ):
-    return jax.device_put(
-        jnp.asarray(tokens), NamedSharding(mesh, P((dp_axis, ep_axis), None))
-    )
+    return shard_tokens_with_spec(mesh, tokens, P((dp_axis, ep_axis), None))

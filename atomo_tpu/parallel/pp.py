@@ -41,6 +41,7 @@ from atomo_tpu.parallel.common import (
     layernorm,
     make_state_specs,
     shard_state,
+    shard_tokens_with_spec,
 )
 from atomo_tpu.parallel.lm import compressed_dp_update
 from atomo_tpu.training.trainer import TrainState
@@ -259,6 +260,4 @@ def make_pp_lm_train_step(
 
 
 def shard_pp_tokens(mesh: Mesh, tokens, dp_axis: str = "dp"):
-    return jax.device_put(
-        jnp.asarray(tokens), NamedSharding(mesh, P(dp_axis, None))
-    )
+    return shard_tokens_with_spec(mesh, tokens, P(dp_axis, None))
