@@ -357,6 +357,18 @@ def cmd_lm(args: argparse.Namespace) -> int:
     )
     key = jax.random.PRNGKey(args.seed)
 
+    # layout-inapplicable flags: warn, don't silently ignore (the train
+    # subcommand's _warn_dead_flags precedent)
+    defaults = {"attn_impl": "ring", "num_experts": 8, "microbatches": 2}
+    applicable = {"dp-sp": "attn_impl", "dp-ep": "num_experts", "dp-pp": "microbatches"}
+    for flag, default in defaults.items():
+        if getattr(args, flag) != default and applicable.get(layout) != flag:
+            warnings.warn(
+                f"--{flag.replace('_', '-')} only applies to layout "
+                f"{[k for k, v in applicable.items() if v == flag][0]}; "
+                f"ignored for --layout {layout}"
+            )
+
     if layout in ("dp", "dp-sp"):
         from atomo_tpu.models.transformer import TransformerLM
         from atomo_tpu.parallel.lm import make_lm_train_step, shard_tokens
@@ -379,7 +391,10 @@ def cmd_lm(args: argparse.Namespace) -> int:
         )
 
         mesh = make_mesh(n_dev, axes=(("dp", dp), ("tp", ways)))
-        state, specs = create_tp_lm_state(mesh, cfg, optimizer, key)
+        try:
+            state, specs = create_tp_lm_state(mesh, cfg, optimizer, key)
+        except ValueError as e:  # sizing errors -> clean one-liner
+            raise SystemExit(str(e)) from None
         step = make_tp_lm_train_step(cfg, optimizer, mesh, specs, codec)
         shard = lambda t: shard_tp_tokens(mesh, t)  # noqa: E731
     elif layout == "dp-ep":
@@ -389,7 +404,10 @@ def cmd_lm(args: argparse.Namespace) -> int:
 
         cfg["num_experts"] = args.num_experts
         mesh = make_mesh(n_dev, axes=(("dp", dp), ("ep", ways)))
-        state, specs = create_moe_lm_state(mesh, cfg, optimizer, key)
+        try:
+            state, specs = create_moe_lm_state(mesh, cfg, optimizer, key)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
         step = make_moe_lm_train_step(cfg, optimizer, mesh, specs, codec)
         shard = lambda t: shard_moe_tokens(mesh, t)  # noqa: E731
     elif layout == "dp-pp":
@@ -407,7 +425,10 @@ def cmd_lm(args: argparse.Namespace) -> int:
                 f"by --microbatches {args.microbatches}"
             )
         mesh = make_mesh(n_dev, axes=(("dp", dp), ("pp", ways)))
-        state, specs = create_pp_lm_state(mesh, cfg, optimizer, key)
+        try:
+            state, specs = create_pp_lm_state(mesh, cfg, optimizer, key)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
         step = make_pp_lm_train_step(
             cfg, optimizer, mesh, specs, codec,
             num_microbatches=args.microbatches,
@@ -495,7 +516,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("--ways", type=int, default=2, metavar="N",
                       help="model-axis size (sp/tp/ep/pp shards)")
     p_lm.add_argument("--attn-impl", type=str, default="ring",
-                      choices=["ring", "ulysses"])
+                      choices=["ring", "ulysses", "ulysses-flash"],
+                      help="dp-sp sequence-parallel strategy; ulysses-flash "
+                           "uses the fused Pallas local attention")
     p_lm.add_argument("--vocab-size", type=int, default=256)
     p_lm.add_argument("--seq-len", type=int, default=128)
     p_lm.add_argument("--width", type=int, default=128)

@@ -1,0 +1,179 @@
+"""Pallas TPU kernel for fused (flash) attention — the LM forward hot path.
+
+The jnp attention paths (parallel.ring.full_attention / blockwise_attention)
+leave the softmax chain to XLA: scores, max, exp, sum and the PV matmul are
+separate HBM-visible ops unless XLA fuses them. This kernel is the classic
+flash-attention schedule as ONE VMEM-resident program per query block: K/V
+stream through the MXU in blocks under an online-softmax accumulator, the
+S×S score matrix never exists, and HBM traffic is O(S·D) reads + O(S·D)
+writes per head regardless of S. For causal masks the K loop stops at the
+diagonal block, halving the work.
+
+Scope discipline (round-2 lesson: TPU-only code paths must stay testable):
+  * forward = Pallas kernel, bit-compared against full_attention in the
+    TPU-semantics interpreter on CPU and on the real chip (tests_tpu);
+  * backward = jax.vjp of the jnp blockwise oracle (identical math), so
+    training through ``flash_attention`` is exact and needs no hand-written
+    transpose kernel; the fused win applies to the forward pass.
+  * shapes that don't tile (S % block) fall back to blockwise_attention —
+    no silent padding semantics.
+
+No reference analogue: the reference has no attention at all (SURVEY.md
+§5.7); this is TPU-first capability the framework adds on top of parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from atomo_tpu.ops.qsgd_kernels import _interpret_mode, is_tpu
+
+NEG_INF = float("-inf")
+
+
+def _fa_kernel(
+    q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+    block_k: int, s_total: int
+):
+    """One (batch, head, q-block) program: stream K/V blocks through an
+    online-softmax accumulator. Block shapes: q/o (1, 1, Bq, D);
+    k/v (1, 1, S, D) resident in VMEM."""
+    q = q_ref[0, 0].astype(jnp.float32)  # (Bq, D)
+    bq, d = q.shape
+    iq = pl.program_id(2)
+    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    n_k = pl.cdiv(s_total, block_k)
+    if causal:
+        # blocks strictly above the diagonal contribute nothing
+        n_k = jnp.minimum(n_k, pl.cdiv((iq + 1) * bq, block_k))
+
+    def body(jk, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (Bq, Bk)
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        valid = k_pos < s_total
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q, k, v, *, causal: bool, scale: float, block_q: int, block_k: int,
+    interpret: bool,
+):
+    b, h, s, d = q.shape
+    grid = (b, h, s // block_q)
+    kernel = partial(
+        _fa_kernel, scale=scale, causal=causal, block_k=block_k, s_total=s
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bb, hh, i: (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bb, hh, i: (bb, hh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret_mode(interpret),
+    )(q, k, v)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(
+        q, k, v, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(
+        q, k, v, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    # exact gradients via the jnp blockwise oracle (same online-softmax
+    # math, same O(S·block) memory); the fused kernel accelerates forward
+    from atomo_tpu.parallel.ring import blockwise_attention
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda qq, kk, vv: blockwise_attention(
+            qq, kk, vv, causal=causal, scale=scale, block_size=block_k
+        ),
+        q, k, v,
+    )
+    return vjp(do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused exact attention (B, H, S, D) -> (B, H, S, D).
+
+    Forward runs the Pallas flash kernel (interpreter on CPU, Mosaic on
+    TPU); backward is the jnp blockwise oracle's VJP. Falls back to
+    blockwise_attention when S doesn't tile by the blocks — identical
+    results either way (tested)."""
+    from atomo_tpu.parallel.ring import blockwise_attention
+
+    b, h, s, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        return blockwise_attention(
+            q, k, v, causal=causal, scale=scale, block_size=block_k
+        )
+    if interpret is None:
+        interpret = not is_tpu()
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

@@ -75,6 +75,21 @@ def opt_state_specs_like(opt_state: Any, params: Any, param_specs: Any) -> Any:
     )
 
 
+def complete_model_axis_grads(grads, param_specs, axis: str, divide_by: int = 1):
+    """Per-shard gradient completion for a model-sharding axis (tp/ep/pp):
+    leaves whose spec mentions ``axis`` are already exact for their slice;
+    replicated leaves hold shard-partials that one psum over the axis
+    completes. ``divide_by`` removes a uniform n-scaling when the loss path
+    crosses a psum (the tp case — see parallel.tp's derivation)."""
+
+    def one(g, sp):
+        sharded = any(a == axis for a in sp if a is not None)
+        full = g if sharded else jax.lax.psum(g, axis)
+        return full / divide_by if divide_by != 1 else full
+
+    return jax.tree_util.tree_map(one, grads, param_specs)
+
+
 def make_state_specs(state: TrainState, param_specs: Any) -> TrainState:
     """A TrainState of PartitionSpecs matching ``state`` leaf-for-leaf."""
     return TrainState(

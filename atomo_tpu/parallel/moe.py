@@ -36,12 +36,13 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from atomo_tpu.parallel.common import (
     attention_sublayer,
     dense_init as _dense_init,
     layernorm,
+    complete_model_axis_grads,
     make_state_specs,
     shard_state,
     shard_tokens_with_spec,
@@ -243,9 +244,6 @@ def make_moe_lm_train_step(
     n_ep = mesh.shape[ep_axis]
     param_specs = state_specs.params
 
-    def _is_ep_sharded(spec: P) -> bool:
-        return any(ax == ep_axis for ax in spec if ax is not None)
-
     def spmd_step(state: TrainState, key, tokens):
         b_local, s = tokens.shape
         t_local = b_local * s
@@ -273,11 +271,8 @@ def make_moe_lm_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         # replicated leaves: psum over ep sums the shard-partials into the
         # replica gradient; expert leaves arrive exact via the a2a transpose
-        grads = jax.tree_util.tree_map(
-            lambda g, sp: g if _is_ep_sharded(sp) else jax.lax.psum(g, ep_axis),
-            grads,
-            param_specs,
-        )
+        # (no divide_by: the loss path crosses no psum — module docstring)
+        grads = complete_model_axis_grads(grads, param_specs, ep_axis)
         replica_loss = jax.lax.psum(loss, ep_axis)
         return compressed_dp_update(
             optimizer, codec, state, k_codec, grads, replica_loss,

@@ -33,12 +33,13 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from atomo_tpu.parallel.common import (
     attention_sublayer,
     dense_init as _dense_init,
     layernorm,
+    complete_model_axis_grads,
     make_state_specs,
     shard_state,
     shard_tokens_with_spec,
@@ -186,9 +187,6 @@ def make_pp_lm_train_step(
     m = num_microbatches
     param_specs = state_specs.params
 
-    def _is_pp_sharded(spec: P) -> bool:
-        return any(ax == pp_axis for ax in spec if ax is not None)
-
     def spmd_step(state: TrainState, key, tokens):
         b_local, s = tokens.shape
         if b_local % m:
@@ -237,12 +235,9 @@ def make_pp_lm_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         # pp-replicated leaves carry nonzero grads only on the stage that
         # used them (embeddings: head; ln_f/head: tail) — psum completes
-        # them; stage-sharded block slices are exact as-is
-        grads = jax.tree_util.tree_map(
-            lambda g, sp: g if _is_pp_sharded(sp) else jax.lax.psum(g, pp_axis),
-            grads,
-            param_specs,
-        )
+        # them; stage-sharded block slices are exact as-is (no psum in the
+        # loss path, so no divide_by)
+        grads = complete_model_axis_grads(grads, param_specs, pp_axis)
         replica_loss = jax.lax.psum(loss, pp_axis)
         return compressed_dp_update(
             optimizer, codec, state, k_codec, grads, replica_loss,

@@ -176,11 +176,15 @@ def ulysses_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     block_size: int = 512,
+    local_impl: str = "blockwise",
 ) -> jax.Array:
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism: swap the
     sequence sharding for a *head* sharding with one ``all_to_all``, run
     blockwise exact attention on whole sequences for H/n local heads, and
-    swap back. The second first-class long-context strategy next to
+    swap back. ``local_impl`` picks the per-chip attention after the swap:
+    "blockwise" (jnp online-softmax scan) or "flash" (the fused Pallas
+    kernel, ops.attention_kernels — Mosaic on TPU, interpreter on CPU).
+    The second first-class long-context strategy next to
     :func:`ring_attention`:
 
       * ring — n ppermute hops of K/V around the ICI torus, O(S/n)
@@ -197,6 +201,10 @@ def ulysses_attention(
     (tests/test_ring.py).
     """
     b, h, s_local, d = q.shape
+    if local_impl not in ("blockwise", "flash"):
+        raise ValueError(
+            f"unknown local_impl {local_impl!r}; expected blockwise|flash"
+        )
     if h % axis_size != 0:
         raise ValueError(
             f"ulysses needs heads ({h}) divisible by the {axis_name!r} "
@@ -208,16 +216,31 @@ def ulysses_attention(
     qkv = jnp.stack([q, k, v])
     qkv = jax.lax.all_to_all(qkv, axis_name, split_axis=2, concat_axis=3, tiled=True)
     q_g, k_g, v_g = qkv[0], qkv[1], qkv[2]  # (B, H/n, S, D)
-    out = blockwise_attention(
-        q_g, k_g, v_g, causal=causal, scale=scale, block_size=block_size
-    )
+    if local_impl == "flash":
+        from atomo_tpu.ops.attention_kernels import flash_attention
+
+        out = flash_attention(
+            q_g, k_g, v_g, causal=causal, scale=scale,
+            block_q=block_size, block_k=block_size,
+        )
+    else:
+        out = blockwise_attention(
+            q_g, k_g, v_g, causal=causal, scale=scale, block_size=block_size
+        )
     # (B, H/n, S, D) -> (B, H, S/n, D): split the sequence, regather heads
     return jax.lax.all_to_all(
         out, axis_name, split_axis=2, concat_axis=1, tiled=True
     )
 
 
-ATTENTION_IMPLS = {"ring": ring_attention, "ulysses": ulysses_attention}
+ATTENTION_IMPLS = {
+    "ring": ring_attention,
+    "ulysses": ulysses_attention,
+    # Ulysses with the fused Pallas kernel as its local attention — the
+    # flash forward IS reachable from training (make_lm_train_step /
+    # `lm --attn-impl ulysses-flash`)
+    "ulysses-flash": partial(ulysses_attention, local_impl="flash"),
+}
 
 
 def make_sequence_parallel_attention(

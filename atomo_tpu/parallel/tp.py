@@ -39,10 +39,11 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from atomo_tpu.parallel.common import (
     layernorm as _layernorm,
+    complete_model_axis_grads,
     make_state_specs,
     shard_state,
     shard_tokens_with_spec,
@@ -249,9 +250,6 @@ def make_tp_lm_train_step(
     v_local = lm_config["vocab_size"] // n_tp
     param_specs = state_specs.params
 
-    def _is_tp_sharded(spec: P) -> bool:
-        return any(ax == tp_axis for ax in spec if ax is not None)
-
     def spmd_step(state: TrainState, key, tokens):
         my_dp = jax.lax.axis_index(dp_axis)
         k_codec = jax.random.fold_in(jax.random.fold_in(key, state.step), my_dp)
@@ -267,23 +265,16 @@ def make_tp_lm_train_step(
             )
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        # Per-shard grad completion. Under shard_map the transpose of psum is
-        # psum, and every loss->leaf path crosses exactly one parallel-region
-        # psum (block exits, or the loss logsumexp psums for the head), so
-        # per-shard cotangents of replicated activations SUM over tp to
-        # n_tp x the true cotangent (verified empirically; see the pmean fix
-        # in parallel.lm for the sp-axis instance). Hence: sharded leaves are
-        # n_tp x their exact slice grad -> divide by n_tp; tp-replicated
-        # leaves (embeddings, LN scales) hold shard-partial contributions
-        # summing to n_tp x truth -> pmean (psum then / n_tp).
-        grads = jax.tree_util.tree_map(
-            lambda g, sp: (
-                g if _is_tp_sharded(sp) else jax.lax.psum(g, tp_axis)
-            )
-            / n_tp,
-            grads,
-            param_specs,
-        )
+        # Per-shard grad completion (common.complete_model_axis_grads).
+        # Under shard_map the transpose of psum is psum, and every
+        # loss->leaf path crosses exactly one parallel-region psum (block
+        # exits, or the loss logsumexp psums for the head), so per-shard
+        # cotangents of replicated activations SUM over tp to n_tp x the
+        # true cotangent (verified empirically; see the pmean fix in
+        # parallel.lm for the sp-axis instance). divide_by=n_tp removes the
+        # uniform n-scaling: sharded leaves become their exact slice grad,
+        # replicated leaves get psum/n = pmean.
+        grads = complete_model_axis_grads(grads, param_specs, tp_axis, n_tp)
 
         return compressed_dp_update(
             optimizer, codec, state, k_codec, grads, loss,

@@ -6,6 +6,7 @@ from atomo_tpu.training.checkpoint import (  # noqa: F401
     list_steps,
     load_checkpoint,
     load_params,
+    load_sharded_checkpoint,
     save_checkpoint,
 )
 from atomo_tpu.training.optim import make_optimizer, stepwise_shrink  # noqa: F401

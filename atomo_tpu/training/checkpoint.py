@@ -114,3 +114,22 @@ def load_params(train_dir: str, state_template, step: Optional[int] = None):
         state_template.batch_stats, d.get("batch_stats", {})
     )
     return int(d.get("step", 0)), params, stats
+
+
+def load_sharded_checkpoint(
+    train_dir: str, state_template, mesh, state_specs, step: Optional[int] = None
+):
+    """Restore a model-sharded TrainState (tp/moe/pp states whose leaves
+    carry PartitionSpecs over a model axis): host-restore onto the template,
+    then device_put every leaf with its NamedSharding. ``state_specs`` is
+    the TrainState-of-specs returned by create_{tp,moe,pp}_lm_state.
+
+    save_checkpoint needs no sharded counterpart — jax.device_get already
+    gathers each sharded leaf to a full host array, so checkpoints written
+    from a sharded run restore onto any mesh shape (or a single device).
+    """
+    from atomo_tpu.parallel.common import shard_state  # lazy: avoids cycle
+
+    return shard_state(
+        mesh, load_checkpoint(train_dir, state_template, step), state_specs
+    )
