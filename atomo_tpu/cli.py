@@ -351,6 +351,27 @@ def cmd_lm(args: argparse.Namespace) -> int:
         shrinkage_freq=args.shrinkage_freq, momentum=args.momentum,
         nesterov=args.nesterov, weight_decay=args.weight_decay,
     )
+    # validate --data-file BEFORE the expensive layout setup: it depends
+    # only on argv and the file
+    raw = None
+    if args.data_file:
+        if args.vocab_size < 256:
+            raise SystemExit(
+                f"--data-file tokenizes raw bytes: --vocab-size "
+                f"{args.vocab_size} < 256 cannot embed them"
+            )
+        try:
+            with open(args.data_file, "rb") as f:
+                raw = np.frombuffer(f.read(), dtype=np.uint8)
+        except OSError as e:
+            raise SystemExit(f"--data-file: {e}") from None
+        if len(raw) // args.seq_len < args.batch_size:
+            raise SystemExit(
+                f"--data-file holds only {len(raw) // args.seq_len} "
+                f"sequences of length {args.seq_len}; need at least "
+                f"--batch-size {args.batch_size}"
+            )
+
     cfg = dict(
         vocab_size=args.vocab_size, max_len=args.seq_len, width=args.width,
         depth=args.depth, num_heads=args.num_heads,
@@ -437,16 +458,27 @@ def cmd_lm(args: argparse.Namespace) -> int:
     else:  # pragma: no cover - argparse choices guard this
         raise SystemExit(f"unknown --layout {layout}")
 
-    # deterministic learnable token streams: arithmetic progressions with
-    # random starts/strides (the LM data analogue of --synthetic)
     rng = np.random.default_rng(args.seed)
+    if raw is not None:
+        # byte-level corpus: the file's raw bytes are the token stream,
+        # chunked into seq_len windows (validated above)
+        n_seq = len(raw) // args.seq_len
+        chunks = raw[: n_seq * args.seq_len].reshape(n_seq, args.seq_len)
 
-    def next_batch():
-        starts = rng.integers(0, args.vocab_size, size=(args.batch_size, 1))
-        strides = rng.integers(1, 4, size=(args.batch_size, 1))
-        seq = (starts + strides * np.arange(args.seq_len)) % args.vocab_size
-        return shard(seq.astype(np.int32))
+        def next_batch():
+            idx = rng.integers(0, n_seq, size=args.batch_size)
+            return shard(chunks[idx].astype(np.int32))
 
+    else:
+        # deterministic learnable token streams: arithmetic progressions
+        # with random starts/strides (the LM data analogue of --synthetic)
+        def next_batch():
+            starts = rng.integers(0, args.vocab_size, size=(args.batch_size, 1))
+            strides = rng.integers(1, 4, size=(args.batch_size, 1))
+            seq = (starts + strides * np.arange(args.seq_len)) % args.vocab_size
+            return shard(seq.astype(np.int32))
+
+    import math
     import time
 
     for i in range(1, args.max_steps + 1):
@@ -456,7 +488,8 @@ def cmd_lm(args: argparse.Namespace) -> int:
         if i % args.log_interval == 0 or i == args.max_steps:
             print(
                 f"LM: Step: {i}, Layout: {layout}({dp}x{ways}), "
-                f"Loss: {loss:.4f}, Time Cost: {time.time() - t0:.4f}, "
+                f"Loss: {loss:.4f}, PPL: {math.exp(min(loss, 30.0)):.2f}, "
+                f"Time Cost: {time.time() - t0:.4f}, "
                 f"Msg(MB): {float(metrics['msg_bytes']) / 1e6:.4f}, "
                 f"Dense(MB): {float(metrics['dense_bytes']) / 1e6:.4f}",
                 flush=True,
@@ -519,6 +552,10 @@ def build_parser() -> argparse.ArgumentParser:
                       choices=["ring", "ulysses", "ulysses-flash"],
                       help="dp-sp sequence-parallel strategy; ulysses-flash "
                            "uses the fused Pallas local attention")
+    p_lm.add_argument("--data-file", type=str, default="",
+                      help="byte-level text corpus (raw bytes = tokens, "
+                           "needs --vocab-size >= 256); default: synthetic "
+                           "deterministic token streams")
     p_lm.add_argument("--vocab-size", type=int, default=256)
     p_lm.add_argument("--seq-len", type=int, default=128)
     p_lm.add_argument("--width", type=int, default=128)

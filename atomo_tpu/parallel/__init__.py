@@ -22,6 +22,7 @@ from atomo_tpu.parallel.replicated import (  # noqa: F401
 from atomo_tpu.parallel.tp import (  # noqa: F401
     create_tp_lm_state,
     make_tp_lm_train_step,
+    make_tp_sp_lm_train_step,
     shard_tp_tokens,
 )
 from atomo_tpu.parallel.moe import (  # noqa: F401

@@ -32,6 +32,24 @@ from atomo_tpu.parallel.ring import ATTENTION_IMPLS
 from atomo_tpu.training.trainer import TrainState, cast_params
 
 
+def sp_boundary_targets_and_mask(tokens, sp_axis: str, n_sp: int):
+    """Boundary-exact next-token targets for a sequence-sharded batch:
+    each shard's last target is the FIRST token of the next shard
+    (ppermute), and the global final position (last shard's last column)
+    is masked out. Returns (targets, valid) of shape (B, S_local) — the
+    contract shared by the dp x sp and dp x tp x sp loss functions, so
+    sharded and unsharded training compute the same scalar CE."""
+    nxt = jax.lax.ppermute(
+        tokens[:, :1], sp_axis,
+        [(i, (i - 1) % n_sp) for i in range(n_sp)],
+    )
+    targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
+    valid = jnp.ones(targets.shape, jnp.float32)
+    is_last = (jax.lax.axis_index(sp_axis) == n_sp - 1).astype(jnp.float32)
+    valid = valid.at[:, -1].set(1.0 - is_last)
+    return targets, valid
+
+
 def compressed_dp_update(
     optimizer,
     codec,
@@ -138,16 +156,8 @@ def make_lm_train_step(
             )
             if compute_dtype is not None:
                 logits = logits.astype(jnp.float32)
-            # boundary target: first token of the next sequence shard
-            nxt = jax.lax.ppermute(
-                tokens[:, :1], sp_axis,
-                [(i, (i - 1) % n_sp) for i in range(n_sp)],
-            )
-            targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
+            targets, valid = sp_boundary_targets_and_mask(tokens, sp_axis, n_sp)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-            valid = jnp.ones(targets.shape, jnp.float32)
-            is_last = (jax.lax.axis_index(sp_axis) == n_sp - 1).astype(jnp.float32)
-            valid = valid.at[:, -1].set(1.0 - is_last)
             total = jax.lax.psum(jnp.sum(valid), sp_axis)
             return jax.lax.psum(jnp.sum(ce * valid), sp_axis) / total
 

@@ -3,7 +3,9 @@
 The reference is DP-only (SURVEY.md §2.1 — "full model per process",
 src/distributed_worker.py:139-164); a model too large for one worker simply
 cannot run there. This module extends the framework with the second model-
-sharding axis: a 2-D ('dp', 'tp') mesh where
+sharding axis — a 2-D ('dp', 'tp') mesh (make_tp_lm_train_step) and the
+full 3-D ('dp', 'tp', 'sp') composition with ring/Ulysses sequence
+parallelism (make_tp_sp_lm_train_step) — where
 
   tp — attention heads, MLP hidden width, and the vocab projection are
        sharded over the axis; every block costs exactly two ``psum``s in
@@ -35,6 +37,7 @@ Design choices (TPU-first):
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any
 
 import jax
@@ -48,7 +51,10 @@ from atomo_tpu.parallel.common import (
     shard_state,
     shard_tokens_with_spec,
 )
-from atomo_tpu.parallel.lm import compressed_dp_update
+from atomo_tpu.parallel.lm import (
+    compressed_dp_update,
+    sp_boundary_targets_and_mask,
+)
 from atomo_tpu.parallel.ring import full_attention
 from atomo_tpu.training.trainer import TrainState, cast_params
 
@@ -167,7 +173,8 @@ def create_tp_lm_state(
 
 
 def tp_lm_forward(
-    params: Any, tokens: jax.Array, *, pos_offset=0, tp_axis=None
+    params: Any, tokens: jax.Array, *, pos_offset=0, tp_axis=None,
+    attention_fn=None,
 ) -> jax.Array:
     """Per-shard TP forward on a TP-laid (and possibly head/hidden/vocab-
     SLICED) param tree. With ``tp_axis`` set (inside shard_map over sliced
@@ -175,9 +182,14 @@ def tp_lm_forward(
     output projection and after the MLP down-projection — so the residual
     stream is the full sum over heads/hidden on every shard. With
     ``tp_axis=None`` and unsliced params this equals TransformerLM.apply on
-    the equivalent stock tree (tested). Returns LOCAL vocab-slice logits
-    (B, S, V_local)."""
+    the equivalent stock tree (tested). ``attention_fn(q, k, v)`` overrides
+    the causal full attention on the LOCAL heads — inject ring/Ulysses to
+    compose tp with a sequence-sharded axis (make_tp_sp_lm_train_step).
+    Returns LOCAL vocab-slice logits (B, S, V_local)."""
     b, s = tokens.shape
+    attn = attention_fn or (
+        lambda q, k, v: full_attention(q, k, v, causal=True)
+    )
 
     def _g(t):  # parallel-region exit: all-reduce the partial sums
         return t if tp_axis is None else jax.lax.psum(t, tp_axis)
@@ -189,7 +201,7 @@ def tp_lm_forward(
         y = _layernorm(x, p["ln1"]["scale"])
         qkv_k = p["MultiHeadAttention_0"]["qkv"]["kernel"]  # (W, 3, Hl, D)
         qkv = jnp.einsum("bsw,wthd->tbhsd", y, qkv_k)
-        out = full_attention(qkv[0], qkv[1], qkv[2], causal=True)
+        out = attn(qkv[0], qkv[1], qkv[2])
         proj_k = p["MultiHeadAttention_0"]["proj"]["kernel"]  # (Hl, D, W)
         x = x + _g(jnp.einsum("bhsd,hdw->bsw", out, proj_k))
         y = _layernorm(x, p["ln2"]["scale"])
@@ -199,13 +211,12 @@ def tp_lm_forward(
     return jnp.einsum("bsw,wv->bsv", x, params["head"]["kernel"])
 
 
-def tp_sharded_ce(
+def tp_sharded_ce_terms(
     logits_local: jax.Array, targets: jax.Array, tp_axis: str, v_local: int
 ) -> jax.Array:
-    """Mean next-token CE over a vocab-sharded logits slice (B, S, V_local)
-    without materializing full logits: psum-logsumexp over the tp axis.
-
-    ``targets`` are global token ids aligned with logits positions."""
+    """Per-position next-token CE (B, S) over a vocab-sharded logits slice
+    (B, S, V_local) without materializing full logits: psum-logsumexp over
+    the tp axis. ``targets`` are global token ids aligned with positions."""
     my = jax.lax.axis_index(tp_axis)
     m_local = jnp.max(logits_local, axis=-1)
     # stop_gradient BEFORE pmax: the max shift is AD-invariant and pmax has
@@ -220,7 +231,16 @@ def tp_sharded_ce(
     t_clip = jnp.clip(t_local, 0, v_local - 1)
     picked = jnp.take_along_axis(logits_local, t_clip[..., None], axis=-1)[..., 0]
     correct = jax.lax.psum(jnp.where(in_range, picked, 0.0), tp_axis)
-    return jnp.mean(lse - correct)
+    return lse - correct
+
+
+def tp_sharded_ce(
+    logits_local: jax.Array, targets: jax.Array, tp_axis: str, v_local: int
+) -> jax.Array:
+    """Mean of :func:`tp_sharded_ce_terms` — the dp x tp loss."""
+    return jnp.mean(
+        tp_sharded_ce_terms(logits_local, targets, tp_axis, v_local)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -293,3 +313,100 @@ def make_tp_lm_train_step(
 
 def shard_tp_tokens(mesh: Mesh, tokens, dp_axis: str = "dp"):
     return shard_tokens_with_spec(mesh, tokens, P(dp_axis, None))
+
+
+# ---------------------------------------------------------------------------
+# the dp x tp x sp train step: compression x Megatron x sequence parallelism
+# ---------------------------------------------------------------------------
+
+
+def make_tp_sp_lm_train_step(
+    lm_config: dict,
+    optimizer,
+    mesh: Mesh,
+    state_specs: TrainState,
+    codec=None,
+    *,
+    dp_axis: str = "dp",
+    tp_axis: str = "tp",
+    sp_axis: str = "sp",
+    attn_impl: str = "ring",
+    compute_dtype=None,
+):
+    """Jitted (state, key, tokens) -> (state, metrics) over a 3-D mesh:
+    batch over dp, heads/hidden/vocab over tp, SEQUENCE over sp — the full
+    composition: each (tp, sp) shard computes ring (or Ulysses) attention
+    on its head slice of its sequence shard, the residual psums ride tp,
+    K/V rotation rides sp, and the ATOMO-compressed gradient exchange rides
+    dp with every chip encoding its own tp slice.
+
+    tokens are (B, S) sharded P(dp, sp); ``state``/``state_specs`` come
+    from create_tp_lm_state on the same mesh. Loss is the exact global
+    next-token CE (lm.py's boundary-exact handling, vocab-sharded over tp).
+
+    Gradient completion (see the dp x tp step + parallel.lm for the two
+    1-axis derivations): every loss->leaf path crosses exactly one sp psum
+    (the CE-sum) and one tp psum (block exits or the logsumexp), so
+    per-shard grads are uniformly n_tp*n_sp-scaled AND partial over sp;
+    completion = psum over sp always, psum over tp for tp-replicated
+    leaves, then divide everything by n_tp*n_sp.
+    """
+    from atomo_tpu.parallel.ring import ATTENTION_IMPLS
+
+    if attn_impl not in ATTENTION_IMPLS:
+        raise ValueError(
+            f"unknown attn_impl {attn_impl!r}; expected one of "
+            f"{sorted(ATTENTION_IMPLS)}"
+        )
+    n_dp = mesh.shape[dp_axis]
+    n_tp = mesh.shape[tp_axis]
+    n_sp = mesh.shape[sp_axis]
+    v_local = lm_config["vocab_size"] // n_tp
+    param_specs = state_specs.params
+
+    def spmd_step(state: TrainState, key, tokens):
+        s_local = tokens.shape[1]
+        my_dp = jax.lax.axis_index(dp_axis)
+        k_codec = jax.random.fold_in(jax.random.fold_in(key, state.step), my_dp)
+        attention_fn = partial(
+            ATTENTION_IMPLS[attn_impl], axis_name=sp_axis, axis_size=n_sp,
+            causal=True,
+        )
+
+        def loss_fn(params):
+            if compute_dtype is not None:
+                params = cast_params(params, compute_dtype)
+            logits_local = tp_lm_forward(
+                params, tokens, tp_axis=tp_axis,
+                pos_offset=jax.lax.axis_index(sp_axis) * s_local,
+                attention_fn=attention_fn,
+            )
+            if compute_dtype is not None:
+                logits_local = logits_local.astype(jnp.float32)
+            targets, valid = sp_boundary_targets_and_mask(
+                tokens, sp_axis, n_sp
+            )
+            ce = tp_sharded_ce_terms(logits_local, targets, tp_axis, v_local)
+            total = jax.lax.psum(jnp.sum(valid), sp_axis)
+            return jax.lax.psum(jnp.sum(ce * valid), sp_axis) / total
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        # completion per the docstring: sp-psum everything (params are
+        # sp-replicated), tp-psum the tp-replicated leaves, /(n_tp*n_sp)
+        grads = jax.lax.psum(grads, sp_axis)
+        grads = complete_model_axis_grads(
+            grads, param_specs, tp_axis, n_tp * n_sp
+        )
+        return compressed_dp_update(
+            optimizer, codec, state, k_codec, grads, loss,
+            dp_axis=dp_axis, n_dp=n_dp,
+        )
+
+    sharded = jax.shard_map(
+        spmd_step,
+        mesh=mesh,
+        in_specs=(state_specs, P(), P(dp_axis, sp_axis)),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))

@@ -158,3 +158,29 @@ def test_lm_subcommand_all_layouts(layout, extra, capsys):
 def test_lm_subcommand_rejects_bad_ways():
     with pytest.raises(SystemExit):
         main(["lm", "--layout", "dp-tp", "--ways", "3", "--n-devices", "4"])
+
+
+def test_lm_data_file_byte_corpus(tmp_path, capsys):
+    """--data-file trains on raw bytes of a real file (vocab 256)."""
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_bytes((b"the quick brown fox jumps over the lazy dog. " * 40))
+    rc = main([
+        "lm", "--layout", "dp", "--data-file", str(corpus),
+        "--vocab-size", "256", "--seq-len", "8", "--width", "16",
+        "--depth", "1", "--num-heads", "2", "--batch-size", "8",
+        "--max-steps", "2", "--log-interval", "1", "--n-devices", "2",
+        "--code", "svd", "--svd-rank", "2",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "PPL:" in out
+
+
+def test_lm_data_file_rejects_small_vocab(tmp_path):
+    corpus = tmp_path / "c.bin"
+    corpus.write_bytes(b"x" * 1000)
+    with pytest.raises(SystemExit, match="vocab-size"):
+        main([
+            "lm", "--data-file", str(corpus), "--vocab-size", "16",
+            "--seq-len", "8", "--n-devices", "2",
+        ])
