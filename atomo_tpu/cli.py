@@ -377,6 +377,7 @@ def cmd_lm(args: argparse.Namespace) -> int:
         depth=args.depth, num_heads=args.num_heads,
     )
     key = jax.random.PRNGKey(args.seed)
+    compute_dtype = jax.numpy.bfloat16 if args.bf16 else None
 
     # layout-inapplicable flags: warn, don't silently ignore (the train
     # subcommand's _warn_dead_flags precedent)
@@ -403,7 +404,8 @@ def cmd_lm(args: argparse.Namespace) -> int:
         state = create_state(TransformerLM(**cfg), optimizer, key, sample)
         state = replicate_state(mesh, state)
         step = make_lm_train_step(
-            cfg, optimizer, mesh, codec, attn_impl=args.attn_impl
+            cfg, optimizer, mesh, codec, attn_impl=args.attn_impl,
+            compute_dtype=compute_dtype,
         )
         shard = lambda t: shard_tokens(mesh, t)  # noqa: E731
     elif layout == "dp-tp":
@@ -416,7 +418,9 @@ def cmd_lm(args: argparse.Namespace) -> int:
             state, specs = create_tp_lm_state(mesh, cfg, optimizer, key)
         except ValueError as e:  # sizing errors -> clean one-liner
             raise SystemExit(str(e)) from None
-        step = make_tp_lm_train_step(cfg, optimizer, mesh, specs, codec)
+        step = make_tp_lm_train_step(
+            cfg, optimizer, mesh, specs, codec, compute_dtype=compute_dtype
+        )
         shard = lambda t: shard_tp_tokens(mesh, t)  # noqa: E731
     elif layout == "dp-ep":
         from atomo_tpu.parallel.moe import (
@@ -429,7 +433,9 @@ def cmd_lm(args: argparse.Namespace) -> int:
             state, specs = create_moe_lm_state(mesh, cfg, optimizer, key)
         except ValueError as e:
             raise SystemExit(str(e)) from None
-        step = make_moe_lm_train_step(cfg, optimizer, mesh, specs, codec)
+        step = make_moe_lm_train_step(
+            cfg, optimizer, mesh, specs, codec, compute_dtype=compute_dtype
+        )
         shard = lambda t: shard_moe_tokens(mesh, t)  # noqa: E731
     elif layout == "dp-pp":
         from atomo_tpu.parallel.pp import (
@@ -453,6 +459,7 @@ def cmd_lm(args: argparse.Namespace) -> int:
         step = make_pp_lm_train_step(
             cfg, optimizer, mesh, specs, codec,
             num_microbatches=args.microbatches,
+            compute_dtype=compute_dtype,
         )
         shard = lambda t: shard_pp_tokens(mesh, t)  # noqa: E731
     else:  # pragma: no cover - argparse choices guard this
@@ -576,6 +583,8 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("--shrinkage-freq", type=int, default=50)
     p_lm.add_argument("--optimizer", type=str, default="sgd")
     p_lm.add_argument("--code", type=str, default="svd")
+    p_lm.add_argument("--bf16", action="store_true", default=False,
+                      help="bfloat16 forward/backward, f32 master state")
     p_lm.add_argument("--svd-rank", type=int, default=3)
     p_lm.add_argument("--quantization-level", type=int, default=2)
     p_lm.add_argument("--bucket-size", type=int, default=512)

@@ -48,7 +48,7 @@ from atomo_tpu.parallel.common import (
     shard_tokens_with_spec,
 )
 from atomo_tpu.parallel.lm import compressed_dp_update
-from atomo_tpu.training.trainer import TrainState
+from atomo_tpu.training.trainer import TrainState, cast_params
 
 # ---------------------------------------------------------------------------
 # init
@@ -160,7 +160,10 @@ def moe_mlp(
     d3 = dispatch[:, :, None] * slot[:, None, :]  # (T, E, C)
     combine = d3 * gate[:, None, None]
 
-    inputs = jnp.einsum("tw,tec->ecw", x, d3)  # (E, C, W)
+    # dispatch/combine ride x's dtype so bf16 compute keeps the expert
+    # matmuls AND both all_to_all collectives in bf16 (routing math above
+    # stays f32); the one-hot structure is exact in any float dtype
+    inputs = jnp.einsum("tw,tec->ecw", x, d3.astype(x.dtype))  # (E, C, W)
     if ep_axis is not None:
         # dispatch collective: every chip keeps E/n expert rows and receives
         # the matching C-slot blocks from all n chips -> (E/n, n*C, W)
@@ -175,7 +178,7 @@ def moe_mlp(
         y = jax.lax.all_to_all(
             y, ep_axis, split_axis=1, concat_axis=0, tiled=True
         )
-    out = jnp.einsum("ecw,tec->tw", y, combine)
+    out = jnp.einsum("ecw,tec->tw", y, combine.astype(x.dtype))
 
     # switch aux loss: fraction routed x mean router prob, over local tokens
     f_e = jnp.mean(onehot, axis=0)
@@ -232,6 +235,7 @@ def make_moe_lm_train_step(
     ep_axis: str = "ep",
     capacity_factor: float = 1.25,
     aux_weight: float = 0.01,
+    compute_dtype=None,
 ):
     """Jitted (state, key, tokens) -> (state, metrics): switch-MoE LM with
     experts sharded over ep and ATOMO-compressed gradient exchange over dp.
@@ -252,9 +256,14 @@ def make_moe_lm_train_step(
         k_codec = jax.random.fold_in(jax.random.fold_in(key, state.step), my_dp)
 
         def loss_fn(params):
+            if compute_dtype is not None:
+                # bf16 MXU compute, f32 master state (training.trainer
+                # contract); router softmax and CE stay f32 internally
+                params = cast_params(params, compute_dtype)
             logits, aux = moe_lm_forward(
                 params, tokens, cfg, capacity=capacity, ep_axis=ep_axis
             )
+            logits = logits.astype(jnp.float32)
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1], tokens[:, 1:]
             )

@@ -45,7 +45,7 @@ from atomo_tpu.parallel.common import (
     shard_tokens_with_spec,
 )
 from atomo_tpu.parallel.lm import compressed_dp_update
-from atomo_tpu.training.trainer import TrainState
+from atomo_tpu.training.trainer import TrainState, cast_params
 
 # ---------------------------------------------------------------------------
 # params: blocks stacked on a leading depth axis (shardable over pp)
@@ -175,6 +175,7 @@ def make_pp_lm_train_step(
     dp_axis: str = "dp",
     pp_axis: str = "pp",
     num_microbatches: int = 2,
+    compute_dtype=None,
 ):
     """Jitted (state, key, tokens) -> (state, metrics): GPipe pipeline over
     pp with ATOMO-compressed gradient exchange over dp.
@@ -203,6 +204,11 @@ def make_pp_lm_train_step(
         k_codec = jax.random.fold_in(jax.random.fold_in(key, state.step), my_dp)
 
         def loss_fn(params):
+            if compute_dtype is not None:
+                # bf16 MXU compute, f32 master state; the scan carry
+                # (activations) rides the compute dtype
+                params = cast_params(params, compute_dtype)
+            act_dtype = compute_dtype or jnp.float32
             local_blocks = params["blocks"]  # (depth/n_pp, ...) slices
 
             def tick(carry, t):
@@ -214,7 +220,7 @@ def make_pp_lm_train_step(
                 y = _block_stack(local_blocks, x_in, cfg["num_heads"])
                 return jax.lax.ppermute(y, pp_axis, fwd_perm), y
 
-            acts0 = jnp.zeros((mb, s, cfg["width"]), jnp.float32)
+            acts0 = jnp.zeros((mb, s, cfg["width"]), act_dtype)
             _, ys = jax.lax.scan(
                 tick, acts0, jnp.arange(m + n_pp - 1)
             )
@@ -223,7 +229,7 @@ def make_pp_lm_train_step(
             # ticks' outputs are dropped instead of pushed through a masked
             # vocab matmul every tick
             y_live = ys[n_pp - 1 :].reshape(b_local, s, cfg["width"])
-            logits = _head(params, y_live)
+            logits = _head(params, y_live).astype(jnp.float32)
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits[:, :-1], tokens[:, 1:]
             )

@@ -55,7 +55,7 @@ from atomo_tpu.parallel.lm import (
     compressed_dp_update,
     sp_boundary_targets_and_mask,
 )
-from atomo_tpu.parallel.ring import full_attention
+from atomo_tpu.parallel.ring import ATTENTION_IMPLS, full_attention
 from atomo_tpu.training.trainer import TrainState, cast_params
 
 # ---------------------------------------------------------------------------
@@ -351,8 +351,6 @@ def make_tp_sp_lm_train_step(
     completion = psum over sp always, psum over tp for tp-replicated
     leaves, then divide everything by n_tp*n_sp.
     """
-    from atomo_tpu.parallel.ring import ATTENTION_IMPLS
-
     if attn_impl not in ATTENTION_IMPLS:
         raise ValueError(
             f"unknown attn_impl {attn_impl!r}; expected one of "

@@ -129,6 +129,7 @@ def test_tune_subcommand_smoke(capsys):
         ("dp-sp", ["--ways", "2", "--attn-impl", "ulysses"]),
         ("dp-sp", ["--ways", "2", "--attn-impl", "ulysses-flash"]),
         ("dp-tp", ["--ways", "2"]),
+        ("dp-tp", ["--ways", "2", "--bf16"]),
         ("dp-ep", ["--ways", "2", "--num-experts", "4"]),
         ("dp-pp", ["--ways", "2", "--microbatches", "2"]),
     ],

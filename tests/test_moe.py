@@ -188,3 +188,18 @@ def test_moe_rejects_indivisible_experts():
     bad = dict(CFG, num_experts=6)
     with pytest.raises(ValueError, match="num_experts"):
         create_moe_lm_state(mesh, bad, optax.sgd(0.1), jax.random.PRNGKey(0))
+
+
+def test_moe_bf16_step_runs_and_keeps_f32_state():
+    opt = optax.sgd(0.05, momentum=0.9)
+    mesh = make_mesh(8, axes=(("dp", 2), ("ep", 4)))
+    state, specs = create_moe_lm_state(mesh, CFG, opt, jax.random.PRNGKey(3))
+    step = make_moe_lm_train_step(
+        CFG, opt, mesh, specs, codec=SvdCodec(rank=2),
+        compute_dtype=jnp.bfloat16,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (8, 10), 0, 16)
+    state, m = step(state, jax.random.PRNGKey(1), shard_moe_tokens(mesh, tokens))
+    assert np.isfinite(float(m["loss"]))
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32

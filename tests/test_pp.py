@@ -104,3 +104,18 @@ def test_pp_rejects_indivisible_depth():
     bad = dict(CFG, depth=6)
     with pytest.raises(ValueError, match="depth"):
         create_pp_lm_state(mesh, bad, optax.sgd(0.1), jax.random.PRNGKey(0))
+
+
+def test_pp_bf16_step_runs_and_keeps_f32_state():
+    opt = optax.sgd(0.05, momentum=0.9)
+    mesh = make_mesh(8, axes=(("dp", 2), ("pp", 4)))
+    state, specs = create_pp_lm_state(mesh, CFG, opt, jax.random.PRNGKey(3))
+    step = make_pp_lm_train_step(
+        CFG, opt, mesh, specs, codec=SvdCodec(rank=2),
+        compute_dtype=jnp.bfloat16,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (8, 10), 0, 16)
+    state, m = step(state, jax.random.PRNGKey(1), shard_pp_tokens(mesh, tokens))
+    assert np.isfinite(float(m["loss"]))
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        assert leaf.dtype == jnp.float32
