@@ -50,9 +50,65 @@ from atomo_tpu.parallel.replicated import (  # noqa: E402
 from atomo_tpu.training import create_state, make_optimizer  # noqa: E402
 
 
+def _params_sha256(params) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(params):
+        h.update(np.asarray(jax.device_get(leaf)).tobytes())
+    return h.hexdigest()
+
+
+def main_lm() -> None:
+    """dp x sp LM mode (ATOMO_MP_MODE=lm): the SEQUENCE axis spans the two
+    processes (mesh rows = sp = process index), so ring attention's K/V
+    ppermutes and the boundary-target fetch cross a REAL process boundary
+    every step — the multi-host long-context claim, actually executed. The
+    dp pair (and its compressed gather) lives inside each process."""
+    from atomo_tpu.models.transformer import TransformerLM
+    from atomo_tpu.parallel.lm import make_lm_train_step
+
+    pid = jax.process_index()
+    mesh = global_mesh((("sp", 2), ("dp", 2)))  # sp major: rows = processes
+    cfg = dict(vocab_size=16, max_len=16, width=16, depth=1, num_heads=2)
+    opt = make_optimizer("sgd", lr=0.05, momentum=0.9)
+    sample = jnp.zeros((2, 16), jnp.int32)
+    state = replicate_state(
+        mesh, create_state(TransformerLM(**cfg), opt, jax.random.PRNGKey(0), sample)
+    )
+    step = make_lm_train_step(cfg, opt, mesh, SvdCodec(rank=2))
+
+    # both processes generate the SAME global batch (seed is shared); each
+    # contributes its own half of every sequence (its sp shard)
+    full = np.random.RandomState(42).randint(0, 16, (4, 16)).astype(np.int32)
+    local_toks = full[:, pid * 8 : (pid + 1) * 8]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    toks = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp", "sp")), local_toks
+    )
+    assert toks.shape == (4, 16), toks.shape
+    state, metrics = step(state, jax.random.PRNGKey(1), toks)
+    print(
+        "RESULT "
+        + json.dumps(
+            {
+                "pid": int(pid),
+                "loss": float(metrics["loss"]),
+                "msg_bytes": int(metrics["msg_bytes"]),
+                "params_sha256": _params_sha256(state.params),
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
     assert jax.process_count() == 2, f"process_count={jax.process_count()}"
     assert len(jax.devices()) == 4, f"global devices={len(jax.devices())}"
+    if os.environ.get("ATOMO_MP_MODE") == "lm":
+        main_lm()
+        return
     pid = jax.process_index()
 
     mesh = global_mesh((("dp", 4),))
@@ -77,11 +133,6 @@ def main() -> None:
     # fingerprint the post-step replicated params: a cryptographic hash of
     # the raw bytes — an L1-sum scalar would absorb sub-rounding or
     # compensating divergences and defeat the bit-for-bit claim
-    import hashlib
-
-    h = hashlib.sha256()
-    for leaf in jax.tree_util.tree_leaves(state.params):
-        h.update(np.asarray(jax.device_get(leaf)).tobytes())
     print(
         "RESULT "
         + json.dumps(
@@ -89,7 +140,7 @@ def main() -> None:
                 "pid": int(pid),
                 "loss": float(metrics["loss"]),
                 "msg_bytes": int(metrics["msg_bytes"]),
-                "params_sha256": h.hexdigest(),
+                "params_sha256": _params_sha256(state.params),
             }
         ),
         flush=True,

@@ -26,7 +26,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_compressed_step():
+def _run_two_process(mode: str):
     port = _free_port()
     env_base = {
         **os.environ,
@@ -34,6 +34,7 @@ def test_two_process_compressed_step():
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
         "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
         "JAX_NUM_PROCESSES": "2",
+        "ATOMO_MP_MODE": mode,
         # the workers import atomo_tpu from the repo root (pytest normally
         # injects it via rootdir conftest; a bare subprocess does not)
         "PYTHONPATH": _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -77,3 +78,15 @@ def test_two_process_compressed_step():
     assert r0["params_sha256"] == r1["params_sha256"], (r0, r1)
     # the codec actually ran: factor bytes, not dense bytes, on the wire
     assert 0 < r0["msg_bytes"] == r1["msg_bytes"]
+
+
+def test_two_process_compressed_step():
+    _run_two_process("cv")
+
+
+def test_two_process_lm_sequence_parallel_step():
+    """dp x sp over TWO real processes, sequence axis ACROSS the process
+    boundary: every ring-attention K/V rotation and the boundary-target
+    fetch is a cross-process ppermute — the multi-host long-context claim,
+    actually executed (see _mp_worker.main_lm)."""
+    _run_two_process("lm")
