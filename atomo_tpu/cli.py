@@ -115,6 +115,11 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                    help="capture a jax.profiler device trace of a few "
                         "steady-state steps into this dir (TensorBoard/XProf "
                         "loadable) — phase cost inside the fused program")
+    t.add_argument("--zero1", action="store_true", default=False,
+                   help="ZeRO-1 optimizer-state sharding: each dp chip "
+                        "holds 1/n of the flat momentum/Adam buffers, "
+                        "updates its slice, and one all_gather reassembles "
+                        "the replicated params (multi-device mesh only)")
     t.add_argument("--bf16", action="store_true", default=False,
                    help="mixed precision: forward/backward compute in "
                         "bfloat16 on the MXU (master params, optimizer "
@@ -275,7 +280,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         distributed_train_loop(
             model, optimizer, mesh, train_iter, test_iter,
             codec=codec, aggregate=args.aggregate, augment=augment,
-            num_aggregate=k_agg,
+            num_aggregate=k_agg, zero1=args.zero1,
             max_steps=max_steps, eval_freq=args.eval_freq, seed=args.seed,
             train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
             compress_ckpt=args.compress, log_every=args.log_interval,
@@ -292,6 +297,12 @@ def cmd_train(args: argparse.Namespace) -> int:
             warnings.warn(
                 "--num-aggregate needs a multi-device mesh; single-device "
                 "training has no replicas to subset — ignoring it"
+            )
+        if args.zero1:
+            warnings.warn(
+                "--zero1 needs a multi-device mesh; single-device training "
+                "has no dp axis to shard the optimizer state over — "
+                "ignoring it"
             )
         train_loop(
             model, optimizer, train_iter, test_iter,

@@ -94,6 +94,7 @@ def make_distributed_train_step(
     augment: bool = False,
     num_aggregate: int = 0,
     compute_dtype=None,
+    zero1_specs=None,
 ):
     """Build the jitted SPMD train step over ``mesh``.
 
@@ -107,6 +108,11 @@ def make_distributed_train_step(
     advertises but never implements (the master always waits for all
     workers, sync_replicas_master_nn.py:113,124 — SURVEY.md §2.1). 0 or
     >= N means aggregate all.
+
+    ``zero1_specs`` (from :func:`zero1_state`) switches the optimizer
+    update to ZeRO-1: state.opt_state holds this chip's 1/n slice of the
+    flat optimizer buffers; the update runs on the slice and one tiled
+    all_gather re-assembles the replicated params.
 
     Caveat (honest): as *straggler mitigation* this is semantics-only. The
     all_gather still moves all N payloads and the SPMD program still blocks
@@ -167,9 +173,28 @@ def make_distributed_train_step(
             else:
                 raise ValueError(f"unknown aggregate mode {aggregate!r}")
 
-        # replicated optimizer update == the PS-side momentum SGD step
-        updates, new_opt = optimizer.update(mean_grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if zero1_specs is None:
+            # replicated optimizer update == the PS-side momentum SGD step
+            updates, new_opt = optimizer.update(
+                mean_grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+        else:
+            # ZeRO-1: update only this chip's flat slice, all_gather params
+            from jax.flatten_util import ravel_pytree
+
+            flat_p, unravel = ravel_pytree(state.params)
+            flat_g, _ = ravel_pytree(mean_grads)
+            chunk = -(-flat_p.size // n_dev)
+            pad = chunk * n_dev - flat_p.size
+            p_pad = jnp.pad(flat_p, (0, pad))
+            g_pad = jnp.pad(flat_g, (0, pad))
+            p_sl = jax.lax.dynamic_slice(p_pad, (my * chunk,), (chunk,))
+            g_sl = jax.lax.dynamic_slice(g_pad, (my * chunk,), (chunk,))
+            updates, new_opt = optimizer.update(g_sl, state.opt_state, p_sl)
+            new_sl = optax.apply_updates(p_sl, updates)
+            new_flat = jax.lax.all_gather(new_sl, axis, tiled=True)
+            new_params = unravel(new_flat[: flat_p.size])
         # keep BN stats consistent across replicas (deviation note above)
         new_stats = jax.lax.pmean(new_stats, axis)
 
@@ -191,11 +216,18 @@ def make_distributed_train_step(
         )
         return new_state, metrics
 
+    state_spec = (
+        P()
+        if zero1_specs is None
+        else TrainState(
+            step=P(), params=P(), batch_stats=P(), opt_state=zero1_specs
+        )
+    )
     sharded = jax.shard_map(
         spmd_step,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis), P(axis)),
-        out_specs=(P(), P()),
+        in_specs=(state_spec, P(), P(axis), P(axis)),
+        out_specs=(state_spec, P()),
         # decoded-mean of identically gathered payloads is replicated by
         # construction; the vma tracker cannot see that through all_gather,
         # so replication checking is disabled (correctness is covered by
@@ -310,10 +342,14 @@ def make_phase_train_steps(
 
 
 def make_distributed_eval_step(model, mesh: Mesh, axis: str = "dp"):
-    def spmd_eval(state: TrainState, images, labels):
-        variables = {"params": state.params}
-        if jax.tree_util.tree_leaves(state.batch_stats):
-            variables["batch_stats"] = state.batch_stats
+    """Eval takes only (params, batch_stats) — NOT the whole TrainState —
+    so a ZeRO-1 run's dp-sharded optimizer buffers are never re-replicated
+    onto every chip just to be ignored by inference."""
+
+    def spmd_eval(params, batch_stats, images, labels):
+        variables = {"params": params}
+        if jax.tree_util.tree_leaves(batch_stats):
+            variables["batch_stats"] = batch_stats
         logits = model.apply(variables, images, train=False)
         loss = cross_entropy_loss(logits, labels)
         prec1, prec5 = accuracy(logits, labels)
@@ -327,7 +363,7 @@ def make_distributed_eval_step(model, mesh: Mesh, axis: str = "dp"):
         jax.shard_map(
             spmd_eval,
             mesh=mesh,
-            in_specs=(P(), P(axis), P(axis)),
+            in_specs=(P(), P(), P(axis), P(axis)),
             out_specs=P(),
             check_vma=False,
         )
@@ -360,6 +396,7 @@ def distributed_train_loop(
     profile_dir: Optional[str] = None,
     profile_steps: int = 3,
     compute_dtype=None,
+    zero1: bool = False,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -393,14 +430,59 @@ def distributed_train_loop(
         model, optimizer, jax.random.PRNGKey(seed), jnp.asarray(sample_images)
     )
     start_step = 0
-    if resume and train_dir and latest_step(train_dir) is not None:
-        state = load_checkpoint(train_dir, state)
-        start_step = int(state.step)
-        log_fn(f"Resumed from {train_dir} at step {start_step}")
-    state = replicate_state(mesh, state)
+    zero1_specs = None
+    want_resume = resume and train_dir and latest_step(train_dir) is not None
+    if zero1:
+        z_state, zero1_specs = zero1_state(mesh, state, optimizer)
+        if want_resume:
+            template = jax.device_get(z_state)
+            try:
+                # zero1-written checkpoint: flat sharded opt buffers restore
+                # straight into the zero1 template — momentum survives
+                restored = load_checkpoint(train_dir, template)
+            except Exception:
+                # replicated-layout checkpoint (pre-zero1 run): carry over
+                # params/stats/step, re-init the sharded opt state
+                import warnings
+
+                warnings.warn(
+                    "--zero1 resume from a replicated-layout checkpoint: "
+                    "params restored, optimizer state re-initialized sharded"
+                )
+                rep = load_checkpoint(train_dir, jax.device_get(state))
+                restored = TrainState(
+                    step=rep.step, params=rep.params,
+                    batch_stats=rep.batch_stats,
+                    opt_state=template.opt_state,
+                )
+            start_step = int(restored.step)
+            log_fn(f"Resumed from {train_dir} at step {start_step}")
+            opt_shardings = jax.tree_util.tree_map(
+                lambda sp: NamedSharding(mesh, sp), zero1_specs
+            )
+            z_state = TrainState(
+                step=jax.device_put(restored.step, replicated(mesh)),
+                params=jax.device_put(restored.params, replicated(mesh)),
+                batch_stats=jax.device_put(
+                    restored.batch_stats, replicated(mesh)
+                ),
+                opt_state=jax.device_put(restored.opt_state, opt_shardings),
+            )
+        state = z_state
+    else:
+        if want_resume:
+            state = load_checkpoint(train_dir, state)
+            start_step = int(state.step)
+            log_fn(f"Resumed from {train_dir} at step {start_step}")
+        state = replicate_state(mesh, state)
     if phase_metrics:
         import warnings
 
+        if zero1:
+            raise ValueError(
+                "--zero1 is not supported with --phase-metrics (the phased "
+                "update program assumes a replicated optimizer state)"
+            )
         if num_aggregate:
             warnings.warn(
                 "--phase-metrics uses full aggregation; ignoring --num-aggregate"
@@ -419,6 +501,7 @@ def distributed_train_loop(
         step_fn = make_distributed_train_step(
             model, optimizer, mesh, codec, aggregate=aggregate, augment=augment,
             num_aggregate=num_aggregate, compute_dtype=compute_dtype,
+            zero1_specs=zero1_specs,
         )
     eval_fn = make_distributed_eval_step(model, mesh) if test_iter is not None else None
     key = jax.random.PRNGKey(seed + 1)
@@ -575,7 +658,7 @@ def _distributed_steps(
                 if trim == 0:
                     continue
                 sti, stl = shard_batch(mesh, ti[:trim], tl[:trim])
-                m = eval_fn(state, sti, stl)
+                m = eval_fn(state.params, state.batch_stats, sti, stl)
                 for k_ in totals:
                     totals[k_] += float(m[k_]) * trim
                 n += trim
@@ -635,3 +718,52 @@ def shard_batch(mesh: Mesh, images, labels, axis: str = "dp"):
 
 def replicate_state(mesh: Mesh, state: TrainState) -> TrainState:
     return jax.device_put(state, replicated(mesh))
+
+
+def zero1_state(
+    mesh: Mesh, state: TrainState, optimizer, axis: str = "dp"
+) -> tuple[TrainState, Any]:
+    """ZeRO-1: replicated params, dp-SHARDED optimizer state.
+
+    The param tree is raveled into one flat vector, padded to a multiple of
+    the dp size, and the optimizer state is built on the per-chip CHUNK of
+    that vector — each chip holds 1/n of every momentum/mu/nu buffer (the
+    memory that dominates Adam training), updates only its slice each step,
+    and the updated param slices are re-assembled with one tiled all_gather
+    (params stay replicated). Requires an optimizer whose init is
+    value-independent on zeros (optax sgd/adam chains are — momenta start
+    at zero, counts at zero); elementwise updates make the sliced update
+    bit-equivalent to the replicated one (tested).
+
+    Returns (state, opt_specs); pass ``zero1_specs=opt_specs`` to
+    make_distributed_train_step. No reference analogue (the PS holds ONE
+    full momentum buffer on the master, optim/sgd.py:57-89; here even that
+    is sharded).
+    """
+    from jax.flatten_util import ravel_pytree
+
+    n = mesh.shape[axis]
+    flat, _ = ravel_pytree(state.params)
+    chunk = -(-flat.size // n)
+    local = optimizer.init(jnp.zeros((chunk,), flat.dtype))
+
+    def glob(leaf):
+        leaf = jnp.asarray(leaf)
+        if leaf.ndim == 0:  # counts etc.: replicated scalars
+            return jax.device_put(leaf, NamedSharding(mesh, P()))
+        # identical zero-init per shard; stored as one (n*chunk,) global
+        return jax.device_put(
+            jnp.tile(leaf, n), NamedSharding(mesh, P(axis))
+        )
+
+    opt_global = jax.tree_util.tree_map(glob, local)
+    opt_specs = jax.tree_util.tree_map(
+        lambda l: P(axis) if jnp.asarray(l).ndim else P(), local
+    )
+    new_state = TrainState(
+        step=jax.device_put(state.step, replicated(mesh)),
+        params=jax.device_put(state.params, replicated(mesh)),
+        batch_stats=jax.device_put(state.batch_stats, replicated(mesh)),
+        opt_state=opt_global,
+    )
+    return new_state, opt_specs

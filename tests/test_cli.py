@@ -185,3 +185,15 @@ def test_lm_data_file_rejects_small_vocab(tmp_path):
             "lm", "--data-file", str(corpus), "--vocab-size", "16",
             "--seq-len", "8", "--n-devices", "2",
         ])
+
+
+def test_train_zero1_multidevice(tmp_path, capsys):
+    rc = main([
+        "train", "--network", "LeNet", "--dataset", "MNIST", "--synthetic",
+        "--batch-size", "8", "--max-steps", "2", "--eval-freq", "0",
+        "--log-interval", "1", "--train-dir", str(tmp_path),
+        "--n-devices", "4", "--code", "svd", "--svd-rank", "2",
+        "--momentum", "0.9", "--zero1",
+    ])
+    assert rc == 0
+    assert "Step: 2" in capsys.readouterr().out
