@@ -115,6 +115,12 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                    help="capture a jax.profiler device trace of a few "
                         "steady-state steps into this dir (TensorBoard/XProf "
                         "loadable) — phase cost inside the fused program")
+    t.add_argument("--grad-accum", type=int, default=1, metavar="K",
+                   help="accumulate gradients over K microbatches per chip "
+                        "before the single encode/exchange: activation "
+                        "memory shrinks to one microbatch at fixed "
+                        "--batch-size; raise --batch-size K-fold to convert "
+                        "that into a K-fold per-sample comm reduction")
     t.add_argument("--zero1", action="store_true", default=False,
                    help="ZeRO-1 optimizer-state sharding: each dp chip "
                         "holds 1/n of the flat momentum/Adam buffers, "
@@ -281,6 +287,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             model, optimizer, mesh, train_iter, test_iter,
             codec=codec, aggregate=args.aggregate, augment=augment,
             num_aggregate=k_agg, zero1=args.zero1,
+            grad_accum=args.grad_accum,
             max_steps=max_steps, eval_freq=args.eval_freq, seed=args.seed,
             train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
             compress_ckpt=args.compress, log_every=args.log_interval,
@@ -303,6 +310,11 @@ def cmd_train(args: argparse.Namespace) -> int:
                 "--zero1 needs a multi-device mesh; single-device training "
                 "has no dp axis to shard the optimizer state over — "
                 "ignoring it"
+            )
+        if args.grad_accum > 1:
+            warnings.warn(
+                "--grad-accum is only wired into the multi-device step; "
+                "single-device training ignores it"
             )
         train_loop(
             model, optimizer, train_iter, test_iter,
@@ -402,6 +414,7 @@ def cmd_lm(args: argparse.Namespace) -> int:
                 f"ignored for --layout {layout}"
             )
 
+    specs = None  # stays None for replicated layouts; set by tp/ep/pp
     if layout in ("dp", "dp-sp"):
         from atomo_tpu.models.transformer import TransformerLM
         from atomo_tpu.parallel.lm import make_lm_train_step, shard_tokens
@@ -499,7 +512,31 @@ def cmd_lm(args: argparse.Namespace) -> int:
     import math
     import time
 
-    for i in range(1, args.max_steps + 1):
+    start = 0
+    if args.train_dir and args.resume:
+        from atomo_tpu.training.checkpoint import (
+            latest_step,
+            load_checkpoint,
+            load_sharded_checkpoint,
+        )
+        from atomo_tpu.parallel.mesh import replicated as _replicated
+
+        if latest_step(args.train_dir) is not None:
+            template = jax.device_get(state)
+            if specs is None:
+                state = jax.device_put(
+                    load_checkpoint(args.train_dir, template),
+                    _replicated(mesh),
+                )
+            else:
+                state = load_sharded_checkpoint(
+                    args.train_dir, template, mesh, specs
+                )
+            start = int(state.step)
+            print(f"Resumed from {args.train_dir} at step {start}", flush=True)
+
+    save_freq = args.save_freq
+    for i in range(start + 1, args.max_steps + 1):
         t0 = time.time()
         state, metrics = step(state, jax.random.fold_in(key, i), next_batch())
         loss = float(metrics["loss"])  # device sync: honest step timing
@@ -512,6 +549,12 @@ def cmd_lm(args: argparse.Namespace) -> int:
                 f"Dense(MB): {float(metrics['dense_bytes']) / 1e6:.4f}",
                 flush=True,
             )
+        if args.train_dir and (
+            (save_freq and i % save_freq == 0) or i == args.max_steps
+        ):
+            from atomo_tpu.training.checkpoint import save_checkpoint
+
+            save_checkpoint(args.train_dir, state, compress=args.compress)
     return 0
 
 
@@ -596,6 +639,17 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("--code", type=str, default="svd")
     p_lm.add_argument("--bf16", action="store_true", default=False,
                       help="bfloat16 forward/backward, f32 master state")
+    p_lm.add_argument("--train-dir", type=str, default="",
+                      help="checkpoint dir (model_step_N naming); empty = "
+                           "no checkpoints")
+    p_lm.add_argument("--save-freq", type=int, default=0,
+                      help="checkpoint every N steps (0 = only at the end)")
+    p_lm.add_argument("--resume", action="store_true", default=False,
+                      help="resume from the latest checkpoint in --train-dir "
+                           "(model-sharded states restore onto their mesh "
+                           "shardings)")
+    p_lm.add_argument("--compress", action="store_true", default=False,
+                      help="lossless-compress checkpoints (C++ native codec)")
     p_lm.add_argument("--svd-rank", type=int, default=3)
     p_lm.add_argument("--quantization-level", type=int, default=2)
     p_lm.add_argument("--bucket-size", type=int, default=512)

@@ -95,6 +95,7 @@ def make_distributed_train_step(
     num_aggregate: int = 0,
     compute_dtype=None,
     zero1_specs=None,
+    grad_accum: int = 1,
 ):
     """Build the jitted SPMD train step over ``mesh``.
 
@@ -109,6 +110,14 @@ def make_distributed_train_step(
     workers, sync_replicas_master_nn.py:113,124 — SURVEY.md §2.1). 0 or
     >= N means aggregate all.
 
+    ``grad_accum`` > 1 splits each chip's batch into that many microbatches
+    and accumulates their gradients in a ``lax.scan`` BEFORE the (single)
+    encode/exchange. At a FIXED per-chip batch this cuts activation memory
+    to one microbatch; the per-sample communication win appears when the
+    freed memory is spent on a K-fold larger --batch-size (same exchanges
+    per step, K x the samples). BatchNorm running stats update sequentially
+    per microbatch (documented deviation from one big batch).
+
     ``zero1_specs`` (from :func:`zero1_state`) switches the optimizer
     update to ZeRO-1: state.opt_state holds this chip's 1/n slice of the
     flat optimizer buffers; the update runs on the slice and one tiled
@@ -122,6 +131,8 @@ def make_distributed_train_step(
     within SPMD the honest wins are the smaller decode cost and the
     gradient-subsetting *noise* semantics, not wall-clock.
     """
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     n_dev = mesh.shape[axis]
     k_agg = num_aggregate if 0 < num_aggregate < n_dev else 0
     if k_agg and (codec is None or aggregate != "gather"):
@@ -138,9 +149,52 @@ def make_distributed_train_step(
         k_aug, k_drop, k_codec = jax.random.split(jax.random.fold_in(step_key, my), 3)
         if augment:
             images = augment_batch(k_aug, images)
-        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+        grad_fn = jax.value_and_grad(
             partial(_loss_fn, model, compute_dtype=compute_dtype), has_aux=True
-        )(state.params, state.batch_stats, images, labels, k_drop)
+        )
+        if grad_accum <= 1:
+            (loss, (logits, new_stats)), grads = grad_fn(
+                state.params, state.batch_stats, images, labels, k_drop
+            )
+            prec1, prec5 = accuracy(logits, labels)
+        else:
+            b_local = images.shape[0]
+            if b_local % grad_accum:
+                raise ValueError(
+                    f"per-chip batch {b_local} not divisible by "
+                    f"grad_accum={grad_accum}"
+                )
+            mb = b_local // grad_accum
+            im_s = images.reshape(grad_accum, mb, *images.shape[1:])
+            lb_s = labels.reshape(grad_accum, mb)
+
+            def acc_body(carry, xs):
+                stats_c, g_sum, loss_sum, p1_sum, p5_sum = carry
+                idx, mb_im, mb_lb = xs
+                (l, (lg, stats_n)), g = grad_fn(
+                    state.params, stats_c, mb_im, mb_lb,
+                    jax.random.fold_in(k_drop, idx),
+                )
+                p1, p5 = accuracy(lg, mb_lb)
+                g_sum = jax.tree_util.tree_map(jnp.add, g_sum, g)
+                return (
+                    stats_n, g_sum, loss_sum + l, p1_sum + p1, p5_sum + p5
+                ), None
+
+            zeros_g = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            (new_stats, g_sum, loss_sum, p1_sum, p5_sum), _ = jax.lax.scan(
+                acc_body,
+                (
+                    state.batch_stats, zeros_g,
+                    jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0),
+                ),
+                (jnp.arange(grad_accum), im_s, lb_s),
+            )
+            grads = jax.tree_util.tree_map(
+                lambda g: g / grad_accum, g_sum
+            )
+            loss = loss_sum / grad_accum
+            prec1, prec5 = p1_sum / grad_accum, p5_sum / grad_accum
 
         dense_bytes = tree_nbytes(grads)
         if codec is None:
@@ -198,7 +252,6 @@ def make_distributed_train_step(
         # keep BN stats consistent across replicas (deviation note above)
         new_stats = jax.lax.pmean(new_stats, axis)
 
-        prec1, prec5 = accuracy(logits, labels)
         metrics = {
             "loss": jax.lax.pmean(loss, axis),
             "prec1": jax.lax.pmean(prec1, axis),
@@ -397,6 +450,7 @@ def distributed_train_loop(
     profile_steps: int = 3,
     compute_dtype=None,
     zero1: bool = False,
+    grad_accum: int = 1,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -483,6 +537,11 @@ def distributed_train_loop(
                 "--zero1 is not supported with --phase-metrics (the phased "
                 "update program assumes a replicated optimizer state)"
             )
+        if grad_accum > 1:
+            raise ValueError(
+                "--grad-accum is not supported with --phase-metrics (the "
+                "phase split assumes one fused compute program)"
+            )
         if num_aggregate:
             warnings.warn(
                 "--phase-metrics uses full aggregation; ignoring --num-aggregate"
@@ -501,7 +560,7 @@ def distributed_train_loop(
         step_fn = make_distributed_train_step(
             model, optimizer, mesh, codec, aggregate=aggregate, augment=augment,
             num_aggregate=num_aggregate, compute_dtype=compute_dtype,
-            zero1_specs=zero1_specs,
+            zero1_specs=zero1_specs, grad_accum=grad_accum,
         )
     eval_fn = make_distributed_eval_step(model, mesh) if test_iter is not None else None
     key = jax.random.PRNGKey(seed + 1)

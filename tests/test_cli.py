@@ -197,3 +197,21 @@ def test_train_zero1_multidevice(tmp_path, capsys):
     ])
     assert rc == 0
     assert "Step: 2" in capsys.readouterr().out
+
+
+def test_lm_checkpoint_resume_sharded_layout(tmp_path, capsys):
+    """lm --train-dir/--resume round-trips a MODEL-SHARDED (dp-tp) state:
+    the checkpoint gathers from sharded buffers and restores onto the mesh
+    shardings via load_sharded_checkpoint's shard_state path."""
+    common = [
+        "lm", "--layout", "dp-tp", "--ways", "2", "--vocab-size", "16",
+        "--seq-len", "8", "--width", "16", "--depth", "1", "--num-heads", "2",
+        "--batch-size", "8", "--log-interval", "1", "--n-devices", "4",
+        "--code", "svd", "--svd-rank", "2", "--train-dir", str(tmp_path),
+    ]
+    assert main([*common, "--max-steps", "2"]) == 0
+    assert (tmp_path / "model_step_2").exists()
+    assert main([*common, "--max-steps", "4", "--resume"]) == 0
+    out = capsys.readouterr().out
+    assert "Resumed from" in out and "Step: 4" in out
+    assert (tmp_path / "model_step_4").exists()

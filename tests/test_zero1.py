@@ -136,3 +136,44 @@ def test_zero1_checkpoint_resume_preserves_momentum(tmp_path):
         if getattr(l, "ndim", 0) == 1
     ]
     assert momenta and any(float(np.abs(np.asarray(m)).max()) > 0 for m in momenta)
+
+
+@pytest.mark.parametrize("use_codec", [False, True])
+def test_grad_accum_matches_full_batch(use_codec):
+    """grad_accum=2 on a BN-free model == one full-batch step: the mean of
+    per-microbatch gradients equals the full-batch gradient, so the update
+    is identical (codec sees the identical accumulated gradient)."""
+    opt = make_optimizer("sgd", lr=0.05, momentum=0.9)
+    codec = SvdCodec(rank=2) if use_codec else None
+    mesh, model, state0, images, labels = _setup(opt)
+    si, sl = shard_batch(mesh, images, labels)
+    copy = lambda s: jax.tree_util.tree_map(lambda x: jnp.array(x), s)  # noqa: E731
+
+    full = replicate_state(mesh, copy(state0))
+    full_step = make_distributed_train_step(model, opt, mesh, codec)
+    acc = replicate_state(mesh, copy(state0))
+    acc_step = make_distributed_train_step(
+        model, opt, mesh, codec, grad_accum=2
+    )
+    key = jax.random.PRNGKey(5)
+    full, mf = full_step(full, key, si, sl)
+    acc, ma = acc_step(acc, key, si, sl)
+    np.testing.assert_allclose(float(mf["loss"]), float(ma["loss"]), atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
+            atol=2e-6,
+        ),
+        jax.device_get(full.params),
+        jax.device_get(acc.params),
+    )
+
+
+def test_grad_accum_rejects_indivisible():
+    opt = make_optimizer("sgd", lr=0.05)
+    mesh, model, state0, images, labels = _setup(opt)
+    si, sl = shard_batch(mesh, images, labels)
+    step = make_distributed_train_step(model, opt, mesh, None, grad_accum=3)
+    state = replicate_state(mesh, state0)
+    with pytest.raises(ValueError, match="grad_accum"):
+        step(state, jax.random.PRNGKey(0), si, sl)
