@@ -74,7 +74,7 @@ CONFIGS = {
     2: dict(metric="resnet18_cifar10_svd3_step_time", network="resnet18",
             input=(32, 32, 3), batch=128, code="svd", rank=3, ways=8,
             torch_baseline=True, dense_compare=True, qsgd_compare=True,
-            bf16_compare=True),
+            bf16_compare=True, attn_compare=True),
     3: dict(metric="vgg11_cifar10_svd5_step_time", network="vgg11",
             input=(32, 32, 3), batch=128, code="svd", rank=5, ways=16,
             dense_compare=True),
@@ -242,16 +242,35 @@ def measure_ours(cfg: dict) -> dict:
         timing="scan-fenced",  # value = device time of a scanned step loop
     )
 
+    if cfg.get("attn_compare") and dev.platform == "tpu":
+        attn_res = _flash_attention_compare()
+        out.update(attn_res)
+        if "attn_flash_error" in attn_res:
+            # same discipline as the QSGD compare: a Mosaic compile failure
+            # of an advertised production path fails the metric; append to
+            # (never overwrite) any earlier reason
+            out["measurement_valid"] = False
+            reason = (
+                "flash attention pallas path failed: "
+                + attn_res["attn_flash_error"]
+            )
+            prior = out.get("invalid_reason")
+            out["invalid_reason"] = f"{prior}; {reason}" if prior else reason
+
     if cfg.get("qsgd_compare") and dev.platform == "tpu":
         cmp_res = _qsgd_encode_compare()
         out.update(cmp_res)
         if "qsgd_encode_error" in cmp_res:
             # a compile failure of the advertised production path is a
-            # FAILED metric, not a footnote (VERDICT r2 weak #2)
+            # FAILED metric, not a footnote (VERDICT r2 weak #2); append to
+            # any earlier reason rather than overwriting it
             out["measurement_valid"] = False
-            out["invalid_reason"] = (
+            reason = (
                 "production QSGD pallas path failed: " + cmp_res["qsgd_encode_error"]
             )
+            prior = out.get("invalid_reason")
+            out["invalid_reason"] = f"{prior}; {reason}" if prior else reason
+
 
     if cfg.get("bf16_compare"):
         # the TPU-native mixed-precision mode (no reference analogue): same
@@ -292,6 +311,62 @@ def measure_ours(cfg: dict) -> dict:
             )
 
     return out
+
+
+def _flash_attention_compare() -> dict:
+    """Fused-Pallas flash attention vs the jnp blockwise oracle on an
+    LM-sized causal forward (TPU only; same per-path try discipline as the
+    QSGD compare). Shapes: (B=4, H=8, S=2048, D=64) f32 — ~4.3 GFLOP of
+    attention per call."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.ops.attention_kernels import flash_attention
+    from atomo_tpu.parallel.ring import blockwise_attention
+
+    b, h, sq, d = 4, 8, 2048, 64
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, sq, d), jnp.float32) for kk in ks)
+    reps = 10
+    res = {}
+    impls = {
+        "flash": lambda q, k, v: flash_attention(
+            q, k, v, causal=True, interpret=False
+        ),
+        "jnp": lambda q, k, v: blockwise_attention(q, k, v, causal=True),
+    }
+    for tag, fn in impls.items():
+        try:
+
+            @jax.jit
+            def many(q, k, v, f=fn):
+                def body(acc, i):
+                    o = f(q + acc * 1e-9, k, v)  # serialize iterations
+                    # consume EVERY output element: a single-position fetch
+                    # would let XLA prune most of the jnp oracle's work
+                    # while the opaque Pallas call runs in full
+                    return jnp.float32(jnp.sum(o) * 1e-9), None
+
+                acc, _ = jax.lax.scan(
+                    body, jnp.float32(0), jnp.arange(reps)
+                )
+                return acc
+
+            float(many(q, k, v))  # compile + warm
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                sync = float(many(q, k, v))
+                best = min(best, (time.perf_counter() - t0) / reps)
+                if not math.isfinite(sync):
+                    raise RuntimeError(f"{tag} attention scalar not finite")
+            res[f"attn_{tag}_ms"] = round(best * 1e3, 3)
+        except Exception as exc:  # noqa: BLE001
+            if tag == "flash":
+                res["attn_flash_error"] = str(exc)[:200]
+            else:
+                res["attn_jnp_error"] = str(exc)[:200]
+    return res
 
 
 def _qsgd_encode_compare() -> dict:
