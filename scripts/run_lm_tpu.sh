@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Canonical long-context LM recipe — no reference analogue (the reference
+# is DP-only and CV-only, SURVEY.md §2.1/§5.7). Trains the transformer LM
+# with ATOMO-compressed gradient exchange over dp composed with a model-
+# sharding axis chosen by LAYOUT:
+#
+#   LAYOUT=dp       pure compressed data parallelism (default)
+#   LAYOUT=dp-sp    ring attention sequence parallelism (ATTN=ulysses or
+#                   ulysses-flash for the all-to-all / fused-kernel variants)
+#   LAYOUT=dp-tp    Megatron tensor parallelism
+#   LAYOUT=dp-ep    switch-MoE expert parallelism
+#   LAYOUT=dp-pp    GPipe pipeline parallelism
+#
+# WAYS sizes the model axis; the rest of the chips form the dp axis.
+set -euo pipefail
+
+python -m atomo_tpu lm \
+  --layout "${LAYOUT:-dp}" \
+  --ways "${WAYS:-2}" \
+  --attn-impl "${ATTN:-ring}" \
+  --vocab-size 256 \
+  --seq-len "${SEQ_LEN:-1024}" \
+  --width 256 \
+  --depth 4 \
+  --num-heads 4 \
+  --batch-size "${BATCH:-16}" \
+  --max-steps "${MAX_STEPS:-1000}" \
+  --log-interval 10 \
+  --code svd \
+  --svd-rank 3 \
+  --lr 0.1 \
+  --momentum 0.9 \
+  --train-dir "${TRAIN_DIR:-output/lm/}" \
+  --save-freq 100 \
+  "$@"
