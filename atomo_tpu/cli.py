@@ -490,24 +490,89 @@ def cmd_lm(args: argparse.Namespace) -> int:
         raise SystemExit(f"unknown --layout {layout}")
 
     rng = np.random.default_rng(args.seed)
+
+    def _synth(r, n):
+        starts = r.integers(0, args.vocab_size, size=(n, 1))
+        strides = r.integers(1, 4, size=(n, 1))
+        return (
+            (starts + strides * np.arange(args.seq_len)) % args.vocab_size
+        ).astype(np.int32)
+
     if raw is not None:
         # byte-level corpus: the file's raw bytes are the token stream,
-        # chunked into seq_len windows (validated above)
+        # chunked into seq_len windows (validated above); the LAST 10% of
+        # chunks are held out for --eval-freq validation
         n_seq = len(raw) // args.seq_len
         chunks = raw[: n_seq * args.seq_len].reshape(n_seq, args.seq_len)
+        n_hold = max(1, n_seq // 10) if args.eval_freq else 0
+        train_chunks = chunks[: n_seq - n_hold] if n_hold else chunks
+        eval_tokens = chunks[n_seq - n_hold :].astype(np.int32) if n_hold else None
+        if len(train_chunks) < args.batch_size:
+            raise SystemExit(
+                f"--data-file leaves only {len(train_chunks)} training "
+                f"sequences after the --eval-freq holdout ({n_hold}); need "
+                f"at least --batch-size {args.batch_size}"
+            )
 
         def next_batch():
-            idx = rng.integers(0, n_seq, size=args.batch_size)
-            return shard(chunks[idx].astype(np.int32))
+            idx = rng.integers(0, len(train_chunks), size=args.batch_size)
+            return shard(train_chunks[idx].astype(np.int32))
 
     else:
         # deterministic learnable token streams: arithmetic progressions
-        # with random starts/strides (the LM data analogue of --synthetic)
+        # with random starts/strides (the LM data analogue of --synthetic);
+        # eval uses an independent stream of the same distribution
+        eval_tokens = (
+            _synth(np.random.default_rng(args.seed + 10_000), args.batch_size)
+            if args.eval_freq
+            else None
+        )
+
         def next_batch():
-            starts = rng.integers(0, args.vocab_size, size=(args.batch_size, 1))
-            strides = rng.integers(1, 4, size=(args.batch_size, 1))
-            seq = (starts + strides * np.arange(args.seq_len)) % args.vocab_size
-            return shard(seq.astype(np.int32))
+            return shard(_synth(rng, args.batch_size))
+
+    def eval_ppl(state) -> float:
+        """Held-out mean CE via the layout's SINGLE-DEVICE oracle forward on
+        the gathered params — uniform across layouts, no extra jitted
+        program (eval batches are small)."""
+        import optax as _optax
+
+        toks = jax.numpy.asarray(eval_tokens[: args.batch_size])
+        params = jax.device_get(state.params)
+        if layout == "dp-tp":
+            from atomo_tpu.models.transformer import TransformerLM
+            from atomo_tpu.parallel.tp import tp_params_to_lm
+
+            logits = TransformerLM(**cfg).apply(
+                {"params": tp_params_to_lm(params, cfg["num_heads"])}, toks
+            )
+        elif layout == "dp-ep":
+            import math as _math
+
+            from atomo_tpu.parallel.moe import moe_lm_forward
+
+            # capacity over the tokens actually in THIS forward (the whole
+            # eval batch runs on one "chip"), not the per-chip training
+            # count — a smaller budget would drop extra tokens and bias
+            # the reported loss upward
+            t_eval = toks.shape[0] * args.seq_len
+            capp = max(
+                1, _math.ceil(1.25 * t_eval / cfg["num_experts"])
+            )
+            logits, _ = moe_lm_forward(params, toks, cfg, capacity=capp)
+        elif layout == "dp-pp":
+            from atomo_tpu.parallel.pp import pp_lm_forward_reference
+
+            logits = pp_lm_forward_reference(params, toks, cfg)
+        else:
+            from atomo_tpu.models.transformer import TransformerLM
+
+            logits = TransformerLM(**cfg).apply({"params": params}, toks)
+        return float(
+            _optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], toks[:, 1:]
+            ).mean()
+        )
 
     import math
     import time
@@ -547,6 +612,13 @@ def cmd_lm(args: argparse.Namespace) -> int:
                 f"Time Cost: {time.time() - t0:.4f}, "
                 f"Msg(MB): {float(metrics['msg_bytes']) / 1e6:.4f}, "
                 f"Dense(MB): {float(metrics['dense_bytes']) / 1e6:.4f}",
+                flush=True,
+            )
+        if args.eval_freq and i % args.eval_freq == 0:
+            vl = eval_ppl(state)
+            print(
+                f"LM Validation: Step: {i}, Loss: {vl:.4f}, "
+                f"PPL: {math.exp(min(vl, 30.0)):.2f}",
                 flush=True,
             )
         if args.train_dir and (
@@ -639,6 +711,12 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("--code", type=str, default="svd")
     p_lm.add_argument("--bf16", action="store_true", default=False,
                       help="bfloat16 forward/backward, f32 master state")
+    p_lm.add_argument("--eval-freq", type=int, default=0,
+                      help="validation PPL every N steps on held-out data "
+                           "(last 10%% of --data-file chunks, or a fresh "
+                           "synthetic stream); 0 = off. Runs the layout's "
+                           "single-device oracle forward on the gathered "
+                           "params")
     p_lm.add_argument("--train-dir", type=str, default="",
                       help="checkpoint dir (model_step_N naming); empty = "
                            "no checkpoints")

@@ -170,11 +170,13 @@ def test_lm_data_file_byte_corpus(tmp_path, capsys):
         "--vocab-size", "256", "--seq-len", "8", "--width", "16",
         "--depth", "1", "--num-heads", "2", "--batch-size", "8",
         "--max-steps", "2", "--log-interval", "1", "--n-devices", "2",
-        "--code", "svd", "--svd-rank", "2",
+        "--code", "svd", "--svd-rank", "2", "--eval-freq", "2",
     ])
     assert rc == 0
     out = capsys.readouterr().out
     assert "PPL:" in out
+    # --eval-freq with --data-file: held-out chunks (last 10%) validate
+    assert "LM Validation: Step: 2" in out
 
 
 def test_lm_data_file_rejects_small_vocab(tmp_path):
@@ -215,3 +217,31 @@ def test_lm_checkpoint_resume_sharded_layout(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "Resumed from" in out and "Step: 4" in out
     assert (tmp_path / "model_step_4").exists()
+
+
+@pytest.mark.parametrize(
+    "layout,extra",
+    [
+        ("dp", []),
+        ("dp-tp", ["--ways", "2"]),
+        ("dp-ep", ["--ways", "2", "--num-experts", "4"]),
+        ("dp-pp", ["--ways", "2", "--microbatches", "2"]),
+    ],
+)
+def test_lm_eval_freq_prints_validation(layout, extra, capsys):
+    """--eval-freq prints a held-out validation line for every layout via
+    its single-device oracle forward on the gathered params."""
+    rc = main([
+        "lm", "--layout", layout, "--vocab-size", "16", "--seq-len", "8",
+        "--width", "16", "--depth", "2", "--num-heads", "2",
+        "--batch-size", "8", "--max-steps", "2", "--log-interval", "2",
+        "--n-devices", "4", "--code", "svd", "--svd-rank", "2",
+        "--eval-freq", "2", *extra,
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "LM Validation: Step: 2" in out
+    import re
+
+    vls = [float(m) for m in re.findall(r"Validation: Step: 2, Loss: ([0-9.]+)", out)]
+    assert vls and all(v == v for v in vls)
