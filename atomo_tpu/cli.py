@@ -349,6 +349,11 @@ def cmd_lm(args: argparse.Namespace) -> int:
     launch.initialize()
     n_dev = args.n_devices or len(jax.devices())
     layout = args.layout
+    if layout == "dp" and args.ways != 2:  # 2 is the argparse default
+        warnings.warn(
+            f"--ways {args.ways} only applies to layouts with a model axis; "
+            "--layout dp is pure data parallelism — ignoring it"
+        )
     ways = 1 if layout == "dp" else args.ways
     if n_dev % ways:
         raise SystemExit(f"--ways {ways} does not divide {n_dev} devices")

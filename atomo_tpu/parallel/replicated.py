@@ -59,6 +59,14 @@ from atomo_tpu.training.trainer import (
 from atomo_tpu.utils.metrics import accuracy
 
 
+def _zero1_chunk(flat_size: int, n_dev: int) -> int:
+    """Per-chip slice length of the flat ZeRO-1 buffers. ONE definition:
+    the train step's dynamic slices and zero1_state's allocations must
+    agree exactly or every momentum slice silently misaligns with its
+    parameter slice."""
+    return -(-flat_size // n_dev)
+
+
 def _loss_fn(model, params, batch_stats, images, labels, dropout_key,
              compute_dtype=None):
     if compute_dtype is not None:
@@ -239,7 +247,7 @@ def make_distributed_train_step(
 
             flat_p, unravel = ravel_pytree(state.params)
             flat_g, _ = ravel_pytree(mean_grads)
-            chunk = -(-flat_p.size // n_dev)
+            chunk = _zero1_chunk(flat_p.size, n_dev)
             pad = chunk * n_dev - flat_p.size
             p_pad = jnp.pad(flat_p, (0, pad))
             g_pad = jnp.pad(flat_g, (0, pad))
@@ -490,23 +498,43 @@ def distributed_train_loop(
         z_state, zero1_specs = zero1_state(mesh, state, optimizer)
         if want_resume:
             template = jax.device_get(z_state)
-            try:
-                # zero1-written checkpoint: flat sharded opt buffers restore
-                # straight into the zero1 template — momentum survives
-                restored = load_checkpoint(train_dir, template)
-            except Exception:
-                # replicated-layout checkpoint (pre-zero1 run): carry over
-                # params/stats/step, re-init the sharded opt state
+            # flax's from_state_dict does NOT raise on layout mismatch (it
+            # silently returns whatever tree the checkpoint held), so the
+            # zero1-vs-replicated decision needs an explicit structure AND
+            # shape check against the template — not a try/except
+            restored = load_checkpoint(train_dir, template)
+
+            def _layout_matches(a, b) -> bool:
+                ta = jax.tree_util.tree_structure(a)
+                tb = jax.tree_util.tree_structure(b)
+                if ta != tb:
+                    return False
+                return all(
+                    jnp.shape(x) == jnp.shape(y)
+                    for x, y in zip(
+                        jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b),
+                    )
+                )
+
+            if not _layout_matches(restored.opt_state, template.opt_state):
+                # replicated-layout checkpoint (or a zero1 one written on a
+                # different device count): params-only restore, re-init the
+                # sharded opt state
                 import warnings
 
+                from atomo_tpu.training.checkpoint import load_params
+
                 warnings.warn(
-                    "--zero1 resume from a replicated-layout checkpoint: "
-                    "params restored, optimizer state re-initialized sharded"
+                    "--zero1 resume: checkpoint optimizer layout does not "
+                    "match this mesh's zero1 layout; params restored, "
+                    "optimizer state re-initialized sharded"
                 )
-                rep = load_checkpoint(train_dir, jax.device_get(state))
+                ck_step, ck_params, ck_stats = load_params(train_dir, template)
                 restored = TrainState(
-                    step=rep.step, params=rep.params,
-                    batch_stats=rep.batch_stats,
+                    step=jnp.asarray(ck_step, jnp.int32),
+                    params=ck_params,
+                    batch_stats=ck_stats,
                     opt_state=template.opt_state,
                 )
             start_step = int(restored.step)
@@ -803,7 +831,7 @@ def zero1_state(
 
     n = mesh.shape[axis]
     flat, _ = ravel_pytree(state.params)
-    chunk = -(-flat.size // n)
+    chunk = _zero1_chunk(flat.size, n)
     local = optimizer.init(jnp.zeros((chunk,), flat.dtype))
 
     def glob(leaf):

@@ -181,7 +181,8 @@ def measure_ours(cfg: dict) -> dict:
         m = None
         for _ in range(WARMUP):
             st, m = step_fn(st, key, images, labels)
-        float(m["loss"])  # drain warmup + per-step compile
+        if m is not None:  # WARMUP can be 0 via ATOMO_BENCH_WARMUP
+            float(m["loss"])  # drain warmup + per-step compile
         t0 = time.perf_counter()
         for _ in range(STEPS):
             st, m = step_fn(st, key, images, labels)

@@ -177,3 +177,37 @@ def test_grad_accum_rejects_indivisible():
     state = replicate_state(mesh, state0)
     with pytest.raises(ValueError, match="grad_accum"):
         step(state, jax.random.PRNGKey(0), si, sl)
+
+
+def test_zero1_resume_from_replicated_checkpoint(tmp_path):
+    """Resuming --zero1 from a checkpoint written WITHOUT zero1: flax's
+    restore does not raise on layout mismatch, so the loop must detect it
+    structurally — params restore, sharded opt state re-initializes, and a
+    warning names the layout mismatch (regression: this path used to crash
+    in device_put with an opaque pytree error)."""
+    import warnings as _w
+
+    from atomo_tpu.data import SPECS, BatchIterator, synthetic_dataset
+    from atomo_tpu.parallel.replicated import distributed_train_loop
+
+    def run(max_steps, resume, zero1):
+        mesh = make_mesh(4)
+        model = get_model("lenet", 10)
+        opt = make_optimizer("sgd", lr=0.05, momentum=0.9)
+        it = BatchIterator(synthetic_dataset(SPECS["mnist"], True), 8, seed=0)
+        distributed_train_loop(
+            model, opt, mesh, it, None, codec=SvdCodec(rank=2),
+            max_steps=max_steps, seed=0, train_dir=str(tmp_path),
+            save_freq=2, resume=resume, compress_ckpt=False,
+            log_fn=lambda *a, **k: None, zero1=zero1,
+        )
+
+    run(2, resume=False, zero1=False)  # replicated-layout checkpoint
+    with _w.catch_warnings(record=True) as w:
+        _w.simplefilter("always")
+        run(4, resume=True, zero1=True)
+    from atomo_tpu.training.checkpoint import latest_step
+
+    assert latest_step(str(tmp_path)) == 4
+    text = " ".join(str(x.message) for x in w)
+    assert "does not match this mesh's zero1 layout" in text
