@@ -20,7 +20,7 @@ scalar.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
+
 
 import jax
 import jax.numpy as jnp
