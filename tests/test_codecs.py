@@ -492,3 +492,39 @@ def test_randomized_svd_roundtrip_and_unbiased_on_lowrank(rng):
     )
     p2 = probed.encode(rng, grad)
     assert p2.u.shape == (24, 4) and p2.coeff.shape == (4,) and p2.vt.shape == (4, 36)
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [(1,), (2,), (7,), (3, 5, 7), (1, 1, 1, 1), (1, 513), (129, 1), (2, 3, 1, 1)],
+)
+def test_svd_codec_adversarial_shapes_roundtrip_unbiased(shape):
+    """Degenerate and odd shapes (scalars-adjacent, primes, unit dims) must
+    encode to static payloads and stay unbiased — the codec's reshaping and
+    dense-fallback edges, where static-shape logic most easily breaks."""
+    codec = SvdCodec(rank=2)
+    g = jax.random.normal(jax.random.PRNGKey(3), shape, jnp.float32)
+    n_keys = 600
+    acc = jnp.zeros(shape, jnp.float32)
+    dec = jax.jit(
+        lambda k: codec.decode(codec.encode(k, g), g.shape, g.dtype)
+    )
+    one = dec(jax.random.PRNGKey(0))
+    assert one.shape == shape and one.dtype == jnp.float32
+    for i in range(n_keys):
+        acc = acc + dec(jax.random.PRNGKey(100 + i))
+    mean = acc / n_keys
+    err = float(jnp.max(jnp.abs(mean - g)))
+    scale = float(jnp.max(jnp.abs(g))) + 1e-6
+    # loose statistical bound: the mean over 600 keys approaches g
+    assert err / scale < 0.5, (shape, err, scale)
+
+
+@pytest.mark.parametrize("shape", [(5,), (3, 3), (1, 64)])
+def test_qsgd_codec_adversarial_shapes_roundtrip(shape):
+    codec = QsgdCodec(bits=2, bucket_size=16)
+    g = jax.random.normal(jax.random.PRNGKey(4), shape, jnp.float32)
+    p = codec.encode(jax.random.PRNGKey(1), g)
+    out = codec.decode(p, g.shape, g.dtype)
+    assert out.shape == shape and out.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(out)))
