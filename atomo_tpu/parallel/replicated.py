@@ -807,6 +807,45 @@ def replicate_state(mesh: Mesh, state: TrainState) -> TrainState:
     return jax.device_put(state, replicated(mesh))
 
 
+def _check_sliceable(optimizer, n_dev: int, dtype) -> None:
+    """ZeRO-1 validity probe (ADVICE r3 #2): the sharded update is correct
+    only when updating a SLICE of the flat param vector equals the slice of
+    the full-vector update — true for elementwise transforms (sgd momentum,
+    adam, weight decay, per-element clipping) but silently FALSE for
+    globally-mixing ones (e.g. optax.clip_by_global_norm, whose norm would
+    be taken per-slice). Run the optimizer on a tiny vector, sliced and
+    unsliced, at setup time; raise on divergence rather than train subtly
+    wrong. The probe sweeps gradient SCALES (1, 1e4, 1e-4) because
+    threshold-gated mixing only activates at some magnitudes — a
+    clip_by_global_norm(10.0) is invisible to a unit-scale probe but fires
+    on the 1e4-scale one."""
+    probe_n = 8 * n_dev
+    pk, gk = jax.random.split(jax.random.PRNGKey(17))
+    p_full = jax.random.normal(pk, (probe_n,), dtype)
+    g_base = jax.random.normal(gk, (probe_n,), dtype)
+    chunk = probe_n // n_dev
+    for scale in (1.0, 1e4, 1e-4):
+        g_full = g_base * scale
+        u_full, _ = optimizer.update(g_full, optimizer.init(p_full), p_full)
+        parts = []
+        for i in range(n_dev):
+            p_i = p_full[i * chunk:(i + 1) * chunk]
+            g_i = g_full[i * chunk:(i + 1) * chunk]
+            u_i, _ = optimizer.update(g_i, optimizer.init(p_i), p_i)
+            parts.append(u_i)
+        ref = jnp.concatenate(parts)
+        tol = 1e-5 * float(jnp.max(jnp.abs(u_full))) + 1e-12
+        if not jnp.allclose(u_full, ref, rtol=1e-5, atol=tol):
+            raise ValueError(
+                "zero1_state: this optimizer's update is not slice-invariant "
+                f"(at gradient scale {scale:g}, a sliced update differs from "
+                "the slice of the full update — e.g. a global-norm clip in "
+                "the chain). ZeRO-1 sharding would train silently wrong; use "
+                "the replicated optimizer path or an elementwise chain "
+                "(sgd/momentum/adam/wd)."
+            )
+
+
 def zero1_state(
     mesh: Mesh, state: TrainState, optimizer, axis: str = "dp"
 ) -> tuple[TrainState, Any]:
@@ -831,6 +870,7 @@ def zero1_state(
 
     n = mesh.shape[axis]
     flat, _ = ravel_pytree(state.params)
+    _check_sliceable(optimizer, n, flat.dtype)
     chunk = _zero1_chunk(flat.size, n)
     local = optimizer.init(jnp.zeros((chunk,), flat.dtype))
 

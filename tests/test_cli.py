@@ -245,3 +245,11 @@ def test_lm_eval_freq_prints_validation(layout, extra, capsys):
 
     vls = [float(m) for m in re.findall(r"Validation: Step: 2, Loss: ([0-9.]+)", out)]
     assert vls and all(v == v for v in vls)
+    if layout == "dp-ep":
+        # ADVICE r3 #5: dp-ep also reports CE under the TRAINING per-chip
+        # drop regime (chunked forward at the training capacity)
+        m = re.search(r"Loss@TrainCap: ([0-9.]+) \(C=(\d+)\)", out)
+        assert m, "dp-ep validation must include the train-capacity CE"
+        assert float(m.group(1)) == float(m.group(1))  # finite
+        # C must be the per-chip budget: ceil(1.25 * (8/4)*8 / 4) = 5
+        assert int(m.group(2)) == 5

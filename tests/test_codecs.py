@@ -403,23 +403,102 @@ def test_bucketed_encode_matches_unbucketed(rng):
 
 def test_auto_algorithm_selection():
     """"auto" (the default) resolves per matrix: Halko sketch for matrices
-    whose small side reaches auto_min_dim, exact Jacobi below (VERDICT r2
-    next-round #3 — exact cost ~120 ms/step on ResNet-18/v5e; the sketch
-    runs at dense parity)."""
+    whose small side reaches auto_min_dim, gram (full spectrum via eigh of
+    the small-side Gram — no iterative QDWH/Jacobi program) below (VERDICT
+    r2 next-round #3 + r3 #3/#5: exact cost ~120 ms/step on
+    ResNet-18/v5e; the sketch runs at dense parity)."""
     codec = SvdCodec(rank=3)
     assert codec.algorithm == "auto"
-    assert codec._algorithm_for(32, 40) == "exact"
+    assert codec._algorithm_for(32, 40) == "gram"
     assert codec._algorithm_for(64, 512) == "randomized"
     assert codec._algorithm_for(512, 512) == "randomized"
     # both Bernoulli modes advertise the reference inclusion law over the
-    # FULL spectrum — a sketch would renormalize p_i and bias the estimator
-    assert SvdCodec(rank=3, sample="bernoulli")._algorithm_for(512, 512) == "exact"
+    # FULL spectrum — a sketch would renormalize p_i and bias the
+    # estimator, so they take the gram path at EVERY size
+    assert SvdCodec(rank=3, sample="bernoulli")._algorithm_for(512, 512) == "gram"
     assert (
         SvdCodec(rank=3, sample="bernoulli_budget")._algorithm_for(512, 512)
-        == "exact"
+        == "gram"
     )
     # explicit settings are honored
     assert SvdCodec(rank=3, algorithm="exact")._algorithm_for(512, 512) == "exact"
+
+
+def test_gram_svd_matches_exact_reconstruction():
+    """The gram factorization must reconstruct u@diag(s)@vt == mat to fp
+    precision on both orientations (that identity — not per-singular-value
+    accuracy — is what every sampler's unbiasedness rests on), and its
+    spectrum must match LAPACK-exact for the well-separated part."""
+    for shape in [(24, 40), (40, 24), (17, 17)]:
+        mat = jax.random.normal(jax.random.PRNGKey(5), shape) * 0.3
+        u, s, vt = SvdCodec._gram_svd(mat)
+        rec = np.asarray((u * s[None, :]) @ vt)
+        np.testing.assert_allclose(rec, np.asarray(mat), atol=5e-5)
+        s_ref = np.asarray(jnp.linalg.svd(mat, compute_uv=False))
+        np.testing.assert_allclose(np.asarray(s), s_ref, atol=1e-3)
+    # zero matrix: all-zero spectrum, finite factors, zero reconstruction
+    u, s, vt = SvdCodec._gram_svd(jnp.zeros((12, 20)))
+    assert np.isfinite(np.asarray(u)).all() and np.isfinite(np.asarray(vt)).all()
+    np.testing.assert_allclose(np.asarray((u * s[None, :]) @ vt), 0.0, atol=1e-7)
+
+
+def test_cholesky_qr_orthonormalizes():
+    """CholeskyQR2 replaces Householder QR in the sketch (TPU encode-tax
+    cut): fp-orthonormal on well/moderately-conditioned blocks, finite
+    (never NaN) on extreme ones. Extreme conditioning degrading
+    orthonormality is FINE for the codec — the estimator is unbiased for
+    any q (see _orthonormalize docstring); the adversarial-conditioning
+    unbiasedness is covered by test_randomized_bias_bounded_on_full_spectrum
+    and the probe tests."""
+    y = jax.random.normal(jax.random.PRNGKey(0), (96, 8))
+    q = SvdCodec._orthonormalize(y)
+    # the NaN-guard jitter (10*eps*trace) floors orthogonality around
+    # 1e-4; that is plenty for sketch quality (and bias-irrelevant)
+    np.testing.assert_allclose(
+        np.asarray(q.T @ q), np.eye(8), atol=1e-4
+    )
+    # columns spanning ~3 orders of magnitude (gram condition ~1e6)
+    y2 = y * (10.0 ** jnp.arange(-1, 3, 0.5, dtype=jnp.float32))[None, :]
+    q2 = SvdCodec._orthonormalize(y2)
+    np.testing.assert_allclose(np.asarray(q2.T @ q2), np.eye(8), atol=1e-3)
+    # rank-deficient / wildly-scaled: must stay finite (not orthonormal)
+    y3 = jnp.concatenate([y[:, :4], y[:, :4]], axis=1)
+    assert np.isfinite(np.asarray(SvdCodec._orthonormalize(y3))).all()
+    y4 = y * (10.0 ** jnp.arange(-3, 5, dtype=jnp.float32))[None, :]
+    assert np.isfinite(np.asarray(SvdCodec._orthonormalize(y4))).all()
+
+
+def test_bf16_wire_halves_bytes_and_stays_unbiased():
+    """wire_dtype=bfloat16: u/vt ship as bf16 (stochastically rounded),
+    coeff stays f32 — payload bytes nearly halve and E[decode] == grad
+    still holds (the narrowing is zero-mean by construction)."""
+    grad = jax.random.normal(jax.random.PRNGKey(42), (32, 24)) * 0.1
+    f32c = SvdCodec(rank=3)
+    bf16c = SvdCodec(rank=3, wire_dtype="bfloat16")
+    p32 = f32c.encode(jax.random.PRNGKey(0), grad)
+    p16 = bf16c.encode(jax.random.PRNGKey(0), grad)
+    assert p16.u.dtype == jnp.bfloat16 and p16.vt.dtype == jnp.bfloat16
+    assert p16.coeff.dtype == jnp.float32
+    assert payload_nbytes(p16) < 0.6 * payload_nbytes(p32)
+    est = mean_decoded(bf16c, grad, n_keys=4000)
+    err = jnp.linalg.norm(est - grad) / jnp.linalg.norm(grad)
+    assert err < 0.15, f"relative bias {err:.3f}"
+
+
+def test_stochastic_round_unbiased_and_close():
+    """E[stochastic_round(x)] == x (mean over keys converges to x, unlike
+    deterministic bf16 rounding whose error is systematic), and each draw
+    is within one bf16 ulp of x."""
+    from atomo_tpu.codecs.svd import stochastic_round
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (256,)) * 3.7
+    keys = jax.random.split(jax.random.PRNGKey(2), 3000)
+    rounded = jax.vmap(lambda k: stochastic_round(k, x).astype(jnp.float32))(keys)
+    mean = np.asarray(jnp.mean(rounded, axis=0))
+    # one bf16 ulp is ~2^-8 relative; the MC mean must sit well inside it
+    np.testing.assert_allclose(mean, np.asarray(x), rtol=2e-4, atol=1e-6)
+    max_err = float(jnp.max(jnp.abs(rounded[0] - x) / jnp.maximum(jnp.abs(x), 1e-6)))
+    assert max_err <= 1.0 / 128.0  # within one ulp step
 
 
 def _power_law_gradient(m, n, decay=1.5, scale=0.1):

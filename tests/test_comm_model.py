@@ -1,0 +1,73 @@
+"""Analytic comm-cost model invariants (atomo_tpu/utils/comm_model.py).
+
+The measured side lives in scripts/comm_crossover.py (8-device exchange
+timings); these tests pin the model algebra the bench rows embed.
+"""
+
+import math
+
+from atomo_tpu.utils.comm_model import (
+    crossover_bandwidth,
+    crossover_report,
+    max_beneficial_ways,
+    ring_allgather_wire_bytes,
+    ring_allreduce_wire_bytes,
+)
+
+D = 44.7e6  # dense ResNet-18 gradient bytes
+P = 0.62e6  # rank-3 payload bytes
+
+
+def test_wire_byte_formulas():
+    # all-reduce saturates at 2D as N grows; all-gather grows ~linearly
+    assert ring_allreduce_wire_bytes(D, 2) == D
+    assert abs(ring_allreduce_wire_bytes(D, 1 << 20) - 2 * D) < 1e-3 * D
+    assert ring_allgather_wire_bytes(P, 8) == P * 7
+
+
+def test_max_beneficial_ways_is_twice_reduction():
+    red = D / P
+    assert abs(max_beneficial_ways(D, P) - 2 * red) < 1e-9
+    # beyond that N, the gather moves MORE bytes than the all-reduce
+    n_star = int(max_beneficial_ways(D, P))
+    assert ring_allgather_wire_bytes(P, n_star + 5) > ring_allreduce_wire_bytes(
+        D, n_star + 5
+    )
+    assert ring_allgather_wire_bytes(P, n_star - 5) < ring_allreduce_wire_bytes(
+        D, n_star - 5
+    )
+
+
+def test_crossover_bandwidth_semantics():
+    tax = 2.5e-3
+    bw = crossover_bandwidth(D, P, 8, tax)
+    # below the crossover bandwidth compression must win, above it lose
+    for frac, wins in ((0.5, True), (2.0, False)):
+        b = bw * frac
+        t_dense = ring_allreduce_wire_bytes(D, 8) / b
+        t_svd = tax + ring_allgather_wire_bytes(P, 8) / b
+        assert (t_svd < t_dense) == wins
+    # zero tax -> compression wins at any bandwidth
+    assert crossover_bandwidth(D, P, 8, 0.0) == float("inf")
+    # negative byte saving (payload too big for this N) -> never wins
+    assert crossover_bandwidth(D, D, 8, tax) is None
+
+
+def test_crossover_report_shape_and_consistency():
+    rep = crossover_report(D, P, dense_step_s=6.5e-3, svd_step_s=9.0e-3)
+    assert rep["codec_tax_ms"] == 2.5
+    assert [r["ways"] for r in rep["ways"]] == [8, 16, 32, 64]
+    for row in rep["ways"]:
+        for label, cell in row["implied"].items():
+            # speedup must equal the ratio of the implied step times
+            assert math.isclose(
+                cell["speedup"], cell["dense_ms"] / cell["svd_ms"], rel_tol=5e-3
+            )
+        # the slowest fabric must favor compression the most
+        sp = [row["implied"][k]["speedup"] for k in
+              ("ici_45GBps", "dcn_6.25GBps", "eth10G_1.25GBps")]
+        assert sp[0] < sp[1] < sp[2]
+    # compression must lose on ICI at single-chip tax, win on 10GbE (the
+    # printed story of artifacts/COMM_CROSSOVER.md)
+    w8 = rep["ways"][0]["implied"]
+    assert w8["ici_45GBps"]["speedup"] < 1.0 < w8["eth10G_1.25GBps"]["speedup"]

@@ -88,6 +88,23 @@ def test_zero1_opt_state_is_actually_sharded():
         assert shard.data.shape == (chunk,)  # 1/n per chip
 
 
+def test_zero1_rejects_global_mixing_optimizer():
+    """ADVICE r3 #2: an optimizer whose update mixes across elements
+    (global-norm clip) would train subtly wrong under ZeRO-1 slicing; the
+    setup-time probe must refuse it, and accept the elementwise chains."""
+    bad = optax.chain(optax.clip_by_global_norm(1e-3), optax.sgd(1e-2))
+    mesh, model, state0, *_ = _setup(make_optimizer("sgd", lr=1e-2))
+    with pytest.raises(ValueError, match="slice-invariant"):
+        zero1_state(mesh, state0, bad)
+    # scale-gated mixing: a clip threshold a unit-scale probe never
+    # reaches (norm ~8 < 10) — the probe's 1e4-scale sweep must fire it
+    lurking = optax.chain(optax.clip_by_global_norm(10.0), optax.sgd(1e-2))
+    with pytest.raises(ValueError, match="slice-invariant"):
+        zero1_state(mesh, state0, lurking)
+    # the supported chains still pass the probe
+    zero1_state(mesh, state0, make_optimizer("adam", lr=1e-2))
+
+
 def test_zero1_checkpoint_resume_preserves_momentum(tmp_path):
     """A zero1-written checkpoint resumes INTO the zero1 layout: the flat
     sharded momentum buffers round-trip and the resumed run continues

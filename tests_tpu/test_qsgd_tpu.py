@@ -36,9 +36,11 @@ def test_oncore_prng_encode_compiles_and_roundtrips(bits):
 
 def test_default_codec_config_works_on_tpu():
     """QsgdCodec() with no flags — the config `--code qsgd` training uses —
-    must auto-select the Pallas kernels and run on the chip."""
+    must run on the chip. Round-4 default flip (VERDICT r3 #4): auto now
+    resolves to the jnp path (it measured faster than the kernel on the
+    v5e in both round-3 sessions); the kernel stays opt-in."""
     codec = QsgdCodec(bits=2)
-    assert codec._pallas(), "auto-selection should pick Pallas on TPU"
+    assert not codec._pallas(), "auto-selection defaults to the jnp path"
     g = jax.random.normal(jax.random.PRNGKey(1), (50_000,), jnp.float32)
     p = codec.encode(jax.random.PRNGKey(2), g)
     d = np.asarray(codec.decode(p, (50_000,)))
