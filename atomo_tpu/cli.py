@@ -81,8 +81,18 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
     t.add_argument("--n-devices", type=int, default=0,
                    help="devices in the dp mesh; 0 = all visible, 1 = single-host")
     t.add_argument("--aggregate", type=str, default="gather",
-                   choices=["gather", "psum"],
-                   help="factor all_gather vs dense psum aggregation")
+                   choices=["gather", "psum", "hierarchical"],
+                   help="factor all_gather vs dense psum aggregation; "
+                        "hierarchical = dense psum over the fast fabric "
+                        "(ICI) then factor all_gather over the slow one "
+                        "(DCN) — see --dcn-ways and "
+                        "artifacts/COMM_CROSSOVER.md")
+    t.add_argument("--dcn-ways", type=int, default=0, metavar="K",
+                   help="hierarchical aggregation: number of SLOW-fabric "
+                        "(outer/DCN) groups; the n-devices mesh becomes "
+                        "(dp=K) x (ici=n/K). 0 = infer from "
+                        "jax.process_count() (one group per host), "
+                        "falling back to 2 on a single process")
     t.add_argument("--sample", type=str, default="fixed_k",
                    choices=["fixed_k", "bernoulli_budget", "bernoulli", "topk"],
                    help="SVD atom sampling mode (bernoulli_budget = reference "
@@ -277,7 +287,24 @@ def cmd_train(args: argparse.Namespace) -> int:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
         from atomo_tpu.training import stepwise_shrink
 
-        mesh = make_mesh(n_dev)
+        inner_axis = None
+        if args.aggregate == "hierarchical":
+            k = args.dcn_ways or max(jax.process_count(), 2)
+            if codec is None:
+                raise SystemExit(
+                    "--aggregate hierarchical needs a compressing --code "
+                    "(the point is factors on the slow fabric; use "
+                    "--aggregate psum for dense)"
+                )
+            if n_dev % k or not 1 < k <= n_dev:
+                raise SystemExit(
+                    f"--dcn-ways {k} must divide --n-devices {n_dev} "
+                    "(outer slow-fabric groups x inner fast-fabric chips)"
+                )
+            mesh = make_mesh(n_dev, axes=(("dp", k), ("ici", n_dev // k)))
+            inner_axis = "ici"
+        else:
+            mesh = make_mesh(n_dev)
         k_agg = 0
         if (
             args.num_aggregate is not None
@@ -295,7 +322,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             model, optimizer, mesh, train_iter, test_iter,
             codec=codec, aggregate=args.aggregate, augment=augment,
             num_aggregate=k_agg, zero1=args.zero1,
-            grad_accum=args.grad_accum,
+            grad_accum=args.grad_accum, inner_axis=inner_axis,
             max_steps=max_steps, eval_freq=args.eval_freq, seed=args.seed,
             train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
             compress_ckpt=args.compress, log_every=args.log_interval,
@@ -381,6 +408,9 @@ def cmd_lm(args: argparse.Namespace) -> int:
             svd_rank=args.svd_rank,
             quantization_level=args.quantization_level,
             bucket_size=args.bucket_size,
+            sample=getattr(args, "sample", "fixed_k"),
+            algorithm=getattr(args, "svd_algo", "auto"),
+            wire_dtype=getattr(args, "svd_wire", "float32"),
         )
     optimizer = make_optimizer(
         args.optimizer, lr=args.lr, lr_shrinkage=args.lr_shrinkage,
@@ -775,6 +805,15 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("--compress", action="store_true", default=False,
                       help="lossless-compress checkpoints (C++ native codec)")
     p_lm.add_argument("--svd-rank", type=int, default=3)
+    p_lm.add_argument("--sample", type=str, default="fixed_k",
+                      choices=["fixed_k", "bernoulli_budget", "bernoulli",
+                               "topk"])
+    p_lm.add_argument("--svd-algo", type=str, default="auto",
+                      choices=["auto", "exact", "gram", "randomized"])
+    p_lm.add_argument("--svd-wire", type=str, default="float32",
+                      choices=["float32", "bfloat16"],
+                      help="bfloat16 = stochastically-rounded factors on "
+                           "the wire (unbiased, ~half the payload bytes)")
     p_lm.add_argument("--quantization-level", type=int, default=2)
     p_lm.add_argument("--bucket-size", type=int, default=512)
     p_lm.set_defaults(fn=cmd_lm)

@@ -104,6 +104,7 @@ def make_distributed_train_step(
     compute_dtype=None,
     zero1_specs=None,
     grad_accum: int = 1,
+    inner_axis: Optional[str] = None,
 ):
     """Build the jitted SPMD train step over ``mesh``.
 
@@ -131,6 +132,19 @@ def make_distributed_train_step(
     flat optimizer buffers; the update runs on the slice and one tiled
     all_gather re-assembles the replicated params.
 
+    ``aggregate="hierarchical"`` (requires ``inner_axis`` and a codec) is
+    the mode the comm-cost model (utils/comm_model.py) points at: on a
+    2-axis data-parallel mesh (outer = ``axis``, the SLOW fabric — DCN /
+    cross-host; inner = ``inner_axis``, the fast one — ICI), gradients are
+    first pmean-ed DENSE over the inner axis (compression cannot beat
+    45 GB/s ICI at these sizes — measured, artifacts/COMM_CROSSOVER.md),
+    then every inner group encodes its reduced gradient with the SAME key
+    (identical payloads within a group) and only the factors cross the
+    slow axis in an all_gather. Bytes on the scarce fabric drop by the
+    full codec reduction while the inner fabric carries what it carries
+    best. No reference analogue (its PS pushes every worker's message
+    over one 10 GbE fabric, src/distributed_worker.py:229-246).
+
     Caveat (honest): as *straggler mitigation* this is semantics-only. The
     all_gather still moves all N payloads and the SPMD program still blocks
     on the slowest chip — only the decode/average work shrinks to K. True
@@ -142,6 +156,25 @@ def make_distributed_train_step(
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     n_dev = mesh.shape[axis]
+    hierarchical = aggregate == "hierarchical"
+    if hierarchical:
+        if codec is None or inner_axis is None:
+            raise ValueError(
+                "aggregate='hierarchical' needs a codec and inner_axis "
+                "(dense psum over the fast fabric, factors over the slow "
+                "one); use aggregate='psum' for fully-dense exchange"
+            )
+        if inner_axis not in mesh.shape:
+            raise ValueError(
+                f"inner_axis {inner_axis!r} not in mesh axes {mesh.axis_names}"
+            )
+        if zero1_specs is not None:
+            raise ValueError(
+                "zero1 + hierarchical aggregation is not supported yet "
+                "(the flat-slice indexing assumes a single dp axis)"
+            )
+    elif inner_axis is not None:
+        raise ValueError("inner_axis only applies to aggregate='hierarchical'")
     k_agg = num_aggregate if 0 < num_aggregate < n_dev else 0
     if k_agg and (codec is None or aggregate != "gather"):
         raise ValueError(
@@ -151,10 +184,26 @@ def make_distributed_train_step(
     if codec is None and aggregate == "gather":
         aggregate = "psum"  # dense gather would be strictly worse than psum
 
+    batch_axes = (axis, inner_axis) if hierarchical else axis
+    metric_axes = batch_axes
+
     def spmd_step(state: TrainState, key, images, labels):
         my = jax.lax.axis_index(axis)
+        if hierarchical:
+            # every chip is a distinct data shard: fold dropout/augment
+            # keys by the full chip id, but the CODEC key by the outer
+            # index alone (all inner-group chips encode the same reduced
+            # gradient with the same key -> identical payloads -> the
+            # replicated-update invariant holds with zero extra comm)
+            my = my * mesh.shape[inner_axis] + jax.lax.axis_index(inner_axis)
         step_key = jax.random.fold_in(key, state.step)
         k_aug, k_drop, k_codec = jax.random.split(jax.random.fold_in(step_key, my), 3)
+        if hierarchical:
+            # sentinel fold (1<<20, beyond any chip id) keeps the codec
+            # stream disjoint from the per-chip dropout/augment streams
+            k_codec = jax.random.fold_in(
+                jax.random.fold_in(step_key, 1 << 20), jax.lax.axis_index(axis)
+            )
         if augment:
             images = augment_batch(k_aug, images)
         grad_fn = jax.value_and_grad(
@@ -208,6 +257,17 @@ def make_distributed_train_step(
         if codec is None:
             mean_grads = jax.lax.pmean(grads, axis)
             msg_bytes = dense_bytes
+        elif hierarchical:
+            # fast fabric first: dense pmean over the inner (ICI) axis —
+            # the regime where the codec tax cannot pay for itself
+            grads = jax.lax.pmean(grads, inner_axis)
+            # slow fabric: only factors cross. Same key within an inner
+            # group (see above) -> payloads identical per group; gather
+            # over the OUTER axis moves n_outer payloads, not n_chips.
+            payloads, stats = encode_tree(codec, k_codec, grads)
+            msg_bytes = stats.payload_bytes  # bytes on the SLOW fabric
+            gathered = jax.lax.all_gather(payloads, axis)
+            mean_grads = decode_mean_tree(codec, gathered, grads, n_dev)
         else:
             payloads, stats = encode_tree(codec, k_codec, grads)
             msg_bytes = stats.payload_bytes
@@ -257,13 +317,14 @@ def make_distributed_train_step(
             new_sl = optax.apply_updates(p_sl, updates)
             new_flat = jax.lax.all_gather(new_sl, axis, tiled=True)
             new_params = unravel(new_flat[: flat_p.size])
-        # keep BN stats consistent across replicas (deviation note above)
-        new_stats = jax.lax.pmean(new_stats, axis)
+        # keep BN stats consistent across replicas (deviation note above);
+        # hierarchical mode averages over BOTH data axes
+        new_stats = jax.lax.pmean(new_stats, metric_axes)
 
         metrics = {
-            "loss": jax.lax.pmean(loss, axis),
-            "prec1": jax.lax.pmean(prec1, axis),
-            "prec5": jax.lax.pmean(prec5, axis),
+            "loss": jax.lax.pmean(loss, metric_axes),
+            "prec1": jax.lax.pmean(prec1, metric_axes),
+            "prec5": jax.lax.pmean(prec5, metric_axes),
             # float32: static trace-time ints; int32 would overflow at jit
             # time for >=2 GiB per-shard gradients
             "msg_bytes": jnp.asarray(msg_bytes, jnp.float32),
@@ -287,7 +348,7 @@ def make_distributed_train_step(
     sharded = jax.shard_map(
         spmd_step,
         mesh=mesh,
-        in_specs=(state_spec, P(), P(axis), P(axis)),
+        in_specs=(state_spec, P(), P(batch_axes), P(batch_axes)),
         out_specs=(state_spec, P()),
         # decoded-mean of identically gathered payloads is replicated by
         # construction; the vma tracker cannot see that through all_gather,
@@ -402,7 +463,7 @@ def make_phase_train_steps(
     return fns
 
 
-def make_distributed_eval_step(model, mesh: Mesh, axis: str = "dp"):
+def make_distributed_eval_step(model, mesh: Mesh, axis="dp"):
     """Eval takes only (params, batch_stats) — NOT the whole TrainState —
     so a ZeRO-1 run's dp-sharded optimizer buffers are never re-replicated
     onto every chip just to be ignored by inference."""
@@ -420,11 +481,12 @@ def make_distributed_eval_step(model, mesh: Mesh, axis: str = "dp"):
             "prec5": jax.lax.pmean(prec5, axis),
         }
 
+    spec = P(tuple(axis)) if isinstance(axis, (tuple, list)) else P(axis)
     return jax.jit(
         jax.shard_map(
             spmd_eval,
             mesh=mesh,
-            in_specs=(P(), P(), P(axis), P(axis)),
+            in_specs=(P(), P(), spec, spec),
             out_specs=P(),
             check_vma=False,
         )
@@ -459,6 +521,7 @@ def distributed_train_loop(
     compute_dtype=None,
     zero1: bool = False,
     grad_accum: int = 1,
+    inner_axis: Optional[str] = None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -589,8 +652,14 @@ def distributed_train_loop(
             model, optimizer, mesh, codec, aggregate=aggregate, augment=augment,
             num_aggregate=num_aggregate, compute_dtype=compute_dtype,
             zero1_specs=zero1_specs, grad_accum=grad_accum,
+            inner_axis=inner_axis,
         )
-    eval_fn = make_distributed_eval_step(model, mesh) if test_iter is not None else None
+    batch_axes = ("dp", inner_axis) if aggregate == "hierarchical" else "dp"
+    eval_fn = (
+        make_distributed_eval_step(model, mesh, axis=batch_axes)
+        if test_iter is not None
+        else None
+    )
     key = jax.random.PRNGKey(seed + 1)
     timer = Timer()
     stream = train_iter.forever()
@@ -607,7 +676,7 @@ def distributed_train_loop(
             state, step_fn, eval_fn, stream, train_iter, test_iter, mesh,
             key, timer, n_train, start_step, max_steps, log_every, log_fn,
             eval_freq, save_freq, train_dir, compress_ckpt, monitor, lr_fn,
-            profile_dir, profile_steps,
+            profile_dir, profile_steps, batch_axes,
         )
     finally:
         if watchdog is not None:
@@ -678,7 +747,7 @@ def _distributed_steps(
     state, step_fn, eval_fn, stream, train_iter, test_iter, mesh, key,
     timer, n_train, start_step, max_steps, log_every, log_fn, eval_freq,
     save_freq, train_dir, compress_ckpt, monitor, lr_fn=None,
-    profile_dir=None, profile_steps=3,
+    profile_dir=None, profile_steps=3, batch_axes="dp",
 ):
     from atomo_tpu.training.checkpoint import save_checkpoint
     from atomo_tpu.utils.metrics import StepMetrics, master_line
@@ -693,7 +762,7 @@ def _distributed_steps(
             prof_ctx.__enter__()
             log_fn(f"Profiling steps {step}..{step + profile_steps - 1} -> {profile_dir}")
         images, labels = next(stream)
-        si, sl = shard_batch(mesh, images, labels)
+        si, sl = shard_batch(mesh, images, labels, axis=batch_axes)
         out = step_fn(state, key, si, sl)
         if prof_ctx is not None and step >= prof_first + profile_steps - 1:
             jax.block_until_ready(out[0].params)
@@ -731,7 +800,15 @@ def _distributed_steps(
                     )
                 )
         if eval_freq and eval_fn is not None and step % eval_freq == 0:
-            n_dev = mesh.shape["dp"]
+            # trim divisor = product of the axes the batch actually shards
+            # over (hierarchical mode shards eval over BOTH data axes —
+            # trimming by the outer axis alone would crash shard_batch)
+            if isinstance(batch_axes, (tuple, list)):
+                n_dev = 1
+                for a in batch_axes:
+                    n_dev *= mesh.shape[a]
+            else:
+                n_dev = mesh.shape[batch_axes]
             totals = {"loss": 0.0, "prec1": 0.0, "prec5": 0.0}
             n = 0
             dropped = 0
@@ -744,7 +821,7 @@ def _distributed_steps(
                 dropped += ti.shape[0] - trim
                 if trim == 0:
                     continue
-                sti, stl = shard_batch(mesh, ti[:trim], tl[:trim])
+                sti, stl = shard_batch(mesh, ti[:trim], tl[:trim], axis=batch_axes)
                 m = eval_fn(state.params, state.batch_stats, sti, stl)
                 for k_ in totals:
                     totals[k_] += float(m[k_]) * trim
@@ -768,9 +845,17 @@ def _distributed_steps(
     return state
 
 
-def shard_batch(mesh: Mesh, images, labels, axis: str = "dp"):
-    n_dev = mesh.shape[axis]
-    sh = batch_sharded(mesh, axis)
+def shard_batch(mesh: Mesh, images, labels, axis="dp"):
+    """Shard the batch dim over ``axis`` — a mesh axis name, or a tuple of
+    names for 2-axis data parallelism (hierarchical aggregation)."""
+    if isinstance(axis, (tuple, list)):
+        n_dev = 1
+        for a in axis:
+            n_dev *= mesh.shape[a]
+        sh = NamedSharding(mesh, P(tuple(axis)))
+    else:
+        n_dev = mesh.shape[axis]
+        sh = batch_sharded(mesh, axis)
     if jax.process_count() > 1:
         # Multi-host SPMD: each process feeds its *local* shard (its own
         # independently shuffled batch slice — the reference's workers also

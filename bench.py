@@ -61,6 +61,9 @@ import time
 WARMUP = 3
 STEPS = 30  # enough steps between scalar fetches to amortize the tunnel RTT
 CHILD_TIMEOUT_S = 2400
+TPU_ATTEMPT_TIMEOUT_S = 1200  # per-attempt cap when dialing the chip (a
+# healthy config finishes well inside this; a wedged compile must not eat
+# the whole ladder window — round-3 failure mode)
 BACKEND_TIMEOUT_S = 300  # axon tunnel dial can wedge for tens of minutes
 RETRIES = 3
 
@@ -709,12 +712,14 @@ def child_main(args) -> int:
 # -------------------------------------------------------------------- parent
 
 
-def _run_child(argv_tail: list[str], env_extra: dict) -> tuple[dict | None, str]:
+def _run_child(
+    argv_tail: list[str], env_extra: dict, timeout_s: int = CHILD_TIMEOUT_S
+) -> tuple[dict | None, str]:
     cmd = [sys.executable, "-u", os.path.abspath(__file__), "--child"] + argv_tail
     env = {**os.environ, **env_extra}
     try:
         p = subprocess.run(
-            cmd, capture_output=True, text=True, env=env, timeout=CHILD_TIMEOUT_S
+            cmd, capture_output=True, text=True, env=env, timeout=timeout_s
         )
         stdout = p.stdout or ""
         rc = p.returncode
@@ -724,7 +729,7 @@ def _run_child(argv_tail: list[str], env_extra: dict) -> tuple[dict | None, str]
         stdout = (e.stdout or b"")
         if isinstance(stdout, bytes):
             stdout = stdout.decode(errors="replace")
-        rc, stderr = -1, f"child timed out after {CHILD_TIMEOUT_S}s"
+        rc, stderr = -1, f"child timed out after {timeout_s}s"
     for line in reversed(stdout.splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -744,7 +749,13 @@ def _bench_one(config: int, no_baseline: bool) -> dict:
     for attempt in range(RETRIES):
         if attempt:
             time.sleep(15 * attempt)  # axon tunnel contention backoff
-        parsed, err = _run_child(tail, {})
+        # TPU attempts get a TIGHTER budget than the generous child default
+        # (which exists for 1-core CPU-fallback runs): a healthy chip
+        # finishes any config in a few minutes, while round 3 lost its
+        # whole end-of-round window to one wedged ResNet-50 compile —
+        # better to fail fast, retry, and leave time for the rest of the
+        # ladder (the driver records the LAST aggregate line).
+        parsed, err = _run_child(tail, {}, timeout_s=TPU_ATTEMPT_TIMEOUT_S)
         if parsed is not None:
             return parsed
         last_err = err
@@ -792,13 +803,13 @@ def main() -> int:
         return 0
     # default: the whole BASELINE.md ladder (VERDICT r2 next-round #4) —
     # one row per config as it completes, then an aggregate headline line
-    # (config 2's fields + all rows so far under "configs"). The aggregate
-    # re-emits after every config from 2 on, so if the caller times the
-    # bench out mid-ladder, the LAST stdout line (what the driver records)
-    # is still a valid headline row rather than whichever config happened
-    # to finish last.
+    # (config 2's fields + all rows so far under "configs"). The HEADLINE
+    # config runs FIRST: if the relay wedges mid-ladder, the driver's
+    # last-line parse still gets a config-2 aggregate instead of whichever
+    # row happened to finish (round-3 lost its on-chip headline to exactly
+    # this). The aggregate re-emits after every later config.
     rows = {}
-    for c in sorted(CONFIGS):
+    for c in [2] + [k for k in sorted(CONFIGS) if k != 2]:
         rows[c] = _bench_one(c, args.no_baseline)
         print(json.dumps(rows[c]), flush=True)
         if 2 in rows:

@@ -100,6 +100,7 @@ def test_watchdog_quiet_while_beating():
     assert not failures
 
 
+@pytest.mark.slow
 def test_distributed_loop_beats_monitor():
     """distributed_train_loop with health_timeout armed completes a short
     run and tears the watchdog down cleanly (production wiring check)."""
@@ -130,6 +131,7 @@ def test_global_mesh_spans_devices():
     assert mesh.devices.size == len(jax.devices())
 
 
+@pytest.mark.slow
 def test_profile_dir_captures_trace(tmp_path):
     """--profile-dir must produce a jax.profiler trace of steady-state steps
     (the fused-program observability story, utils/tracing docstring)."""

@@ -41,6 +41,7 @@ def test_bare_flags_behave_like_train(tmp_path):
     assert rc == 0
 
 
+@pytest.mark.slow
 def test_train_svd_smoke_with_checkpoint(tmp_path):
     rc = main([
         "train", "--network", "LeNet", "--dataset", "MNIST", "--synthetic",
@@ -134,6 +135,7 @@ def test_tune_subcommand_smoke(capsys):
         ("dp-pp", ["--ways", "2", "--microbatches", "2"]),
     ],
 )
+@pytest.mark.slow
 def test_lm_subcommand_all_layouts(layout, extra, capsys):
     """Every parallelism layout is drivable end-to-end from the CLI on the
     8-device CPU mesh and prints the LM log line with a finite loss."""
@@ -161,6 +163,7 @@ def test_lm_subcommand_rejects_bad_ways():
         main(["lm", "--layout", "dp-tp", "--ways", "3", "--n-devices", "4"])
 
 
+@pytest.mark.slow
 def test_lm_data_file_byte_corpus(tmp_path, capsys):
     """--data-file trains on raw bytes of a real file (vocab 256)."""
     corpus = tmp_path / "corpus.txt"
@@ -189,6 +192,7 @@ def test_lm_data_file_rejects_small_vocab(tmp_path):
         ])
 
 
+@pytest.mark.slow
 def test_train_zero1_multidevice(tmp_path, capsys):
     rc = main([
         "train", "--network", "LeNet", "--dataset", "MNIST", "--synthetic",
@@ -201,6 +205,7 @@ def test_train_zero1_multidevice(tmp_path, capsys):
     assert "Step: 2" in capsys.readouterr().out
 
 
+@pytest.mark.slow
 def test_lm_checkpoint_resume_sharded_layout(tmp_path, capsys):
     """lm --train-dir/--resume round-trips a MODEL-SHARDED (dp-tp) state:
     the checkpoint gathers from sharded buffers and restores onto the mesh
@@ -228,6 +233,7 @@ def test_lm_checkpoint_resume_sharded_layout(tmp_path, capsys):
         ("dp-pp", ["--ways", "2", "--microbatches", "2"]),
     ],
 )
+@pytest.mark.slow
 def test_lm_eval_freq_prints_validation(layout, extra, capsys):
     """--eval-freq prints a held-out validation line for every layout via
     its single-device oracle forward on the gathered params."""

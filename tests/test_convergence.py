@@ -25,6 +25,9 @@ from atomo_tpu.models import get_model
 from atomo_tpu.training import create_state, make_optimizer, make_train_step
 
 
+pytestmark = pytest.mark.slow  # heavy multi-device compile/parity runs; deselect with -m "not slow"
+
+
 def _train(model, codec, it, steps, seed=0, lr=0.01, momentum=0.0):
     # momentum 0 is the reference's canonical SVD recipe
     # (src/run_pytorch.sh:1-20): momentum integrates the sampling noise of

@@ -41,6 +41,7 @@ def test_mesh_has_8_devices():
 
 
 @pytest.mark.parametrize("codec_name", ["svd", "qsgd", "dense"])
+@pytest.mark.slow
 def test_distributed_step_runs(codec_name):
     mesh, model, opt, it, state = _setup()
     codec = {
@@ -59,6 +60,7 @@ def test_distributed_step_runs(codec_name):
         assert int(metrics["msg_bytes"]) < int(metrics["dense_bytes"])
 
 
+@pytest.mark.slow
 def test_svd_gather_bytes_reduction_at_rank3():
     """North star: >=8x gradient-volume reduction at svd-rank 3 on ResNet-18
     (BASELINE.md). Checked on the exact payload sizes the gather moves."""
@@ -77,6 +79,7 @@ def test_svd_gather_bytes_reduction_at_rank3():
     assert reduction >= 8.0, f"only {reduction:.1f}x"
 
 
+@pytest.mark.slow
 def test_replicas_stay_identical():
     """After several compressed steps, params must be exactly replicated."""
     mesh, model, opt, it, state = _setup()
@@ -94,6 +97,7 @@ def test_replicas_stay_identical():
         np.testing.assert_array_equal(shards[0], s)
 
 
+@pytest.mark.slow
 def test_gather_and_psum_agree():
     """gather (factors on the wire) and psum (dense on the wire) produce the
     same update given the same sampling keys."""
@@ -134,6 +138,7 @@ def test_distributed_matches_single_when_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_distributed_training_learns():
     mesh, model, opt, it, state = _setup()
     step = make_distributed_train_step(model, opt, mesh, QsgdCodec(bits=2, bucket_size=128))
@@ -149,6 +154,7 @@ def test_distributed_training_learns():
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
 
 
+@pytest.mark.slow
 def test_num_aggregate_subset():
     """Honest --num-aggregate: K-of-N rotating subset aggregation keeps
     replicas identical and still trains (SURVEY.md §2.1 'vestigial flag')."""
@@ -181,6 +187,7 @@ def test_num_aggregate_requires_gather():
 
 
 @pytest.mark.parametrize("codec_name", ["svd", "dense"])
+@pytest.mark.slow
 def test_phase_steps_match_fused(codec_name):
     """The four separately-jitted phase programs must produce the same
     update as the fused step (same keys, same math) — VERDICT r1 #6."""
@@ -214,6 +221,7 @@ def test_phase_steps_match_fused(codec_name):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_phase_metrics_loop_logs_nonzero_phases():
     """distributed_train_loop --phase-metrics emits worker lines whose
     Comp/Encode/Comm columns are real nonzero seconds, plus the reference
@@ -248,6 +256,7 @@ def test_phase_metrics_loop_logs_nonzero_phases():
     assert "Cur lr 0.01" in master[-1]
 
 
+@pytest.mark.slow
 def test_bf16_distributed_replicas_stay_identical():
     """Mixed precision under SPMD: the bf16 step must keep the replicated-PS
     equivalence contract (f32 master state bit-identical across replicas)."""

@@ -29,7 +29,16 @@ def test_mnist_models(name):
 
 
 @pytest.mark.parametrize(
-    "name", ["resnet18", "resnet50", "resnet110", "vgg11", "densenet100"]
+    "name",
+    [
+        "resnet18",
+        "vgg11",
+        # the deep ones compile for 10-70s each on 1 CPU core — full-suite
+        # only; resnet18/vgg11 keep CIFAR-net coverage in the smoke set
+        pytest.param("resnet50", marks=pytest.mark.slow),
+        pytest.param("resnet110", marks=pytest.mark.slow),
+        pytest.param("densenet100", marks=pytest.mark.slow),
+    ],
 )
 def test_cifar_models(name):
     model = get_model(name, 10)
@@ -48,6 +57,7 @@ def test_cifar100_head():
     assert out.shape == (2, 100)
 
 
+@pytest.mark.slow
 def test_alexnet_imagenet_geometry():
     model = get_model("alexnet", 1000)
     x = jnp.ones((1, 224, 224, 3))

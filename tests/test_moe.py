@@ -33,6 +33,9 @@ CFG = dict(
 )
 
 
+pytestmark = pytest.mark.slow  # heavy multi-device compile/parity runs; deselect with -m "not slow"
+
+
 def _moe_block_params(key, width=16, n_experts=4, f=32):
     kr, ku, kd = jax.random.split(key, 3)
     return {

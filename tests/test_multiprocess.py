@@ -20,6 +20,9 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _TIMEOUT_S = 420
 
 
+pytestmark = pytest.mark.slow  # heavy multi-device compile/parity runs; deselect with -m "not slow"
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))

@@ -28,6 +28,9 @@ from atomo_tpu.training.trainer import TrainState
 CFG = dict(vocab_size=16, max_len=12, width=16, depth=4, num_heads=4)
 
 
+pytestmark = pytest.mark.slow  # heavy multi-device compile/parity runs; deselect with -m "not slow"
+
+
 def test_pp_reference_forward_shapes():
     params = init_pp_lm_params(jax.random.PRNGKey(0), CFG)
     tokens = jnp.zeros((2, 10), jnp.int32)

@@ -17,6 +17,9 @@ from atomo_tpu.parallel.ring import (
 from atomo_tpu.training import create_state, make_optimizer
 
 
+pytestmark = pytest.mark.slow  # heavy multi-device compile/parity runs; deselect with -m "not slow"
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_matches_full_attention(causal):
     """Exactness: ring attention over 4 sequence shards == full attention."""

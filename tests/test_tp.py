@@ -29,6 +29,9 @@ from atomo_tpu.parallel.tp import (
 CFG = dict(vocab_size=16, max_len=12, width=16, depth=2, num_heads=4)
 
 
+pytestmark = pytest.mark.slow  # heavy multi-device compile/parity runs; deselect with -m "not slow"
+
+
 def _lm_and_params(key=0):
     lm = TransformerLM(**CFG)
     tokens = jax.random.randint(jax.random.PRNGKey(7), (4, 10), 0, CFG["vocab_size"])

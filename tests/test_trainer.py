@@ -3,6 +3,7 @@
 sparsification, single process)."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -56,6 +57,7 @@ def test_lenet_learns_with_qsgd_codec():
     assert ev["prec1"] > 30.0, ev
 
 
+@pytest.mark.slow
 def test_lenet_learns_with_svd_codec():
     train_it, test_it = _iters()
     model = get_model("lenet", 10)
@@ -91,6 +93,7 @@ def test_worker_log_line_matches_tuning_regex():
     assert float(m.group(1).split(",")[0]) == 2.3021
 
 
+@pytest.mark.slow
 def test_bf16_mixed_precision_learns_and_keeps_f32_state():
     """--bf16 mode: bf16 forward/backward, f32 master state. The model must
     still learn, params/opt-state/BN stats must stay f32, and the codec

@@ -31,6 +31,7 @@ def _setup(opt):
 
 @pytest.mark.parametrize("opt_name", ["sgd", "adam"])
 @pytest.mark.parametrize("use_codec", [False, True])
+@pytest.mark.slow
 def test_zero1_matches_replicated_update(opt_name, use_codec):
     """Two steps with sharded optimizer state land on the same params as
     the replicated update (elementwise optimizers are slice-invariant)."""
@@ -105,6 +106,7 @@ def test_zero1_rejects_global_mixing_optimizer():
     zero1_state(mesh, state0, make_optimizer("adam", lr=1e-2))
 
 
+@pytest.mark.slow
 def test_zero1_checkpoint_resume_preserves_momentum(tmp_path):
     """A zero1-written checkpoint resumes INTO the zero1 layout: the flat
     sharded momentum buffers round-trip and the resumed run continues
@@ -156,6 +158,7 @@ def test_zero1_checkpoint_resume_preserves_momentum(tmp_path):
 
 
 @pytest.mark.parametrize("use_codec", [False, True])
+@pytest.mark.slow
 def test_grad_accum_matches_full_batch(use_codec):
     """grad_accum=2 on a BN-free model == one full-batch step: the mean of
     per-microbatch gradients equals the full-batch gradient, so the update
@@ -196,6 +199,7 @@ def test_grad_accum_rejects_indivisible():
         step(state, jax.random.PRNGKey(0), si, sl)
 
 
+@pytest.mark.slow
 def test_zero1_resume_from_replicated_checkpoint(tmp_path):
     """Resuming --zero1 from a checkpoint written WITHOUT zero1: flax's
     restore does not raise on layout mismatch, so the loop must detect it
