@@ -110,7 +110,7 @@ def crossover_report(
             t_svd = svd_step_s + ag / bw
             per_bw[label] = {
                 "dense_ms": round(t_dense * 1e3, 3),
-                "svd_ms": round(t_svd * 1e3, 3),
+                "compressed_ms": round(t_svd * 1e3, 3),
                 "speedup": round(t_dense / t_svd, 3),
             }
         # JSON-safe crossover: inf (tax <= 0 — compression is free or

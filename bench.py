@@ -248,12 +248,16 @@ def measure_ours(cfg: dict) -> dict:
             def body(acc, i):
                 gg = jax.tree_util.tree_map(lambda a: a + acc * 1e-30, g)
                 p, _ = encode_tree(codec, jax.random.fold_in(k, i), gg)
-                leaves = jax.tree_util.tree_leaves(p)
-                tot = sum(
-                    jnp.vdot(l, l) for l in leaves
-                    if jnp.issubdtype(l.dtype, jnp.floating)
-                )
-                return jnp.float32(tot * 1e-20), None
+                # EVERY leaf must stay live: summing only floating leaves
+                # would let XLA dead-code-eliminate the uint32 bit-packing
+                # that IS the bulk of a QSGD encode (review r4 finding)
+                tot = jnp.float32(0)
+                for l in jax.tree_util.tree_leaves(p):
+                    if jnp.issubdtype(l.dtype, jnp.floating):
+                        tot = tot + jnp.vdot(l, l) * 1e-20
+                    else:
+                        tot = tot + jnp.sum(l.astype(jnp.float32)) * 1e-30
+                return tot, None
 
             acc, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(STEPS))
             return acc

@@ -29,14 +29,15 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The recipe is calibrated at a 4-way dp mesh (batch 32); on a 1-device CPU
-# the batch silently shrinks to 8 and the gate numbers shift. Force the
-# virtual device count BEFORE jax import when running on host CPU.
-if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
-    _fl = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _fl:
-        os.environ["XLA_FLAGS"] = (
-            _fl + " --xla_force_host_platform_device_count=4"
-        ).strip()
+# the batch silently shrinks to 8 and the gate numbers mean nothing. Force
+# the virtual device count BEFORE jax import unconditionally — the flag
+# only affects the HOST platform, so it is inert on a real TPU run — and
+# hard-fail after backend init if fewer than 4 devices resolved anyway.
+_fl = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _fl:
+    os.environ["XLA_FLAGS"] = (
+        _fl + " --xla_force_host_platform_device_count=4"
+    ).strip()
 
 
 def main() -> int:
@@ -78,6 +79,13 @@ def main() -> int:
     from atomo_tpu.training import create_state, make_optimizer
 
     n_dev = min(4, len(jax.devices()))
+    if n_dev < 4:
+        raise SystemExit(
+            f"only {n_dev} device(s) resolved; the gate's bound/rank are "
+            "calibrated at the 4-way batch-32 recipe — running at a smaller "
+            "batch would score against the wrong calibration (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=4 on CPU)"
+        )
     cfg = dict(vocab_size=64, max_len=64, width=64, depth=2, num_heads=4)
     batch, seq = 8 * n_dev, 64
     mesh = make_mesh(n_dev, axes=(("dp", n_dev), ("sp", 1)))

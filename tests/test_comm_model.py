@@ -61,7 +61,7 @@ def test_crossover_report_shape_and_consistency():
         for label, cell in row["implied"].items():
             # speedup must equal the ratio of the implied step times
             assert math.isclose(
-                cell["speedup"], cell["dense_ms"] / cell["svd_ms"], rel_tol=5e-3
+                cell["speedup"], cell["dense_ms"] / cell["compressed_ms"], rel_tol=5e-3
             )
         # the slowest fabric must favor compression the most
         sp = [row["implied"][k]["speedup"] for k in
