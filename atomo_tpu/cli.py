@@ -80,13 +80,26 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
     t = parser.add_argument_group("tpu-native flags")
     t.add_argument("--n-devices", type=int, default=0,
                    help="devices in the dp mesh; 0 = all visible, 1 = single-host")
-    t.add_argument("--aggregate", type=str, default="gather",
-                   choices=["gather", "psum", "hierarchical"],
-                   help="factor all_gather vs dense psum aggregation; "
+    t.add_argument("--aggregate", type=str, default="auto",
+                   choices=["auto", "gather", "psum", "hierarchical"],
+                   help="gradient exchange mode: gather = factor all_gather "
+                        "(compressed wire), psum = dense all-reduce, "
                         "hierarchical = dense psum over the fast fabric "
                         "(ICI) then factor all_gather over the slow one "
-                        "(DCN) — see --dcn-ways and "
-                        "artifacts/COMM_CROSSOVER.md")
+                        "(DCN) — see --dcn-ways. auto (default) picks per "
+                        "deployment from the measured comm-cost model and "
+                        "prints why (utils/comm_model.choose_aggregate, "
+                        "artifacts/COMM_CROSSOVER.md)")
+    t.add_argument("--fabric", type=str, default="auto", metavar="F",
+                   help="fabric for --aggregate auto's ADVISORY (the mode "
+                        "itself is decided by wire bytes + host topology): "
+                        "auto (ici single-host, dcn multi-host) | ici | "
+                        "dcn | eth10g | a per-chip GB/s number")
+    t.add_argument("--codec-tax-ms", type=float, default=None, metavar="MS",
+                   help="measured single-chip codec tax for --aggregate "
+                        "auto's advisory; default scales the measured "
+                        "ResNet-18 anchor (artifacts/BENCH_ONCHIP_r3.md) "
+                        "by gradient size")
     t.add_argument("--dcn-ways", type=int, default=0, metavar="K",
                    help="hierarchical aggregation: number of SLOW-fabric "
                         "(outer/DCN) groups; the n-devices mesh becomes "
@@ -168,7 +181,8 @@ def _warn_dead_flags(args: argparse.Namespace) -> None:
             "parameter in the reference too, README.md:111)"
         )
     if args.num_aggregate is not None and (
-        args.aggregate != "gather" or args.code.lower() in DENSE_CODES
+        args.aggregate not in ("gather", "auto")
+        or args.code.lower() in DENSE_CODES
     ):
         warnings.warn(
             "--num-aggregate only applies to compressed gather aggregation "
@@ -250,6 +264,67 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
     return model, optimizer, codec, train_iter, test_iter, name
 
 
+def _codec_byte_budget(codec, model_init_fn) -> tuple[int, int]:
+    """(dense_bytes, payload_bytes) for one gradient exchange, computed at
+    zero cost with jax.eval_shape (static shapes make the payload size a
+    trace-time constant — codecs/base.payload_nbytes)."""
+    import jax
+
+    from atomo_tpu.codecs import encode_tree, tree_nbytes
+
+    def shapes():
+        params = model_init_fn()
+        payload, _ = encode_tree(codec, jax.random.PRNGKey(0), params)
+        return params, payload
+
+    grads_s, payload_s = jax.eval_shape(shapes)
+    return tree_nbytes(grads_s), tree_nbytes(payload_s)
+
+
+def _resolve_auto_aggregate(
+    args, codec, model_init_fn, n_dev, *, allow_hierarchical=True, log=print
+) -> str:
+    """``--aggregate auto`` (VERDICT r4 #3): pick the exchange mode from
+    the measured comm-cost model and always say why in one line."""
+    import jax
+
+    from atomo_tpu.utils.comm_model import FABRICS, choose_aggregate
+
+    n_proc = jax.process_count()
+    cross_host = (
+        n_proc > 1 or getattr(args, "dcn_ways", 0) > 1
+    ) and allow_hierarchical
+    fabric = args.fabric
+    if fabric == "auto":
+        bw = FABRICS["dcn" if n_proc > 1 else "ici"]
+    elif fabric in FABRICS:
+        bw = FABRICS[fabric]
+    else:
+        try:
+            bw = float(fabric) * 1e9
+        except ValueError:
+            bw = -1.0
+        if not (0 < bw < float("inf")):  # also rejects nan/inf strings
+            raise SystemExit(
+                f"--fabric {fabric!r}: expected auto | "
+                f"{' | '.join(sorted(FABRICS))} | <positive finite GB/s>"
+            )
+    dense_b = payload_b = 0
+    if codec is not None:
+        dense_b, payload_b = _codec_byte_budget(codec, model_init_fn)
+    mode, reason = choose_aggregate(
+        has_codec=codec is not None,
+        dense_bytes=dense_b,
+        payload_bytes=payload_b,
+        ways=n_dev,
+        fabric_bw=bw,
+        tax_s=None if args.codec_tax_ms is None else args.codec_tax_ms / 1e3,
+        cross_host=cross_host,
+    )
+    log(f"--aggregate auto -> {mode} ({reason})")
+    return mode
+
+
 def cmd_train(args: argparse.Namespace) -> int:
     import jax
     import jax.numpy as jnp
@@ -287,6 +362,35 @@ def cmd_train(args: argparse.Namespace) -> int:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
         from atomo_tpu.training import stepwise_shrink
 
+        if args.aggregate == "auto":
+            # shape only — do NOT pull a batch: epoch() advances the
+            # iterator's persistent shuffle RNG, which would change the
+            # training data order vs an explicit --aggregate run with the
+            # same seed (code-review r5 finding)
+            sample = jnp.zeros(
+                (1,) + tuple(train_iter.images.shape[1:]), jnp.float32
+            )
+
+            def _init_params():
+                return model.init(
+                    {"params": jax.random.PRNGKey(0),
+                     "dropout": jax.random.PRNGKey(0)},
+                    sample, train=False,
+                )["params"]
+
+            args.aggregate = _resolve_auto_aggregate(
+                args, codec, _init_params, n_dev
+            )
+            if (
+                args.num_aggregate is not None
+                and codec is not None
+                and args.aggregate != "gather"
+            ):
+                warnings.warn(
+                    "--num-aggregate only applies to gather aggregation; "
+                    f"--aggregate auto resolved to {args.aggregate!r} — "
+                    "pass --aggregate gather explicitly to subset replicas"
+                )
         inner_axis = None
         if args.aggregate == "hierarchical":
             k = args.dcn_ways or max(jax.process_count(), 2)
@@ -401,11 +505,37 @@ def cmd_lm(args: argparse.Namespace) -> int:
     if args.batch_size % dp:
         raise SystemExit(f"--batch-size {args.batch_size} not divisible by dp={dp}")
 
+    # Width-aware rank policy (VERDICT r4 weak #8): rank 3 measurably
+    # FLOORS a width-64 LM at 1.39x dense CE while rank 6 passes the
+    # convergence gate (artifacts/LM_CONVERGENCE.md) — transformer matrix
+    # width sets the rank budget. Default (0) scales rank to preserve the
+    # verified 6/64 rank/width operating point; an explicit below-floor
+    # rank runs, but never silently.
+    svd_rank = args.svd_rank
+    if args.code.lower().startswith("svd"):  # svd AND svd_budget: rank 0
+        # would mean full-rank payloads / empty Bernoulli keep-sets
+        # ceil(width * 6/64): the verified ratio, exact at the anchor
+        rank_floor = max(2, -(-args.width * 6 // 64))
+        if svd_rank <= 0:
+            svd_rank = rank_floor
+            print(
+                f"--svd-rank auto -> {svd_rank} for width {args.width} "
+                "(anchored at the verified rank-6/width-64 operating "
+                "point, artifacts/LM_CONVERGENCE.md)"
+            )
+        elif svd_rank < rank_floor:
+            warnings.warn(
+                f"--svd-rank {svd_rank} is below the width-scaled floor "
+                f"{rank_floor} for --width {args.width}: rank 3 floors a "
+                "width-64 LM at 1.39x dense CE "
+                "(artifacts/LM_CONVERGENCE.md) — expect a loss floor; use "
+                "--svd-rank 0 for the width-scaled default"
+            )
     codec = None
     if args.code.lower() not in DENSE_CODES:
         codec = get_codec(
             args.code,
-            svd_rank=args.svd_rank,
+            svd_rank=svd_rank,
             quantization_level=args.quantization_level,
             bucket_size=args.bucket_size,
             sample=getattr(args, "sample", "fixed_k"),
@@ -445,6 +575,27 @@ def cmd_lm(args: argparse.Namespace) -> int:
     key = jax.random.PRNGKey(args.seed)
     compute_dtype = jax.numpy.bfloat16 if args.bf16 else None
 
+    aggregate = args.aggregate
+    if aggregate == "auto":
+        # the lm path has no hierarchical mode (model axes already own the
+        # second mesh dimension), so auto picks gather vs psum over the dp
+        # axis; byte budget from the unsharded LM (tp/ep/pp shard both
+        # sides of the ratio equally — decision-equivalent heuristic)
+        from atomo_tpu.models.transformer import TransformerLM as _LM
+
+        sample = jax.numpy.zeros((1, args.seq_len), jax.numpy.int32)
+
+        def _init_params():
+            return _LM(**cfg).init(
+                {"params": jax.random.PRNGKey(0),
+                 "dropout": jax.random.PRNGKey(0)},
+                sample, train=False,
+            )["params"]
+
+        aggregate = _resolve_auto_aggregate(
+            args, codec, _init_params, dp, allow_hierarchical=False
+        )
+
     # layout-inapplicable flags: warn, don't silently ignore (the train
     # subcommand's _warn_dead_flags precedent)
     defaults = {"attn_impl": "ring", "num_experts": 8, "microbatches": 2}
@@ -472,7 +623,7 @@ def cmd_lm(args: argparse.Namespace) -> int:
         state = replicate_state(mesh, state)
         step = make_lm_train_step(
             cfg, optimizer, mesh, codec, attn_impl=args.attn_impl,
-            compute_dtype=compute_dtype,
+            compute_dtype=compute_dtype, aggregate=aggregate,
         )
         shard = lambda t: shard_tokens(mesh, t)  # noqa: E731
     elif layout == "dp-tp":
@@ -486,7 +637,8 @@ def cmd_lm(args: argparse.Namespace) -> int:
         except ValueError as e:  # sizing errors -> clean one-liner
             raise SystemExit(str(e)) from None
         step = make_tp_lm_train_step(
-            cfg, optimizer, mesh, specs, codec, compute_dtype=compute_dtype
+            cfg, optimizer, mesh, specs, codec, compute_dtype=compute_dtype,
+            aggregate=aggregate,
         )
         shard = lambda t: shard_tp_tokens(mesh, t)  # noqa: E731
     elif layout == "dp-ep":
@@ -501,7 +653,8 @@ def cmd_lm(args: argparse.Namespace) -> int:
         except ValueError as e:
             raise SystemExit(str(e)) from None
         step = make_moe_lm_train_step(
-            cfg, optimizer, mesh, specs, codec, compute_dtype=compute_dtype
+            cfg, optimizer, mesh, specs, codec, compute_dtype=compute_dtype,
+            aggregate=aggregate,
         )
         shard = lambda t: shard_moe_tokens(mesh, t)  # noqa: E731
     elif layout == "dp-pp":
@@ -526,7 +679,7 @@ def cmd_lm(args: argparse.Namespace) -> int:
         step = make_pp_lm_train_step(
             cfg, optimizer, mesh, specs, codec,
             num_microbatches=args.microbatches,
-            compute_dtype=compute_dtype,
+            compute_dtype=compute_dtype, aggregate=aggregate,
         )
         shard = lambda t: shard_pp_tokens(mesh, t)  # noqa: E731
     else:  # pragma: no cover - argparse choices guard this
@@ -804,7 +957,23 @@ def build_parser() -> argparse.ArgumentParser:
                            "shardings)")
     p_lm.add_argument("--compress", action="store_true", default=False,
                       help="lossless-compress checkpoints (C++ native codec)")
-    p_lm.add_argument("--svd-rank", type=int, default=3)
+    p_lm.add_argument("--svd-rank", type=int, default=0,
+                      help="0 (default) = width-scaled auto rank; an "
+                           "explicit rank below the width floor warns "
+                           "(artifacts/LM_CONVERGENCE.md)")
+    p_lm.add_argument("--aggregate", type=str, default="auto",
+                      choices=["auto", "gather", "psum"],
+                      help="dp gradient exchange: factor all_gather vs "
+                           "dense all-reduce; auto picks from the comm-cost "
+                           "model and prints why")
+    p_lm.add_argument("--fabric", type=str, default="auto", metavar="F",
+                      help="fabric for --aggregate auto's advisory line: "
+                           "auto | ici | dcn | eth10g | a per-chip GB/s "
+                           "number")
+    p_lm.add_argument("--codec-tax-ms", type=float, default=None,
+                      metavar="MS",
+                      help="measured single-chip codec tax for --aggregate "
+                           "auto (default: size-scaled measured anchor)")
     p_lm.add_argument("--sample", type=str, default="fixed_k",
                       choices=["fixed_k", "bernoulli_budget", "bernoulli",
                                "topk"])

@@ -27,7 +27,12 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from atomo_tpu.codecs import decode_mean_tree, encode_tree, tree_nbytes
+from atomo_tpu.codecs import (
+    decode_mean_tree,
+    decode_tree,
+    encode_tree,
+    tree_nbytes,
+)
 from atomo_tpu.parallel.ring import ATTENTION_IMPLS
 from atomo_tpu.training.trainer import TrainState, cast_params
 
@@ -60,6 +65,7 @@ def compressed_dp_update(
     *,
     dp_axis: str,
     n_dp: int,
+    aggregate: str = "gather",
 ):
     """The shared per-shard tail of every compressed-DP train step: encode
     this replica's (already-completed) gradient, all_gather payloads over
@@ -67,18 +73,31 @@ def compressed_dp_update(
     pmean when ``codec`` is None. Returns (new_state, metrics). Used by the
     dp x sp (make_lm_train_step) and dp x tp (parallel.tp) steps; gradients
     may be model-sharded on other mesh axes — each shard exchanges its own
-    slice over dp, so compression composes with model sharding."""
+    slice over dp, so compression composes with model sharding.
+
+    ``aggregate="psum"`` with a codec keeps the encode->decode round trip
+    (the quantization-noise semantics) but exchanges DENSE gradients with a
+    pmean — the mode ``--aggregate auto`` picks on fast ICI, where the
+    factor gather's codec tax loses to the wire saving
+    (utils/comm_model.choose_aggregate)."""
     dense_bytes = tree_nbytes(grads)
     if codec is None:
         mean_grads = jax.lax.pmean(grads, dp_axis)
         msg_bytes = dense_bytes
-    else:
+    elif aggregate == "psum":
+        payloads, _ = encode_tree(codec, k_codec, grads)
+        decoded = decode_tree(codec, payloads, grads)
+        mean_grads = jax.lax.pmean(decoded, dp_axis)
+        msg_bytes = dense_bytes  # the wire truly carries dense bytes here
+    elif aggregate == "gather":
         payloads, stats = encode_tree(codec, k_codec, grads)
         msg_bytes = stats.payload_bytes
         gathered = jax.lax.all_gather(payloads, dp_axis)
         # fused decode_mean where the codec provides it (SVD: one
         # (m, N·k)@(N·k, n) matmul), vmap-decode + mean otherwise
         mean_grads = decode_mean_tree(codec, gathered, grads, n_dp)
+    else:
+        raise ValueError(f"unknown aggregate mode {aggregate!r}")
 
     updates, new_opt = optimizer.update(mean_grads, state.opt_state, state.params)
     new_params = optax.apply_updates(state.params, updates)
@@ -109,6 +128,7 @@ def make_lm_train_step(
     sp_axis: str = "sp",
     attn_impl: str = "ring",
     compute_dtype=None,
+    aggregate: str = "gather",
 ):
     """Jitted (state, key, tokens) -> (state, metrics) with tokens (B, S)
     sharded batch-over-dp and sequence-over-sp. ``lm_config`` are
@@ -172,7 +192,7 @@ def make_lm_train_step(
 
         return compressed_dp_update(
             optimizer, codec, state, k_codec, grads, loss,
-            dp_axis=dp_axis, n_dp=n_dp,
+            dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
         )
 
     sharded = jax.shard_map(

@@ -236,6 +236,7 @@ def make_moe_lm_train_step(
     capacity_factor: float = 1.25,
     aux_weight: float = 0.01,
     compute_dtype=None,
+    aggregate: str = "gather",
 ):
     """Jitted (state, key, tokens) -> (state, metrics): switch-MoE LM with
     experts sharded over ep and ATOMO-compressed gradient exchange over dp.
@@ -285,7 +286,7 @@ def make_moe_lm_train_step(
         replica_loss = jax.lax.psum(loss, ep_axis)
         return compressed_dp_update(
             optimizer, codec, state, k_codec, grads, replica_loss,
-            dp_axis=dp_axis, n_dp=n_dp,
+            dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
         )
 
     sharded = jax.shard_map(

@@ -176,6 +176,7 @@ def make_pp_lm_train_step(
     pp_axis: str = "pp",
     num_microbatches: int = 2,
     compute_dtype=None,
+    aggregate: str = "gather",
 ):
     """Jitted (state, key, tokens) -> (state, metrics): GPipe pipeline over
     pp with ATOMO-compressed gradient exchange over dp.
@@ -247,7 +248,7 @@ def make_pp_lm_train_step(
         replica_loss = jax.lax.psum(loss, pp_axis)
         return compressed_dp_update(
             optimizer, codec, state, k_codec, grads, replica_loss,
-            dp_axis=dp_axis, n_dp=n_dp,
+            dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
         )
 
     sharded = jax.shard_map(

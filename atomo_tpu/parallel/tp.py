@@ -258,6 +258,7 @@ def make_tp_lm_train_step(
     dp_axis: str = "dp",
     tp_axis: str = "tp",
     compute_dtype=None,
+    aggregate: str = "gather",
 ):
     """Jitted (state, key, tokens) -> (state, metrics): Megatron-TP forward/
     backward with ATOMO-compressed gradient exchange over dp.
@@ -298,7 +299,7 @@ def make_tp_lm_train_step(
 
         return compressed_dp_update(
             optimizer, codec, state, k_codec, grads, loss,
-            dp_axis=dp_axis, n_dp=n_dp,
+            dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
         )
 
     sharded = jax.shard_map(
@@ -332,6 +333,7 @@ def make_tp_sp_lm_train_step(
     sp_axis: str = "sp",
     attn_impl: str = "ring",
     compute_dtype=None,
+    aggregate: str = "gather",
 ):
     """Jitted (state, key, tokens) -> (state, metrics) over a 3-D mesh:
     batch over dp, heads/hidden/vocab over tp, SEQUENCE over sp — the full
@@ -397,7 +399,7 @@ def make_tp_sp_lm_train_step(
         )
         return compressed_dp_update(
             optimizer, codec, state, k_codec, grads, loss,
-            dp_axis=dp_axis, n_dp=n_dp,
+            dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
         )
 
     sharded = jax.shard_map(

@@ -50,6 +50,104 @@ DEFAULT_BANDWIDTHS = (
     ("eth10G_1.25GBps", 1.25e9),
 )
 
+# named fabric presets for --fabric (per-chip effective ring bandwidth of
+# the slowest link on the gradient path; see module docstring sources)
+FABRICS = {"ici": 45e9, "dcn": 6.25e9, "eth10g": 1.25e9}
+
+# Measured single-chip codec tax anchor: ResNet-18/CIFAR-10 on TPU v5e,
+# artifacts/BENCH_ONCHIP_r3.md — svd3 9.01 ms vs dense 6.50 ms (tax 2.5 ms
+# on a 44.7 MB dense gradient); the qsgd encode measured ~2.5 ms on the
+# same tree. `estimate_codec_tax_s` scales that anchor linearly with the
+# dense gradient size: the encode work (matmuls/eighs per layer for svd,
+# elementwise quantize for qsgd) is ~linear in elements at fixed shapes.
+# An estimate, not a measurement — overridable via --codec-tax-ms.
+_TAX_ANCHOR_S = 2.5e-3
+_TAX_ANCHOR_BYTES = 44.7e6
+
+
+def estimate_codec_tax_s(dense_bytes: float) -> float:
+    return _TAX_ANCHOR_S * float(dense_bytes) / _TAX_ANCHOR_BYTES
+
+
+def choose_aggregate(
+    *,
+    has_codec: bool,
+    dense_bytes: float,
+    payload_bytes: float,
+    ways: int,
+    fabric_bw: float,
+    tax_s: float | None = None,
+    cross_host: bool = False,
+) -> tuple[str, str]:
+    """``--aggregate auto``: pick gather / psum / hierarchical + why.
+
+    The reference never had this choice — its one PS pushed every message
+    over one 10 GbE fabric (src/distributed_worker.py:330-335). Here the
+    framework has three exchange modes and a measured cost model
+    (artifacts/COMM_CROSSOVER.md), so the default can pick per deployment:
+
+      * no compressing codec         -> psum (dense all-reduce; nothing else
+                                       makes sense)
+      * mesh crosses hosts (DCN/
+        Ethernet on the outer axis)  -> hierarchical (dense psum rides ICI,
+                                       factors cross the slow fabric)
+      * single fabric: with a codec BOTH modes pay the encode->decode
+        round trip (psum with a codec is the same estimator over a dense
+        wire — the quantization noise is the user's algorithm choice, not
+        ours to silently drop), so the tax cancels and the choice reduces
+        to wire bytes: gather iff P*(N-1) < 2*D*(N-1)/N, i.e.
+        N < 2*(byte reduction). The fabric and tax still decide the
+        ADVISORY: when the wire saving at this fabric is smaller than the
+        tax, compression itself is costing wall-clock vs dense training
+        (--code sgd) and the printed line says so with numbers — the
+        measured single-chip truth (artifacts/BENCH_ONCHIP_r3.md: svd3
+        9.01 ms vs dense 6.50 ms with no wire to save).
+
+    Returns (mode, one-line justification) — the caller prints the line so
+    the selection is never silent.
+    """
+    if not has_codec:
+        return "psum", "no compressing codec: dense all-reduce (psum)"
+    if ways <= 1:
+        return (
+            "psum",
+            "single device: no exchange; psum keeps codec semantics "
+            "without a gather",
+        )
+    if cross_host:
+        return (
+            "hierarchical",
+            "mesh crosses hosts: dense psum over ICI, factors over the "
+            "slow inter-host fabric (artifacts/COMM_CROSSOVER.md concl. 2)",
+        )
+    ar = ring_allreduce_wire_bytes(dense_bytes, ways)
+    ag = ring_allgather_wire_bytes(payload_bytes, ways)
+    n_star = max_beneficial_ways(dense_bytes, payload_bytes)
+    if ag >= ar:
+        return (
+            "psum",
+            f"dense all-reduce wins at {ways} ways: the factor all_gather "
+            f"would move {ag / 1e6:.2f} MB/chip >= {ar / 1e6:.2f} MB/chip "
+            f"dense (compression stops paying past N = 2x reduction = "
+            f"{n_star:.0f}); the codec round trip runs either way",
+        )
+    if tax_s is None:
+        tax_s = estimate_codec_tax_s(dense_bytes)
+    saved_s = (ar - ag) / fabric_bw
+    reason = (
+        f"factor all_gather wins at {ways} ways: {ag / 1e6:.2f} MB/chip "
+        f"vs {ar / 1e6:.2f} MB/chip dense (both modes pay the codec "
+        "round trip, so wire bytes decide)"
+    )
+    if saved_s < tax_s:
+        reason += (
+            f"; NOTE on {fabric_bw / 1e9:.2f} GB/s/chip the wire saving "
+            f"{saved_s * 1e3:.2f} ms < codec tax ~{tax_s * 1e3:.2f} ms — "
+            "compression is costing wall-clock here; dense training "
+            "(--code sgd) would be faster end-to-end"
+        )
+    return "gather", reason
+
 
 def ring_allreduce_wire_bytes(dense_bytes: float, ways: int) -> float:
     """Per-chip one-direction wire traffic of a ring all-reduce."""

@@ -88,6 +88,17 @@ CONFIGS = {
     5: dict(metric="resnet110_cifar10_svd3_budget_step_time", network="resnet110",
             input=(32, 32, 3), batch=128, code="svd_budget", rank=3, ways=64,
             dense_compare=True),
+    # Config 6 (VERDICT r4 next-round #9): the high-MFU operating point.
+    # The CIFAR ladder is HBM-bound with single-digit MFU ceilings by
+    # physics (artifacts/ROOFLINE.md); this one is matmul-dominated —
+    # TransformerLM width 512, bf16 MXU compute, 16k tokens/step — so the
+    # framework demonstrates a high-MFU regime and the codec's behavior
+    # there. rank 48 = the width-scaled policy (ceil(512*6/64), the
+    # verified rank/width ratio — artifacts/LM_CONVERGENCE.md). No
+    # reference analogue (CV-only): baseline "none".
+    6: dict(metric="transformer_lm_w512_svd48_step_time", kind="lm",
+            width=512, depth=8, num_heads=8, vocab=8192, seq=512, batch=32,
+            code="svd", rank=48, bf16=True, ways=8, dense_compare=True),
 }
 
 # Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
@@ -138,6 +149,130 @@ def _flops_per_step(step_fn, *args):
         return None
 
 
+def measure_lm(cfg: dict) -> dict:
+    """Config-6 measurement: single-chip TransformerLM step (fwd + bwd +
+    encode + decode + update in one XLA program via parallel.lm's step on
+    a 1-device mesh), scan-fenced exactly like the CV path."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import get_codec
+    from atomo_tpu.models.transformer import TransformerLM
+    from atomo_tpu.parallel.lm import make_lm_train_step, shard_tokens
+    from atomo_tpu.parallel.mesh import make_mesh
+    from atomo_tpu.parallel.replicated import replicate_state
+    from atomo_tpu.training import create_state, make_optimizer
+
+    lm_cfg = dict(
+        vocab_size=cfg["vocab"], max_len=cfg["seq"], width=cfg["width"],
+        depth=cfg["depth"], num_heads=cfg["num_heads"],
+    )
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    mesh = make_mesh(1, axes=(("dp", 1), ("sp", 1)))
+    key = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, cfg["seq"]), jnp.int32)
+    state0 = create_state(TransformerLM(**lm_cfg), opt, key, sample)
+    codec = get_codec(cfg["code"], svd_rank=cfg["rank"], quantization_level=4)
+    compute_dtype = jnp.bfloat16 if cfg.get("bf16") else None
+    tokens = shard_tokens(
+        mesh,
+        jax.random.randint(
+            jax.random.PRNGKey(1), (cfg["batch"], cfg["seq"]), 0,
+            cfg["vocab"], dtype=jnp.int32,
+        ),
+    )
+
+    def timed_lm(step_fn, st):
+        """Same discipline as the CV `timed`: scan the steps under one
+        dispatch, fence with a scalar fetch, best-of-3."""
+
+        @jax.jit
+        def multi(s0, k, toks):
+            def body(s, _):
+                s, m = step_fn(s, k, toks)
+                return s, m["loss"]
+
+            s_out, losses = jax.lax.scan(body, s0, None, length=STEPS)
+            return s_out, losses[-1]
+
+        m = None
+        for _ in range(WARMUP):
+            st, m = step_fn(st, key, tokens)
+        if m is None:  # WARMUP=0: still need one stepped metrics dict for
+            st, m = step_fn(st, key, tokens)  # the byte accounting
+        float(m["loss"])
+        st, last = multi(st, key, tokens)
+        float(last)
+        dt, sync = float("inf"), float("nan")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            st, last = multi(st, key, tokens)
+            sync = float(last)
+            dt = min(dt, (time.perf_counter() - t0) / STEPS)
+        return dt, st, m, sync
+
+    step = make_lm_train_step(
+        lm_cfg, opt, mesh, codec, compute_dtype=compute_dtype
+    )
+    state = replicate_state(mesh, state0)
+    flops = _flops_per_step(step, state, key, tokens)
+    dt, state, metrics, sync = timed_lm(step, state)
+
+    dense = int(metrics["dense_bytes"]) if metrics else 0
+    msg = int(metrics["msg_bytes"]) if metrics else 1
+    dev = jax.devices()[0]
+    peak = _peak_tflops(dev.device_kind) if dev.platform == "tpu" else None
+    mfu = (flops / dt / (peak * 1e12)) if (flops and peak) else None
+    tokens_per_step = cfg["batch"] * cfg["seq"]
+
+    valid, invalid_reason = True, None
+    if not math.isfinite(sync):
+        valid, invalid_reason = False, f"sync scalar not finite: {sync}"
+    elif mfu is not None and not (0.0 < mfu < 1.0):
+        valid, invalid_reason = False, f"mfu {mfu:.3f} outside (0, 1)"
+
+    out = dict(
+        metric=cfg["metric"],
+        value=round(dt * 1e3, 3),
+        unit="ms/step",
+        config=dict(
+            kind="lm", **lm_cfg, batch=cfg["batch"], code=cfg["code"],
+            rank=cfg["rank"], bf16=bool(cfg.get("bf16")), warmup=WARMUP,
+            steps=STEPS, codec_defaults=repr(codec),
+        ),
+        byte_reduction=round(dense / max(msg, 1), 2),
+        mfu=round(mfu, 4) if mfu is not None else None,
+        flops_per_step=flops,
+        peak_tflops=peak,
+        tokens_per_step=tokens_per_step,
+        tokens_per_sec=round(tokens_per_step / dt, 1),
+        platform=dev.platform,
+        device=dev.device_kind,
+        ways=cfg.get("ways", 1),
+        dispatch_ms_per_step=None,
+        chips_measured=1,
+        measurement_valid=valid,
+        invalid_reason=invalid_reason,
+        timing="scan-fenced",
+    )
+    if cfg.get("dense_compare"):
+        dense_step = make_lm_train_step(
+            lm_cfg, opt, mesh, None, compute_dtype=compute_dtype
+        )
+        ddt, _, _, dsync = timed_lm(dense_step, replicate_state(mesh, state0))
+        out["dense_ms_per_step"] = round(ddt * 1e3, 3)
+        if not math.isfinite(dsync):
+            _mark_invalid(out, f"dense sync scalar not finite: {dsync}")
+        else:
+            from atomo_tpu.utils.comm_model import crossover_report
+
+            out["comm_model"] = crossover_report(
+                dense_bytes=dense, payload_bytes=msg,
+                dense_step_s=ddt, svd_step_s=dt,
+            )
+    return out
+
+
 def measure_ours(cfg: dict) -> dict:
     import jax
     import jax.numpy as jnp
@@ -145,6 +280,9 @@ def measure_ours(cfg: dict) -> dict:
     from atomo_tpu.codecs import get_codec
     from atomo_tpu.models import get_model
     from atomo_tpu.training import create_state, make_optimizer, make_train_step
+
+    if cfg.get("kind") == "lm":
+        return measure_lm(cfg)
 
     model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
@@ -745,12 +883,37 @@ def _run_child(
     return None, f"rc={rc}: " + " | ".join(tail)
 
 
-def _bench_one(config: int, no_baseline: bool) -> dict:
+def _probe_tpu() -> bool:
+    """ONE cheap TPU-reachability probe before the ladder. When the axon
+    relay is down, every TPU attempt burns BACKEND_TIMEOUT_S before dying;
+    at RETRIES x 6 configs that is hours — round 4 lost its entire bench
+    window to exactly this (BENCH_r04.json: rc=124, empty tail). One probe
+    up front turns a dead relay into ~5 lost minutes + an honest CPU
+    ladder."""
+    code = (
+        "import bench, sys; bench._honor_platform_env(); "
+        "d = bench._backend_or_die(); "
+        "sys.exit(0 if d and d[0].platform == 'tpu' else 3)"
+    )
+    try:
+        rc = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            timeout=BACKEND_TIMEOUT_S + 60,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        ).returncode
+        return rc == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _bench_one(config: int, no_baseline: bool, try_tpu: bool = True) -> dict:
     tail = ["--config", str(config)]
     if no_baseline:
         tail.append("--no-baseline")
     last_err = "unknown"
-    for attempt in range(RETRIES):
+    for attempt in range(RETRIES if try_tpu else 0):
         if attempt:
             time.sleep(15 * attempt)  # axon tunnel contention backoff
         # TPU attempts get a TIGHTER budget than the generous child default
@@ -763,6 +926,8 @@ def _bench_one(config: int, no_baseline: bool) -> dict:
         if parsed is not None:
             return parsed
         last_err = err
+    if not try_tpu:
+        last_err = "tpu probe failed at ladder start; skipped tpu attempts"
     # final fallback: measure on the CPU backend rather than report nothing
     # (fast mode: 4 steps, no side-compares — existence beats precision on
     # a 1-core host; the row carries the degraded-protocol marker in error)
@@ -805,6 +970,7 @@ def main() -> int:
     if args.config is not None:
         print(json.dumps(_bench_one(args.config, args.no_baseline)))
         return 0
+    try_tpu = os.environ.get("JAX_PLATFORMS", "") != "cpu" and _probe_tpu()
     # default: the whole BASELINE.md ladder (VERDICT r2 next-round #4) —
     # one row per config as it completes, then an aggregate headline line
     # (config 2's fields + all rows so far under "configs"). The HEADLINE
@@ -814,7 +980,7 @@ def main() -> int:
     # this). The aggregate re-emits after every later config.
     rows = {}
     for c in [2] + [k for k in sorted(CONFIGS) if k != 2]:
-        rows[c] = _bench_one(c, args.no_baseline)
+        rows[c] = _bench_one(c, args.no_baseline, try_tpu=try_tpu)
         print(json.dumps(rows[c]), flush=True)
         if 2 in rows:
             headline = dict(rows[2])

@@ -163,7 +163,10 @@ def main() -> int:
     discriminates = bool(
         ratio < args.ratio_bound and ratio_broken >= args.ratio_bound
     )
-    ok = ratio < args.ratio_bound and converged
+    # the verdict requires all three: parity, real convergence, AND a gate
+    # that provably fails the biased ablation (ADVICE r4: a non-discriminating
+    # gate must not report PASS)
+    ok = ratio < args.ratio_bound and converged and discriminates
 
     os.makedirs(args.out, exist_ok=True)
     payload = dict(

@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-5 on-chip evidence queue (VERDICT r4 next-round #1).
+#
+# Runs the full armed queue into artifacts/onchip_r5/ the moment the axon
+# relay is healthy. Order matters: cheapest/highest-value first so a relay
+# window that closes early still yields evidence.
+#
+#   1. tests_tpu/           — codec + flash-attention Mosaic compile on TPU
+#   2. bench.py --all       — all ladder configs with the final gram/CholeskyQR2
+#                             codec (config 5 expected <<58.4 ms)
+#   3. bf16_probe.py        — localize the bf16-slower-than-f32 regression
+#   4. convergence_artifact — ResNet-18 hardened (label-noise + ablation) gate
+#
+# Usage: bash scripts/onchip_queue_r5.sh   (assumes relay already healthy)
+set -u
+cd "$(dirname "$0")/.."
+OUT=artifacts/onchip_r5
+mkdir -p "$OUT"
+TS() { date +%H:%M:%S; }
+
+echo "$(TS) queue start" | tee -a "$OUT/queue.log"
+
+echo "$(TS) [1/4] tests_tpu" | tee -a "$OUT/queue.log"
+timeout 2400 python -m pytest tests_tpu/ -q --tb=short \
+  > "$OUT/tests_tpu.log" 2>&1
+echo "$(TS) tests_tpu rc=$?" | tee -a "$OUT/queue.log"
+
+echo "$(TS) [2/4] bench --all" | tee -a "$OUT/queue.log"
+timeout 9000 python bench.py --all > "$OUT/bench_all.jsonl" 2> "$OUT/bench_all.err"
+echo "$(TS) bench rc=$?" | tee -a "$OUT/queue.log"
+
+echo "$(TS) [3/4] bf16_probe" | tee -a "$OUT/queue.log"
+timeout 2400 python scripts/bf16_probe.py > "$OUT/bf16_probe.log" 2>&1
+echo "$(TS) bf16_probe rc=$?" | tee -a "$OUT/queue.log"
+
+echo "$(TS) [4/4] convergence artifact (resnet18 hardened)" | tee -a "$OUT/queue.log"
+timeout 7200 python scripts/convergence_artifact.py --out "$OUT" \
+  > "$OUT/convergence.log" 2>&1
+echo "$(TS) convergence rc=$?" | tee -a "$OUT/queue.log"
+
+echo "$(TS) queue done" | tee -a "$OUT/queue.log"

@@ -12,6 +12,10 @@
 #   LAYOUT=dp-pp    GPipe pipeline parallelism
 #
 # WAYS sizes the model axis; the rest of the chips form the dp axis.
+#
+# SVD_RANK defaults to 0 = the width-scaled auto rank (ceil(width*6/64)):
+# a fixed rank 3 measurably floors small-width LMs
+# (artifacts/LM_CONVERGENCE.md).
 set -euo pipefail
 
 python -m atomo_tpu lm \
@@ -27,7 +31,7 @@ python -m atomo_tpu lm \
   --max-steps "${MAX_STEPS:-1000}" \
   --log-interval 10 \
   --code svd \
-  --svd-rank 3 \
+  --svd-rank "${SVD_RANK:-0}" \
   --lr 0.1 \
   --momentum 0.9 \
   --train-dir "${TRAIN_DIR:-output/lm/}" \

@@ -130,6 +130,16 @@ def main() -> None:
     assert gi.shape[0] == 8, gi.shape  # global batch = both processes' shards
 
     state, metrics = step(state, jax.random.PRNGKey(1), gi, gl)
+    # ATOMO_MP_DUMP: process 0 saves the post-step param leaves so the
+    # parent test can compare them leaf-wise against its single-process
+    # oracle (a summary scalar would absorb compensating divergences)
+    dump_path = os.environ.get("ATOMO_MP_DUMP", "")
+    if dump_path and pid == 0:
+        np.savez(
+            dump_path,
+            *[np.asarray(jax.device_get(l))
+              for l in jax.tree_util.tree_leaves(state.params)],
+        )
     # fingerprint the post-step replicated params: a cryptographic hash of
     # the raw bytes — an L1-sum scalar would absorb sub-rounding or
     # compensating divergences and defeat the bit-for-bit claim
@@ -141,6 +151,7 @@ def main() -> None:
                 "loss": float(metrics["loss"]),
                 "msg_bytes": int(metrics["msg_bytes"]),
                 "params_sha256": _params_sha256(state.params),
+                "dump_path": dump_path or None,
             }
         ),
         flush=True,

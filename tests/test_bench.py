@@ -19,14 +19,14 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     (round-3 lost its on-chip headline to a config-4 compile hang)."""
     order = []
 
-    def fake_bench_one(c, no_baseline):
+    def fake_bench_one(c, no_baseline, try_tpu=True):
         order.append(c)
         return {"metric": f"m{c}", "value": float(c), "measurement_valid": True}
 
     monkeypatch.setattr(bench, "_bench_one", fake_bench_one)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     assert bench.main() == 0
-    assert order == [2, 1, 3, 4, 5]
+    assert order == [2, 1, 3, 4, 5, 6]
 
     lines = [
         json.loads(ln)
@@ -38,7 +38,7 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     assert aggs and all(a["metric"] == "m2" for a in aggs)
     assert aggs[-1]["configs_complete"] is True
     assert [c["metric"] for c in aggs[-1]["configs"]] == [
-        "m1", "m2", "m3", "m4", "m5"
+        "m1", "m2", "m3", "m4", "m5", "m6"
     ]
     # an aggregate exists right after the FIRST config completes
     assert "configs" in lines[1]

@@ -144,6 +144,7 @@ def test_lm_subcommand_all_layouts(layout, extra, capsys):
         "--width", "16", "--depth", "2", "--num-heads", "2",
         "--batch-size", "8", "--max-steps", "2", "--log-interval", "1",
         "--n-devices", "4", "--code", "svd", "--svd-rank", "2",
+        "--aggregate", "gather",  # pin the compressed wire the Msg assert checks
         *extra,
     ])
     assert rc == 0

@@ -113,13 +113,14 @@ def test_hierarchical_validation():
 
 
 @pytest.mark.slow
-def test_hierarchical_cli_end_to_end(capsys):
+def test_hierarchical_cli_end_to_end(capsys, tmp_path):
     """--aggregate hierarchical --dcn-ways 2 drives the 2-axis mode from
     the train subcommand, including sharded eval."""
     from atomo_tpu.cli import main
 
     rc = main([
         "train", "--network", "LeNet", "--dataset", "MNIST", "--synthetic",
+        "--train-dir", str(tmp_path),
         "--batch-size", "16", "--max-steps", "2", "--log-interval", "2",
         "--n-devices", "8", "--momentum", "0.0", "--code", "svd",
         "--svd-rank", "2", "--aggregate", "hierarchical", "--dcn-ways", "2",

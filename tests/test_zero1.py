@@ -89,6 +89,63 @@ def test_zero1_opt_state_is_actually_sharded():
         assert shard.data.shape == (chunk,)  # 1/n per chip
 
 
+@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+def test_zero1_composes_with_hierarchical(opt_name):
+    """VERDICT r4 weak #7: zero1 + hierarchical aggregation. The optimizer
+    slices shard over BOTH data axes (every chip holds 1/8), and two steps
+    land on the same params as the replicated hierarchical run."""
+    from atomo_tpu.codecs import SvdCodec
+
+    opt = (
+        make_optimizer("sgd", lr=0.05, momentum=0.9)
+        if opt_name == "sgd"
+        else make_optimizer("adam", lr=1e-2)
+    )
+    mesh = make_mesh(8, axes=(("dcn", 2), ("ici", 4)))
+    model = get_model("lenet", 10)
+    images = jax.random.normal(jax.random.PRNGKey(1), (16, 28, 28, 1))
+    labels = jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 10)
+    state0 = create_state(model, opt, jax.random.PRNGKey(0), images)
+    codec = SvdCodec(rank=2)
+    kw = dict(axis="dcn", aggregate="hierarchical", inner_axis="ici")
+    copy = lambda s: jax.tree_util.tree_map(lambda x: jnp.array(x), s)  # noqa: E731
+
+    ref = replicate_state(mesh, copy(state0))
+    ref_step = make_distributed_train_step(model, opt, mesh, codec, **kw)
+    z, opt_specs = zero1_state(mesh, copy(state0), opt, axis=("dcn", "ici"))
+    z_step = make_distributed_train_step(
+        model, opt, mesh, codec, zero1_specs=opt_specs, **kw
+    )
+
+    # the memory claim: vector opt-state shards are 1/8 of the flat size
+    from jax.flatten_util import ravel_pytree
+
+    n_params = ravel_pytree(state0.params)[0].size
+    chunk = -(-n_params // 8)
+    vec_leaves = [
+        l for l in jax.tree_util.tree_leaves(z.opt_state) if l.ndim == 1
+    ]
+    assert vec_leaves
+    for leaf in vec_leaves:
+        assert leaf.shape == (8 * chunk,)
+        assert leaf.addressable_shards[0].data.shape == (chunk,)
+
+    si, sl = shard_batch(mesh, images, labels, axis=("dcn", "ici"))
+    for i in range(2):
+        key = jax.random.PRNGKey(20 + i)
+        ref, mr = ref_step(ref, key, si, sl)
+        z, mz = z_step(z, key, si, sl)
+    np.testing.assert_allclose(float(mr["loss"]), float(mz["loss"]), atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
+            atol=1e-6,
+        ),
+        jax.device_get(ref.params),
+        jax.device_get(z.params),
+    )
+
+
 def test_zero1_rejects_global_mixing_optimizer():
     """ADVICE r3 #2: an optimizer whose update mixes across elements
     (global-norm clip) would train subtly wrong under ZeRO-1 slicing; the
