@@ -57,6 +57,11 @@ def main() -> int:
                          "spectrum kept vs matrix width, so small models "
                          "need proportionally higher rank; rank 6 restores "
                          "parity at ~5x byte reduction")
+    ap.add_argument("--width", type=int, default=64,
+                    help="transformer width. Non-default widths validate "
+                         "the width-scaled rank policy (cli lm --svd-rank "
+                         "0: rank = ceil(width*6/64)) at a second measured "
+                         "point; outputs are then suffixed _w{width}")
     ap.add_argument("--token-noise", type=float, default=0.1,
                     help="fraction of stream tokens randomized: keeps the "
                          "loss floor off zero so the gate can discriminate "
@@ -86,7 +91,9 @@ def main() -> int:
             "batch would score against the wrong calibration (set "
             "XLA_FLAGS=--xla_force_host_platform_device_count=4 on CPU)"
         )
-    cfg = dict(vocab_size=64, max_len=64, width=64, depth=2, num_heads=4)
+    cfg = dict(
+        vocab_size=64, max_len=64, width=args.width, depth=2, num_heads=4
+    )
     batch, seq = 8 * n_dev, 64
     mesh = make_mesh(n_dev, axes=(("dp", n_dev), ("sp", 1)))
     # lr 0.05: at lr 0.1+momentum this width-64 LM sits on the stability
@@ -181,9 +188,10 @@ def main() -> int:
         ratio_bound=args.ratio_bound, byte_reduction=reduction,
         bytes=bytes_info, converged=converged, passes=ok, curves=curves,
     )
-    with open(os.path.join(args.out, "LM_CONVERGENCE.json"), "w") as f:
+    sfx = "" if args.width == 64 else f"_w{args.width}"
+    with open(os.path.join(args.out, f"LM_CONVERGENCE{sfx}.json"), "w") as f:
         json.dump(payload, f)
-    with open(os.path.join(args.out, "LM_CONVERGENCE.md"), "w") as f:
+    with open(os.path.join(args.out, f"LM_CONVERGENCE{sfx}.md"), "w") as f:
         f.write(
             f"# LM convergence parity: SVD rank-{args.rank} vs dense\n\n"
             f"TransformerLM ({cfg['depth']}x{cfg['width']}, vocab "

@@ -74,6 +74,28 @@ def test_cpu_fallback_row_is_headline_invalid(monkeypatch):
     assert calls["n"] == bench.RETRIES + 1
 
 
+def test_dead_relay_skips_tpu_attempts(monkeypatch):
+    """Round-4 postmortem (BENCH_r04.json rc=124, empty): with the relay
+    down, TPU attempts burned the whole ladder window. When the parent's
+    one-shot probe fails, _bench_one must go STRAIGHT to the CPU fallback
+    — zero TPU children — and still mark the row honestly."""
+    tpu_children = {"n": 0}
+
+    def fake_run_child(tail, env, timeout_s=None):
+        if env.get("JAX_PLATFORMS") == "cpu":
+            return {"metric": "m", "value": 50.0, "measurement_valid": True,
+                    "platform": "cpu"}, ""
+        tpu_children["n"] += 1
+        return None, "rc=17: wedged"
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    row = bench._bench_one(1, no_baseline=True, try_tpu=False)
+    assert tpu_children["n"] == 0
+    assert row["measurement_valid"] is False
+    assert "probe failed" in row["error"]
+
+
 def test_comm_model_attached_is_json_safe():
     """The comm model rows embedded in bench output must serialize with
     strict JSON (no Infinity tokens — code-review r4 finding)."""
