@@ -332,6 +332,18 @@ def cmd_train(args: argparse.Namespace) -> int:
     from atomo_tpu.parallel import launch
 
     _warn_dead_flags(args)
+    if args.bf16:
+        # measured on v5e (artifacts/BENCH_ONCHIP_r3.md): bf16 ran the
+        # CIFAR CNN ladder SLOWER than f32 (7.78-7.91 vs 6.50 ms/step on
+        # config 2) — these small-image convs are HBM-bound, so halving
+        # MXU time buys nothing while the casts add work. Warn rather than
+        # refuse: the mode is correct, and matmul-dominated models (the
+        # lm subcommand, bench config 6) are where it pays.
+        warnings.warn(
+            "--bf16 measured slower than f32 for the HBM-bound CIFAR-class "
+            "CNN recipes on v5e (artifacts/BENCH_ONCHIP_r3.md: 7.8 vs 6.5 "
+            "ms/step); it pays on matmul-dominated models (lm). Proceeding."
+        )
     # Multi-host: form ONE jax.distributed world before any mesh/backend use
     # (replaces the reference's mpirun rank dispatch,
     # src/distributed_nn.py:86-88,243-259). No-op on a single host.
