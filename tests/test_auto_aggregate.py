@@ -203,3 +203,27 @@ def test_lm_rank_auto_scales_with_width(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "--svd-rank auto -> 6" in out
+
+
+@pytest.mark.slow
+def test_auto_spelling_trains_identically_to_explicit(tmp_path, capsys):
+    """Seed-level reproducibility across spellings (code-review r5): the
+    auto resolver must not consume training RNG, so `--aggregate auto`
+    (resolving to gather) and `--aggregate gather` with the same seed
+    produce the SAME step-1 loss on the same data order."""
+    def run(mode):
+        args = [
+            "train", "--network", "LeNet", "--dataset", "MNIST",
+            "--synthetic", "--train-dir", str(tmp_path / mode),
+            "--batch-size", "8", "--max-steps", "1", "--eval-freq", "0",
+            "--log-interval", "1", "--n-devices", "4", "--code", "svd",
+            "--svd-rank", "2", "--momentum", "0.0", "--seed", "7",
+            "--aggregate", mode,
+        ]
+        assert main(args) == 0
+        out = capsys.readouterr().out
+        m = re.search(r"Loss: ([0-9.]+)", out)
+        assert m, out
+        return m.group(1)
+
+    assert run("auto") == run("gather")
