@@ -1,0 +1,25 @@
+#!/bin/bash
+# Relay watcher (round 5). The axon TPU tunnel comes and goes: it was
+# healthy 03:48-~04:05 this session, then wedged mid-testrun and took the
+# whole first on-chip window with it. This loop probes with a FRESH python
+# (a wedged backend never recovers in-process) every POLL_S seconds and, on
+# first health, fires scripts/onchip_queue_r5b.sh exactly once.
+#
+# Usage: nohup bash scripts/relay_watch_r5.sh >/tmp/relay_watch.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+POLL_S=${POLL_S:-180}
+LOG=/tmp/relay_r5.log
+while true; do
+  if timeout 150 python -c "
+import jax, sys
+sys.exit(0 if jax.devices()[0].platform == 'tpu' else 1)
+" >/dev/null 2>&1; then
+    echo "$(date +%H:%M:%S) relay UP — firing queue" | tee -a "$LOG"
+    bash scripts/onchip_queue_r5b.sh
+    echo "$(date +%H:%M:%S) queue finished; watcher exiting" | tee -a "$LOG"
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) relay down" >> "$LOG"
+  sleep "$POLL_S"
+done
