@@ -23,24 +23,24 @@ echo "$(TS) queue start" | tee -a "$OUT/queue.log"
 echo "$(TS) [1/5] tests_tpu" | tee -a "$OUT/queue.log"
 timeout 2400 python -m pytest tests_tpu/ -q --tb=short \
   > "$OUT/tests_tpu.log" 2>&1
-echo "$(TS) tests_tpu rc=$?" | tee -a "$OUT/queue.log"
+rc=$?; echo "$(TS) tests_tpu rc=$rc" | tee -a "$OUT/queue.log"
 
 echo "$(TS) [2/5] bench --all" | tee -a "$OUT/queue.log"
 timeout 9000 python bench.py --all > "$OUT/bench_all.jsonl" 2> "$OUT/bench_all.err"
-echo "$(TS) bench rc=$?" | tee -a "$OUT/queue.log"
+rc=$?; echo "$(TS) bench rc=$rc" | tee -a "$OUT/queue.log"
 
 echo "$(TS) [3/5] encode_profile (VERDICT r4 #2 breakdown)" | tee -a "$OUT/queue.log"
 timeout 2400 python scripts/encode_profile.py --out "$OUT" \
   > "$OUT/encode_profile.log" 2>&1
-echo "$(TS) encode_profile rc=$?" | tee -a "$OUT/queue.log"
+rc=$?; echo "$(TS) encode_profile rc=$rc" | tee -a "$OUT/queue.log"
 
 echo "$(TS) [4/5] bf16_probe" | tee -a "$OUT/queue.log"
 timeout 2400 python scripts/bf16_probe.py > "$OUT/bf16_probe.log" 2>&1
-echo "$(TS) bf16_probe rc=$?" | tee -a "$OUT/queue.log"
+rc=$?; echo "$(TS) bf16_probe rc=$rc" | tee -a "$OUT/queue.log"
 
 echo "$(TS) [5/5] convergence artifact (resnet18 hardened)" | tee -a "$OUT/queue.log"
 timeout 7200 python scripts/convergence_artifact.py --out "$OUT" \
   > "$OUT/convergence.log" 2>&1
-echo "$(TS) convergence rc=$?" | tee -a "$OUT/queue.log"
+rc=$?; echo "$(TS) convergence rc=$rc" | tee -a "$OUT/queue.log"
 
 echo "$(TS) queue done" | tee -a "$OUT/queue.log"

@@ -18,23 +18,23 @@ echo "$(TS) queue-b start" | tee -a "$OUT/queue.log"
 
 echo "$(TS) [1/4] bench --all" | tee -a "$OUT/queue.log"
 timeout 7200 python bench.py --all > "$OUT/bench_all.jsonl" 2> "$OUT/bench_all.err"
-echo "$(TS) bench rc=$?" | tee -a "$OUT/queue.log"
+rc=$?; echo "$(TS) bench rc=$rc" | tee -a "$OUT/queue.log"
 
 echo "$(TS) [2/4] encode_profile" | tee -a "$OUT/queue.log"
 timeout 2400 python scripts/encode_profile.py --out "$OUT" \
   > "$OUT/encode_profile.log" 2>&1
-echo "$(TS) encode_profile rc=$?" | tee -a "$OUT/queue.log"
+rc=$?; echo "$(TS) encode_profile rc=$rc" | tee -a "$OUT/queue.log"
 
 echo "$(TS) [3/4] bf16_probe" | tee -a "$OUT/queue.log"
 timeout 2400 python scripts/bf16_probe.py > "$OUT/bf16_probe.log" 2>&1
-echo "$(TS) bf16_probe rc=$?" | tee -a "$OUT/queue.log"
+rc=$?; echo "$(TS) bf16_probe rc=$rc" | tee -a "$OUT/queue.log"
 
 echo "$(TS) [4/4] tests_tpu (per-file budgets)" | tee -a "$OUT/queue.log"
 for f in tests_tpu/test_codecs_tpu.py tests_tpu/test_attention_tpu.py \
          tests_tpu/test_qsgd_tpu.py; do
   timeout 1200 python -m pytest "$f" -q --tb=line -p no:cacheprovider \
     >> "$OUT/tests_tpu_b.log" 2>&1
-  echo "$(TS) $f rc=$?" | tee -a "$OUT/queue.log"
+  rc=$?; echo "$(TS) $f rc=$rc" | tee -a "$OUT/queue.log"
 done
 
 echo "$(TS) queue-b done" | tee -a "$OUT/queue.log"

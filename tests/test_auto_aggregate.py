@@ -227,3 +227,31 @@ def test_auto_spelling_trains_identically_to_explicit(tmp_path, capsys):
         return m.group(1)
 
     assert run("auto") == run("gather")
+
+
+def test_lm_gate_ablation_foil_resolution():
+    """The LM gate's foil must stay discriminating (ADVICE r4 + code-review
+    r5): no-probes converges toward the production codec as rank grows
+    (measured: w128 rank-12 no-probes ratio 1.141, under the 1.15 bound),
+    so 'auto' swaps to the floor-rank foil above the default rank, and the
+    degenerate rank<=3 floor-rank combination is rejected outright."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "lm_gate_script",
+        os.path.join(
+            os.path.dirname(__file__), "..", "scripts",
+            "lm_convergence_artifact.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    assert mod.resolve_ablation("auto", 6, 6) == "noprobes"
+    assert mod.resolve_ablation("auto", 12, 6) == "floor-rank"
+    assert mod.resolve_ablation("noprobes", 12, 6) == "noprobes"
+    with pytest.raises(ValueError, match="floor-rank"):
+        mod.resolve_ablation("floor-rank", 3, 6)
+    with pytest.raises(ValueError, match="floor-rank"):
+        mod.resolve_ablation("floor-rank", 2, 6)
