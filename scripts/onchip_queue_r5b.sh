@@ -16,20 +16,26 @@ TS() { date +%H:%M:%S; }
 
 echo "$(TS) queue-b start" | tee -a "$OUT/queue.log"
 
-echo "$(TS) [1/4] bench --all" | tee -a "$OUT/queue.log"
+echo "$(TS) [1/5] bench --all" | tee -a "$OUT/queue.log"
 timeout 7200 python bench.py --all > "$OUT/bench_all.jsonl" 2> "$OUT/bench_all.err"
 rc=$?; echo "$(TS) bench rc=$rc" | tee -a "$OUT/queue.log"
 
-echo "$(TS) [2/4] encode_profile" | tee -a "$OUT/queue.log"
+echo "$(TS) [2/5] encode_profile" | tee -a "$OUT/queue.log"
 timeout 2400 python scripts/encode_profile.py --out "$OUT" \
   > "$OUT/encode_profile.log" 2>&1
 rc=$?; echo "$(TS) encode_profile rc=$rc" | tee -a "$OUT/queue.log"
 
-echo "$(TS) [3/4] bf16_probe" | tee -a "$OUT/queue.log"
+echo "$(TS) [3/5] bf16_probe" | tee -a "$OUT/queue.log"
 timeout 2400 python scripts/bf16_probe.py > "$OUT/bf16_probe.log" 2>&1
 rc=$?; echo "$(TS) bf16_probe rc=$rc" | tee -a "$OUT/queue.log"
 
-echo "$(TS) [4/4] tests_tpu (per-file budgets)" | tee -a "$OUT/queue.log"
+echo "$(TS) [4/5] convergence artifact (resnet18 hardened; minutes on chip," \
+     "hopeless on the 1-core CPU host)" | tee -a "$OUT/queue.log"
+timeout 3600 python scripts/convergence_artifact.py --out "$OUT" \
+  > "$OUT/convergence.log" 2>&1
+rc=$?; echo "$(TS) convergence rc=$rc" | tee -a "$OUT/queue.log"
+
+echo "$(TS) [5/5] tests_tpu (per-file budgets)" | tee -a "$OUT/queue.log"
 for f in tests_tpu/test_codecs_tpu.py tests_tpu/test_attention_tpu.py \
          tests_tpu/test_qsgd_tpu.py; do
   timeout 1200 python -m pytest "$f" -q --tb=line -p no:cacheprovider \
