@@ -2,24 +2,42 @@
 # Relay watcher (round 5). The axon TPU tunnel comes and goes: it was
 # healthy 03:48-~04:05 this session, then wedged mid-testrun and took the
 # whole first on-chip window with it. This loop probes with a FRESH python
-# (a wedged backend never recovers in-process) every POLL_S seconds and, on
-# first health, fires scripts/onchip_queue_r5b.sh exactly once.
+# (a wedged backend never recovers in-process) every POLL_S seconds and
+# fires scripts/onchip_queue_r5b.sh on every healthy window until the
+# queue's per-step .done markers are all present — evidence accumulates
+# across however many short windows the relay grants.
 #
 # Usage: nohup bash scripts/relay_watch_r5.sh >/tmp/relay_watch.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
 POLL_S=${POLL_S:-180}
 LOG=/tmp/relay_r5.log
+OUT=artifacts/onchip_r5
+
+all_done () {
+  # the queue writes its own step manifest; before the first fire there is
+  # no manifest and nothing can be done
+  [ -f "$OUT/.steps" ] || return 1
+  while read -r s; do
+    [ -n "$s" ] && [ ! -e "$OUT/.done_$s" ] && return 1
+  done < "$OUT/.steps"
+  return 0
+}
+
 while true; do
+  if all_done; then
+    echo "$(date +%H:%M:%S) all queue steps done — watcher exiting" | tee -a "$LOG"
+    exit 0
+  fi
   if timeout 150 python -c "
 import jax, sys
 sys.exit(0 if jax.devices()[0].platform == 'tpu' else 1)
 " >/dev/null 2>&1; then
     echo "$(date +%H:%M:%S) relay UP — firing queue" | tee -a "$LOG"
     bash scripts/onchip_queue_r5b.sh
-    echo "$(date +%H:%M:%S) queue finished; watcher exiting" | tee -a "$LOG"
-    exit 0
+    echo "$(date +%H:%M:%S) queue pass finished" | tee -a "$LOG"
+  else
+    echo "$(date +%H:%M:%S) relay down" >> "$LOG"
   fi
-  echo "$(date +%H:%M:%S) relay down" >> "$LOG"
   sleep "$POLL_S"
 done
