@@ -2,33 +2,58 @@
 # Round-5 on-chip queue, second attempt — reordered after the first TPU
 # window (03:48-~04:05) was spent on tests_tpu and died mid-bench when the
 # relay wedged. Lessons applied:
-#   - bench FIRST: the round's make-or-break (VERDICT r4 #1) and its ladder
-#     already emits the config-2 headline before the long tail.
-#   - every step writes $OUT/.done_<step> on success and is SKIPPED when
-#     the marker exists, so re-firing the queue across several short relay
-#     windows resumes where the last window died instead of starting over.
-#   - tests_tpu LAST with per-file timeouts so one wedged dial cannot eat
-#     the window.
+#   - bench FIRST and PER-CONFIG: config 2 (the headline) runs before the
+#     long tail, and each config retires independently so short windows
+#     accumulate evidence instead of restarting a 6-config ladder.
+#   - every step writes $OUT/.done_<step> when its artifact carries real
+#     TPU evidence (exit codes alone lie: bench exits 0 on CPU-fallback
+#     rows, pytest exits 0 when everything auto-skips off-TPU) and is
+#     SKIPPED when the marker exists.
+#   - every step gives up after MAX_TRIES failed attempts (marker content
+#     "gaveup") so one deterministic failure cannot monopolize every
+#     window the relay grants.
+#   - tests_tpu LAST with per-file timeouts, verbose + line-buffered +
+#     append-mode logs so a killed window leaves attributable evidence.
 set -u
 cd "$(dirname "$0")/.."
 OUT=artifacts/onchip_r5
 mkdir -p "$OUT"
 TS() { date +%H:%M:%S; }
+MAX_TRIES=${MAX_TRIES:-3}
+PY=python
+
+BENCH_CONFIGS=(2 1 3 4 5 6)  # headline first
+TEST_FILES=(tests_tpu/test_codecs_tpu.py tests_tpu/test_attention_tpu.py
+            tests_tpu/test_qsgd_tpu.py)
+
+# manifest of expected .done markers, read by relay_watch_r5.sh so the two
+# scripts cannot drift on the step list
+{
+  for c in "${BENCH_CONFIGS[@]}"; do echo "bench_c$c"; done
+  printf '%s\n' encode_profile bf16_probe convergence
+  for f in "${TEST_FILES[@]}"; do echo "tests_$(basename "$f" .py)"; done
+} > "$OUT/.steps"
 
 run_step () {  # run_step <name> <timeout_s> <validator-cmd> <cmd...>
-  # rc==0 alone cannot mark success: bench exits 0 on CPU-fallback rows and
-  # pytest exits 0 when every test auto-skips off-TPU — the validator must
-  # confirm the artifact actually carries TPU evidence.
   local name=$1 budget=$2 check=$3; shift 3
   if [ -e "$OUT/.done_$name" ]; then
     echo "$(TS) $name already done — skip" | tee -a "$OUT/queue.log"
     return 0
   fi
-  echo "$(TS) $name start" | tee -a "$OUT/queue.log"
+  local tries
+  tries=$(cat "$OUT/.try_$name" 2>/dev/null || echo 0)
+  if [ "$tries" -ge "$MAX_TRIES" ]; then
+    echo "gaveup after $tries attempts" > "$OUT/.done_$name"
+    echo "$(TS) $name GAVE UP after $tries attempts" | tee -a "$OUT/queue.log"
+    return 1
+  fi
+  echo $((tries + 1)) > "$OUT/.try_$name"
+  echo "$(TS) $name start (attempt $((tries + 1))/$MAX_TRIES)" \
+    | tee -a "$OUT/queue.log"
   timeout "$budget" "$@"
   local rc=$?
   if [ "$rc" -eq 0 ] && bash -c "$check"; then
-    touch "$OUT/.done_$name"
+    echo "ok" > "$OUT/.done_$name"
     echo "$(TS) $name rc=0 VALID" | tee -a "$OUT/queue.log"
   else
     echo "$(TS) $name rc=$rc (not marked done)" | tee -a "$OUT/queue.log"
@@ -36,70 +61,65 @@ run_step () {  # run_step <name> <timeout_s> <validator-cmd> <cmd...>
   return "$rc"
 }
 
-echo "$(TS) queue-b start" | tee -a "$OUT/queue.log"
-
-TEST_FILES=(tests_tpu/test_codecs_tpu.py tests_tpu/test_attention_tpu.py
-            tests_tpu/test_qsgd_tpu.py)
-
-# manifest of expected .done markers, read by relay_watch_r5.sh so the two
-# scripts cannot drift on the step list
-{
-  printf '%s\n' bench encode_profile bf16_probe convergence
-  for f in "${TEST_FILES[@]}"; do echo "tests_$(basename "$f" .py)"; done
-} > "$OUT/.steps"
-
-PY=python
-# done only when a headline aggregate says the ladder COMPLETED and every
-# config row is a valid TPU measurement — one healthy config-2 row must not
-# retire the step while the rest of the ladder fell back to CPU
-V_BENCH="$PY - <<'EOF'
-import json, sys
-rows = [json.loads(l) for l in open('$OUT/bench_all.jsonl') if l.strip()]
-ok = any(
-    r.get('configs_complete')
-    and all(c.get('platform') == 'tpu' and c.get('measurement_valid')
-            for c in r.get('configs', []))
-    for r in rows)
-sys.exit(0 if ok else 1)
-EOF"
-V_EPROF="$PY -c \"import json; d=json.load(open('$OUT/ENCODE_PROFILE.json')); \
-  exit(0 if d.get('platform')=='tpu' else 1)\""
-V_BF16="$PY - <<'EOF'
+# validators parse line-by-line with per-line error-skip: appended logs can
+# hold a line truncated by a killed run, and that garbage must not block
+# validation of a later healthy pass
+v_jsonl_last_tpu () {  # <file>: newest parseable row is a valid TPU row
+  local f=$1
+  cat <<EOF
+$PY - <<'PYEOF'
 import json, sys
 last = None
-for l in open('$OUT/bf16_probe.log'):
-    l = l.strip()
-    if l.startswith('{'):
-        last = json.loads(l)
+try:
+    for l in open('$f'):
+        l = l.strip()
+        if l.startswith('{'):
+            try:
+                last = json.loads(l)
+            except Exception:
+                pass
+except OSError:
+    sys.exit(1)
 sys.exit(0 if last and last.get('platform') == 'tpu'
+         and last.get('measurement_valid', True)
          and not last.get('partial') else 1)
-EOF"
+PYEOF
+EOF
+}
+
+V_EPROF="$PY -c \"import json; d=json.load(open('$OUT/ENCODE_PROFILE.json')); \
+  exit(0 if d.get('platform')=='tpu' else 1)\""
 V_CONV="$PY -c \"import json; d=json.load(open('$OUT/CONVERGENCE.json')); \
   exit(0 if d.get('platform')=='tpu' else 1)\""
-# >> so a retried bench cannot destroy valid TPU rows a previous window
-# already earned; the validator scans every accumulated row
-run_step bench 7200 "$V_BENCH" bash -c \
-  "python bench.py --all >> '$OUT/bench_all.jsonl' 2>> '$OUT/bench_all.err'"
+
+echo "$(TS) queue-b start" | tee -a "$OUT/queue.log"
+
+# per-config bench: each config appends to its own jsonl (a retry cannot
+# destroy an earlier window's rows) and retires on its own TPU row
+for c in "${BENCH_CONFIGS[@]}"; do
+  run_step "bench_c$c" 2400 "$(v_jsonl_last_tpu "$OUT/bench_c$c.jsonl")" \
+    bash -c "python bench.py --config $c >> '$OUT/bench_c$c.jsonl' \
+             2>> '$OUT/bench_all.err'"
+done
 
 run_step encode_profile 2400 "$V_EPROF" bash -c \
-  "python scripts/encode_profile.py --out '$OUT' > '$OUT/encode_profile.log' 2>&1"
+  "python scripts/encode_profile.py --out '$OUT' >> '$OUT/encode_profile.log' 2>&1"
 
-run_step bf16_probe 2400 "$V_BF16" bash -c \
-  "python scripts/bf16_probe.py > '$OUT/bf16_probe.log' 2>&1"
+run_step bf16_probe 2400 "$(v_jsonl_last_tpu "$OUT/bf16_probe.log")" bash -c \
+  "python scripts/bf16_probe.py >> '$OUT/bf16_probe.log' 2>&1"
 
 # minutes on chip, hopeless on the 1-core CPU host (~460 GFLOP/step)
 run_step convergence 3600 "$V_CONV" bash -c \
-  "python scripts/convergence_artifact.py --out '$OUT' > '$OUT/convergence.log' 2>&1"
+  "python scripts/convergence_artifact.py --out '$OUT' >> '$OUT/convergence.log' 2>&1"
 
-# -v + line buffering: window 1 ran -q and its killed log was three
-# unattributable dots — a partial log must name what ran and what wedged
 for f in "${TEST_FILES[@]}"; do
   name="tests_$(basename "$f" .py)"
   log="$OUT/$name.log"
   v="tail -5 '$log' | grep -q ' passed' && ! tail -5 '$log' | grep -q skipped"
   run_step "$name" 1200 "$v" bash -c \
-    "stdbuf -oL -eL python -m pytest '$f' -v --tb=short -p no:cacheprovider \
-       > '$log' 2>&1"
+    "echo \"=== pass \$(date +%H:%M:%S) ===\" >> '$log'; \
+     stdbuf -oL -eL python -m pytest '$f' -v --tb=short -p no:cacheprovider \
+       >> '$log' 2>&1"
 done
 
 echo "$(TS) queue-b done" | tee -a "$OUT/queue.log"
