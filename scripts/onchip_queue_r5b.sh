@@ -118,16 +118,22 @@ echo "$(TS) queue-b start" | tee -a "$OUT/queue.log"
 # per-config bench: each config appends to its own jsonl (a retry cannot
 # destroy an earlier window's rows) and retires on its own TPU row
 for c in "${BENCH_CONFIGS[@]}"; do
+  # leading echo: a killed pass can leave a truncated line without a
+  # newline, and bench --config prints exactly ONE row — without the
+  # guard the next pass's row would concatenate onto the garbage and be
+  # lost (parsers skip blank lines)
   run_step "bench_c$c" 2400 "$(v_jsonl_any_tpu "$OUT/bench_c$c.jsonl")" \
-    bash -c "ATOMO_BENCH_RETRIES=1 python bench.py --config $c >> '$OUT/bench_c$c.jsonl' \
-             2>> '$OUT/bench_all.err'"
+    bash -c "echo >> '$OUT/bench_c$c.jsonl'; \
+             ATOMO_BENCH_RETRIES=1 python bench.py --config $c \
+             >> '$OUT/bench_c$c.jsonl' 2>> '$OUT/bench_all.err'"
 done
 
 run_step encode_profile 2400 "$V_EPROF" bash -c \
   "python scripts/encode_profile.py --out '$OUT' >> '$OUT/encode_profile.log' 2>&1"
 
 run_step bf16_probe 2400 "$(v_jsonl_any_tpu "$OUT/bf16_probe.log")" bash -c \
-  "python scripts/bf16_probe.py >> '$OUT/bf16_probe.log' 2>&1"
+  "echo >> '$OUT/bf16_probe.log'; \
+   python scripts/bf16_probe.py >> '$OUT/bf16_probe.log' 2>&1"
 
 # minutes on chip, hopeless on the 1-core CPU host (~460 GFLOP/step)
 run_step convergence 3600 "$V_CONV" bash -c \

@@ -105,3 +105,36 @@ def test_comm_model_attached_is_json_safe():
                            svd_step_s=6.5e-3)  # tax clamps to 0 -> inf case
     text = json.dumps(rep, allow_nan=False)  # raises on inf/nan
     assert "any_bandwidth" in text
+
+
+def test_assembler_newest_valid_tpu_row(tmp_path):
+    """The on-chip assembler (and the queue validator that mirrors it) must
+    pick the NEWEST valid TPU row, skip lines truncated by killed runs, and
+    ignore CPU-fallback appends that follow earned TPU evidence."""
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "assemble_onchip_r5",
+        _os.path.join(_os.path.dirname(__file__), "..", "scripts",
+                      "assemble_onchip_r5.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    f = tmp_path / "bench_c2.jsonl"
+    # the queue prepends a newline before each append precisely so a line
+    # truncated by a killed pass ends up alone on its line like this,
+    # instead of swallowing the next pass's single row by concatenation
+    f.write_text(
+        '{"platform": "tpu", "measurement_valid": true, "value": 9.0}\n'
+        '{"trunca\n'  # killed mid-write
+        '{"platform": "tpu", "measurement_valid": true, "value": 8.5}\n'
+        '{"platform": "cpu", "measurement_valid": false, "value": 999}\n'
+    )
+    row = mod.newest_valid_tpu_row(str(f))
+    assert row is not None and row["value"] == 8.5
+
+    g = tmp_path / "bench_c3.jsonl"
+    g.write_text('{"platform": "cpu", "measurement_valid": false}\n')
+    assert mod.newest_valid_tpu_row(str(g)) is None
