@@ -136,6 +136,23 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                    help="arm the step-heartbeat watchdog: interrupt the job "
                         "if no step completes within this many seconds "
                         "(0 = off); recovery = restart from last checkpoint")
+    t.add_argument("--grad-guard", action="store_true", default=False,
+                   help="anomaly-guarded stepping: screen each replica's "
+                        "raw gradient for non-finite values, drop anomalous "
+                        "contributions and re-scale the surviving average "
+                        "by n/kept (valid because the codecs are unbiased); "
+                        "a step with no survivors is skipped")
+    t.add_argument("--max-grad-norm", type=float, default=0.0, metavar="L2",
+                   help="with the guard: also drop contributions whose "
+                        "global L2 norm exceeds this (0 = finiteness only). "
+                        "A screen, not clipping — implies --grad-guard")
+    t.add_argument("--keep-ckpts", type=int, default=0, metavar="K",
+                   help="retain only the newest K model_step_N checkpoints "
+                        "(0 = keep all)")
+    t.add_argument("--chaos", type=str, default="", metavar="SPEC",
+                   help="fault-injection spec for drills, e.g. "
+                        "'nan@3,kill@6,truncate@4' (see utils/chaos.py); "
+                        "defaults to the ATOMO_CHAOS env var")
     t.add_argument("--phase-metrics", action="store_true", default=False,
                    help="split the step into separately-jitted phases and "
                         "log real Comp/Encode/Comm (+ master Gather/Decode) "
@@ -204,6 +221,12 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
     from atomo_tpu.data import BatchIterator, load_dataset, synthetic_dataset, SPECS, canonical_name
     from atomo_tpu.models import get_model
     from atomo_tpu.training import make_optimizer
+
+    from atomo_tpu.training.resilience import with_retries
+
+    # dataset IO (downloads / NFS reads) is the classic transient failure:
+    # bounded backoff instead of dying on the first blip
+    load_dataset = with_retries(load_dataset, exceptions=(OSError,))
 
     name = canonical_name(args.dataset)
     train_iter = None
@@ -369,6 +392,18 @@ def cmd_train(args: argparse.Namespace) -> int:
     max_steps = min(args.max_steps, args.epochs * steps_per_epoch)
     save_freq = args.save_freq or args.eval_freq
 
+    guard = None
+    if args.grad_guard or args.max_grad_norm > 0:
+        from atomo_tpu.training.resilience import GuardConfig
+
+        guard = GuardConfig(max_grad_norm=args.max_grad_norm)
+    chaos = None
+    if args.chaos:
+        from atomo_tpu.utils.chaos import ChaosConfig, ChaosInjector
+
+        chaos = ChaosInjector(ChaosConfig.from_spec(args.chaos))
+    # (no --chaos: the train loops read ATOMO_CHAOS from the env)
+
     n_dev = args.n_devices or len(jax.devices())
     if n_dev > 1:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
@@ -443,6 +478,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
             compress_ckpt=args.compress, log_every=args.log_interval,
             health_timeout=args.health_timeout,
+            guard=guard, chaos=chaos, keep_ckpts=args.keep_ckpts,
             phase_metrics=args.phase_metrics,
             lr_fn=stepwise_shrink(args.lr, args.lr_shrinkage, args.shrinkage_freq),
             profile_dir=args.profile_dir or None,
@@ -474,6 +510,8 @@ def cmd_train(args: argparse.Namespace) -> int:
             train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
             compress_ckpt=args.compress, log_every=args.log_interval,
             compute_dtype=jnp.bfloat16 if args.bf16 else None,
+            guard=guard, chaos=chaos, health_timeout=args.health_timeout,
+            keep_ckpts=args.keep_ckpts,
         )
     return 0
 

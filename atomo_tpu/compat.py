@@ -1,0 +1,54 @@
+"""JAX API-drift shims.
+
+The codebase targets the current jax surface (``jax.shard_map`` with a
+``check_vma`` kwarg; ``pltpu.InterpretParams`` for the TPU-semantics Pallas
+interpreter). Installed versions drift in both directions:
+
+  * jax 0.4.x has only ``jax.experimental.shard_map.shard_map`` whose
+    replication-check kwarg is spelled ``check_rep``; newer jax exposes
+    ``jax.shard_map`` with ``check_vma``.
+  * ``pltpu.InterpretParams`` (TPU-semantics interpret mode) does not exist
+    on older releases; plain ``interpret=True`` is the fallback there
+    (see ops/qsgd_kernels._interpret_mode for the caveat about its
+    prng stubs).
+
+``install()`` is idempotent and runs at ``import atomo_tpu`` time so every
+entry point (library, tests, subprocess workers) sees one consistent API.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    """Install ``jax.shard_map`` when the running jax lacks it."""
+    if hasattr(jax, "shard_map"):
+        return
+    import inspect
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    params = inspect.signature(_shard_map).parameters
+    rep_kw = "check_vma" if "check_vma" in params else "check_rep"
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None and rep_kw not in kw:
+            kw[rep_kw] = check_vma
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+    jax.shard_map = shard_map
+
+
+def pallas_tpu_interpret_mode(interpret: bool):
+    """Value for ``pl.pallas_call(interpret=...)``: the TPU-semantics
+    interpreter where the installed jax has it, plain interpret mode
+    otherwise (False when not interpreting at all)."""
+    if not interpret:
+        return False
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "InterpretParams", None)
+    return cls() if cls is not None else True

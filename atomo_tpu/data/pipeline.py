@@ -78,16 +78,30 @@ class BatchIterator:
         n = len(self.dataset)
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
-    def epoch(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    def _epoch_sels(self) -> Iterator[np.ndarray]:
+        """One epoch's batch index selections (the shuffle happens here)."""
         n = len(self.dataset)
         idx = np.arange(n)
         if self.shuffle:
             self._rng.shuffle(idx)
         stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
         for s in range(0, stop, self.batch_size):
-            sel = idx[s : s + self.batch_size]
+            yield idx[s : s + self.batch_size]
+
+    def epoch(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        for sel in self._epoch_sels():
             yield self.images[sel], self.labels[sel]
 
-    def forever(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+    def forever(self, skip: int = 0) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Endless epoch stream. ``skip`` discards that many leading
+        batches WITHOUT materializing them (index-stream only) while
+        consuming the exact same shuffle-RNG draws — the resume-replay
+        path: a restarted run's batch sequence lines up with the
+        uninterrupted run's at a cost of one index shuffle per skipped
+        epoch, not a data copy per skipped batch."""
         while True:
-            yield from self.epoch()
+            for sel in self._epoch_sels():
+                if skip > 0:
+                    skip -= 1
+                    continue
+                yield self.images[sel], self.labels[sel]

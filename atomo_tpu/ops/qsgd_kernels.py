@@ -59,8 +59,13 @@ def is_tpu() -> bool:
 
 def _interpret_mode(interpret: bool):
     """True → the TPU-semantics interpreter (generic interpret mode has no
-    CPU lowering for pltpu.prng_* primitives)."""
-    return pltpu.InterpretParams() if interpret else False
+    CPU lowering for pltpu.prng_* primitives). On jax versions without
+    ``pltpu.InterpretParams`` this falls back to plain ``interpret=True`` —
+    fine for the external-uniform kernels the tests use; the on-core-PRNG
+    path needs real hardware there."""
+    from atomo_tpu.compat import pallas_tpu_interpret_mode
+
+    return pallas_tpu_interpret_mode(interpret)
 
 
 def _finish_quantize(x, u, words_ref, scales_ref, *, bits, levels, vpw, scheme):

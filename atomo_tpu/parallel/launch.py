@@ -31,6 +31,8 @@ def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    attempts: int = 3,
+    backoff: float = 1.0,
 ) -> None:
     """Initialize the multi-host runtime.
 
@@ -38,6 +40,12 @@ def initialize(
     Multi-process: wires jax.distributed so jax.devices() spans all hosts.
     Arguments default from the standard env (JAX_COORDINATOR_ADDRESS etc.)
     or the TPU metadata the runtime provides.
+
+    The coordinator handshake is the classic restart race: after a failure
+    the workers come back before the coordinator is listening. ``attempts``
+    > 1 retries the initialize with exponential backoff (``backoff`` base
+    seconds) on connection-flavored failures instead of dying into the
+    scheduler's next restart round.
     """
     coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if num_processes is None:
@@ -48,7 +56,49 @@ def initialize(
         process_id = int(env) if env else None
     if coordinator_address is None and num_processes in (None, 1):
         return  # single host
-    jax.distributed.initialize(
+    try:
+        from jax._src.distributed import global_state as _gs
+
+        if getattr(_gs, "client", None) is not None:
+            return  # already initialized: idempotent no-op — the retry
+            # below must never shut down a HEALTHY coordinator connection
+    except ImportError:
+        pass  # private path moved: jax's own "called once" guard applies
+    from atomo_tpu.training.resilience import with_retries
+
+    def _attempt(**kw):
+        try:
+            jax.distributed.initialize(**kw)
+        except (RuntimeError, ConnectionError, OSError):
+            # jax sets global_state.client BEFORE client.connect(), so a
+            # failed connect leaves half-initialized state and every
+            # further initialize() dies on the "should only be called
+            # once" guard. Reset it so the retry can actually connect.
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            try:
+                from jax._src.distributed import global_state as _gs
+
+                _gs.client = None
+                _gs.service = None
+                _gs.preemption_sync_manager = None
+            except Exception:
+                pass  # private path moved: shutdown() above is the fallback
+            raise
+
+    with_retries(
+        _attempt,
+        attempts=max(attempts, 1),
+        base_delay=backoff,
+        exceptions=(RuntimeError, ConnectionError, OSError),
+        on_retry=lambda i, exc: print(
+            f"jax.distributed.initialize failed (attempt {i}): {exc}; "
+            "retrying",
+            flush=True,
+        ),
+    )(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,

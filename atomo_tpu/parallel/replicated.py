@@ -50,6 +50,12 @@ from atomo_tpu.codecs import (
 )
 from atomo_tpu.data.pipeline import augment_batch
 from atomo_tpu.parallel.mesh import batch_sharded, replicated
+from atomo_tpu.training.resilience import (
+    grad_ok,
+    masked_mean,
+    rescale_by_survivors,
+    select_state,
+)
 from atomo_tpu.training.trainer import (
     TrainState,
     cast_compute_inputs,
@@ -66,6 +72,29 @@ def _zero1_chunk(flat_size: int, n_dev: int) -> int:
     agree exactly or every momentum slice silently misaligns with its
     parameter slice."""
     return -(-flat_size // n_dev)
+
+
+def _mask_gathered(gathered, okg):
+    """Zero the gathered payloads of unhealthy replicas. ``okg`` is the
+    (n,) float flag vector; leaves have the replica axis leading. where()
+    rather than multiply: a NaN payload times zero is still NaN, and the
+    whole point is keeping the anomalous replica's NaNs out of the mean.
+    Zeroed payloads decode to zero for every codec (SVD: zero factors;
+    QSGD/TernGrad: zero scales/words), so the masked decode-mean over n is
+    sum(surviving)/n — rescaled by n/kept at the call site."""
+    def m(p):
+        shape = (okg.shape[0],) + (1,) * (p.ndim - 1)
+        return jnp.where(okg.reshape(shape) > 0, p, jnp.zeros((), p.dtype))
+
+    return jax.tree_util.tree_map(m, gathered)
+
+
+def _healthy_mean(x, ok, kept_chips, metric_axes):
+    """Mean of a per-chip scalar over healthy chips only (guard mode): the
+    anomalous replica's loss/precision may be NaN and a plain pmean would
+    poison the logged series even though the params were protected."""
+    safe = jnp.where(ok, x, jnp.zeros((), x.dtype))
+    return jax.lax.psum(safe, metric_axes) / jnp.maximum(kept_chips, 1.0)
 
 
 def _loss_fn(model, params, batch_stats, images, labels, dropout_key,
@@ -106,8 +135,27 @@ def make_distributed_train_step(
     zero1_specs=None,
     grad_accum: int = 1,
     inner_axis: Optional[str] = None,
+    guard=None,
+    chaos=None,
 ):
     """Build the jitted SPMD train step over ``mesh``.
+
+    ``guard`` (training.resilience.GuardConfig) arms per-replica anomaly
+    screening with the skip-and-rescale policy: each replica screens its
+    RAW gradient (finiteness + optional norm ceiling) before encoding; an
+    anomalous contribution is masked out of the aggregation and the
+    surviving average is re-scaled by n/kept — valid precisely because
+    ATOMO's estimator is unbiased (resilience.py rationale). A step with
+    zero survivors is skipped outright (params/opt state/BN stats held).
+    metrics gain "skipped" (1.0 when the whole step was dropped) and
+    "dropped" (contributions masked this step). In hierarchical mode the
+    screen runs on the inner-pmean-ed gradient, so the unit of drop is an
+    inner (ICI) group — one bad chip poisons its group's dense pmean, and
+    that whole group's payload is masked from the slow-fabric gather.
+
+    ``chaos`` (utils.chaos.ChaosInjector) bakes deterministic gradient
+    faults into the compiled step, confined to ``chaos.target_replica``
+    (-1 = all replicas). Test/validation hook; zero cost when None.
 
     Returns step(state, key, images, labels) -> (state, metrics); call with
     ``images``/``labels`` sharded over ``axis`` and ``state`` replicated.
@@ -261,28 +309,64 @@ def make_distributed_train_step(
             loss = loss_sum / grad_accum
             prec1, prec5 = p1_sum / grad_accum, p5_sum / grad_accum
 
+        if chaos is not None:
+            grads = chaos.inject_grads(grads, state.step + 1, replica=my)
+
+        ok = kept = None  # guard-mode: local health flag / surviving count
+        n_contrib = k_agg or n_dev  # contributions in the average
         dense_bytes = tree_nbytes(grads)
         if codec is None:
-            mean_grads = jax.lax.pmean(grads, axis)
+            if guard is not None:
+                ok = grad_ok(grads, guard.max_grad_norm)
+                kept = jax.lax.psum(ok.astype(jnp.float32), axis)
+                mean_grads = masked_mean(grads, ok, kept, axis)
+            else:
+                mean_grads = jax.lax.pmean(grads, axis)
             msg_bytes = dense_bytes
         elif hierarchical:
             # fast fabric first: dense pmean over the inner (ICI) axis —
             # the regime where the codec tax cannot pay for itself
             grads = jax.lax.pmean(grads, inner_axis)
+            if guard is not None:
+                # group-level screen: the inner pmean already mixed any bad
+                # chip into its group, so health is a property of the
+                # group's reduced gradient (identical across its chips)
+                ok = grad_ok(grads, guard.max_grad_norm)
             # slow fabric: only factors cross. Same key within an inner
             # group (see above) -> payloads identical per group; gather
             # over the OUTER axis moves n_outer payloads, not n_chips.
             payloads, stats = encode_tree(codec, k_codec, grads)
             msg_bytes = stats.payload_bytes  # bytes on the SLOW fabric
             gathered = jax.lax.all_gather(payloads, axis)
-            mean_grads = decode_mean_tree(codec, gathered, grads, n_dev)
+            if guard is not None:
+                okg = jax.lax.all_gather(ok.astype(jnp.float32), axis)
+                kept = jnp.sum(okg)
+                mean_grads = rescale_by_survivors(
+                    decode_mean_tree(
+                        codec, _mask_gathered(gathered, okg), grads, n_dev
+                    ),
+                    n_dev,
+                    kept,
+                )
+            else:
+                mean_grads = decode_mean_tree(codec, gathered, grads, n_dev)
         else:
+            if guard is not None:
+                # screen the RAW gradient before it is encoded: codecs
+                # propagate NaN/Inf into payloads, so post-encode checks
+                # could not tell an anomalous gradient from codec overflow
+                ok = grad_ok(grads, guard.max_grad_norm)
             payloads, stats = encode_tree(codec, k_codec, grads)
             msg_bytes = stats.payload_bytes
             if aggregate == "gather":
                 # factors on the wire: all_gather fixed-shape payloads,
                 # decode all replicas identically, mean.
                 gathered = jax.lax.all_gather(payloads, axis)  # leading axis n_dev
+                okg = (
+                    jax.lax.all_gather(ok.astype(jnp.float32), axis)
+                    if guard is not None
+                    else None
+                )
                 if k_agg:
                     # deterministic rotating subset — identical on every
                     # chip, so replicas stay bit-equal
@@ -290,16 +374,33 @@ def make_distributed_train_step(
                     gathered = jax.tree.map(
                         lambda a: jnp.take(a, sel, axis=0), gathered
                     )
+                    if okg is not None:
+                        okg = jnp.take(okg, sel, axis=0)
                 # fused decode_mean where the codec provides it (SVD: the N
                 # rank-k factor blocks concatenate into ONE (m, N·k)@(N·k, n)
                 # matmul — MXU-sized, no N dense intermediates); vmap-decode
                 # + mean otherwise.
-                mean_grads = decode_mean_tree(
-                    codec, gathered, grads, k_agg or n_dev
-                )
+                if guard is not None:
+                    kept = jnp.sum(okg)
+                    mean_grads = rescale_by_survivors(
+                        decode_mean_tree(
+                            codec, _mask_gathered(gathered, okg), grads,
+                            n_contrib,
+                        ),
+                        n_contrib,
+                        kept,
+                    )
+                else:
+                    mean_grads = decode_mean_tree(
+                        codec, gathered, grads, n_contrib
+                    )
             elif aggregate == "psum":
                 decoded = decode_tree(codec, payloads, grads)
-                mean_grads = jax.lax.pmean(decoded, axis)
+                if guard is not None:
+                    kept = jax.lax.psum(ok.astype(jnp.float32), axis)
+                    mean_grads = masked_mean(decoded, ok, kept, axis)
+                else:
+                    mean_grads = jax.lax.pmean(decoded, axis)
                 # wire honesty: the pmean moves DENSE gradients; payload
                 # size is a codec property, not this mode's message size
                 msg_bytes = dense_bytes
@@ -334,19 +435,42 @@ def make_distributed_train_step(
             new_sl = optax.apply_updates(p_sl, updates)
             new_flat = jax.lax.all_gather(new_sl, batch_axes, tiled=True)
             new_params = unravel(new_flat[: flat_p.size])
-        # keep BN stats consistent across replicas (deviation note above);
-        # hierarchical mode averages over BOTH data axes
-        new_stats = jax.lax.pmean(new_stats, metric_axes)
-
-        metrics = {
-            "loss": jax.lax.pmean(loss, metric_axes),
-            "prec1": jax.lax.pmean(prec1, metric_axes),
-            "prec5": jax.lax.pmean(prec5, metric_axes),
-            # float32: static trace-time ints; int32 would overflow at jit
-            # time for >=2 GiB per-shard gradients
-            "msg_bytes": jnp.asarray(msg_bytes, jnp.float32),
-            "dense_bytes": jnp.asarray(dense_bytes, jnp.float32),
-        }
+        if guard is None:
+            # keep BN stats consistent across replicas (deviation note
+            # above); hierarchical mode averages over BOTH data axes
+            new_stats = jax.lax.pmean(new_stats, metric_axes)
+            metrics = {
+                "loss": jax.lax.pmean(loss, metric_axes),
+                "prec1": jax.lax.pmean(prec1, metric_axes),
+                "prec5": jax.lax.pmean(prec5, metric_axes),
+                # float32: static trace-time ints; int32 would overflow at
+                # jit time for >=2 GiB per-shard gradients
+                "msg_bytes": jnp.asarray(msg_bytes, jnp.float32),
+                "dense_bytes": jnp.asarray(dense_bytes, jnp.float32),
+                "skipped": jnp.float32(0.0),
+                "dropped": jnp.float32(0.0),
+            }
+        else:
+            ok_step = kept > 0  # any survivor -> the rescaled mean applies
+            # healthy-only means: a chip whose forward NaN-ed must not
+            # poison the BN stats or the logged metric series either
+            kept_chips = jax.lax.psum(ok.astype(jnp.float32), metric_axes)
+            new_stats = jax.tree_util.tree_map(
+                lambda s: _healthy_mean(s, ok, kept_chips, metric_axes),
+                new_stats,
+            )
+            new_params = select_state(ok_step, new_params, state.params)
+            new_opt = select_state(ok_step, new_opt, state.opt_state)
+            new_stats = select_state(ok_step, new_stats, state.batch_stats)
+            metrics = {
+                "loss": _healthy_mean(loss, ok, kept_chips, metric_axes),
+                "prec1": _healthy_mean(prec1, ok, kept_chips, metric_axes),
+                "prec5": _healthy_mean(prec5, ok, kept_chips, metric_axes),
+                "msg_bytes": jnp.asarray(msg_bytes, jnp.float32),
+                "dense_bytes": jnp.asarray(dense_bytes, jnp.float32),
+                "skipped": 1.0 - ok_step.astype(jnp.float32),
+                "dropped": n_contrib - kept,
+            }
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,
@@ -539,6 +663,10 @@ def distributed_train_loop(
     zero1: bool = False,
     grad_accum: int = 1,
     inner_axis: Optional[str] = None,
+    guard=None,
+    chaos=None,
+    on_health_failure=None,
+    keep_ckpts: int = 0,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -562,11 +690,12 @@ def distributed_train_loop(
     XProf loadable) around ``profile_steps`` steady-state steps — the
     honest way to see encode/decode cost INSIDE the fused program, where
     host-side spans cannot reach (utils/tracing rationale)."""
-    from atomo_tpu.parallel.launch import HealthMonitor, HealthWatchdog
-    from atomo_tpu.training.checkpoint import latest_step, load_checkpoint, save_checkpoint
+    from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
+    from atomo_tpu.training.resilience import heartbeat_watchdog, resolve_chaos
     from atomo_tpu.training.trainer import create_state
     from atomo_tpu.utils.metrics import StepMetrics, Timer
 
+    chaos = resolve_chaos(chaos)
     sample_images, _ = next(iter(train_iter.epoch()))
     state = create_state(
         model, optimizer, jax.random.PRNGKey(seed), jnp.asarray(sample_images)
@@ -587,7 +716,14 @@ def distributed_train_loop(
             # silently returns whatever tree the checkpoint held), so the
             # zero1-vs-replicated decision needs an explicit structure AND
             # shape check against the template — not a try/except
-            restored = load_checkpoint(train_dir, template)
+            try:
+                restored = load_checkpoint(train_dir, template)
+            except FileNotFoundError as exc:
+                # every candidate failed integrity checks: start fresh
+                log_fn(f"Resume requested but {exc}; starting fresh")
+                restored = None
+            want_resume = restored is not None
+        if want_resume:
 
             def _layout_matches(a, b) -> bool:
                 ta = jax.tree_util.tree_structure(a)
@@ -638,13 +774,24 @@ def distributed_train_loop(
         state = z_state
     else:
         if want_resume:
-            state = load_checkpoint(train_dir, state)
-            start_step = int(state.step)
-            log_fn(f"Resumed from {train_dir} at step {start_step}")
+            try:
+                state = load_checkpoint(train_dir, state)
+                start_step = int(state.step)
+                log_fn(f"Resumed from {train_dir} at step {start_step}")
+            except FileNotFoundError as exc:
+                # every candidate failed integrity checks: start fresh
+                # rather than dying inside an elastic-restart loop
+                log_fn(f"Resume requested but {exc}; starting fresh")
         state = replicate_state(mesh, state)
     if phase_metrics:
         import warnings
 
+        if guard is not None or chaos is not None:
+            raise ValueError(
+                "--phase-metrics is an observability mode without the "
+                "anomaly-guard/chaos hooks; drop --phase-metrics to use "
+                "--grad-guard / --chaos"
+            )
         if zero1:
             raise ValueError(
                 "--zero1 is not supported with --phase-metrics (the phased "
@@ -674,7 +821,7 @@ def distributed_train_loop(
             model, optimizer, mesh, codec, aggregate=aggregate, augment=augment,
             num_aggregate=num_aggregate, compute_dtype=compute_dtype,
             zero1_specs=zero1_specs, grad_accum=grad_accum,
-            inner_axis=inner_axis,
+            inner_axis=inner_axis, guard=guard, chaos=chaos,
         )
     batch_axes = ("dp", inner_axis) if aggregate == "hierarchical" else "dp"
     eval_fn = (
@@ -684,25 +831,19 @@ def distributed_train_loop(
     )
     key = jax.random.PRNGKey(seed + 1)
     timer = Timer()
-    stream = train_iter.forever()
+    # replay: skip the batches the interrupted run consumed so the resumed
+    # data order matches the uninterrupted run's (index-only — one shuffle
+    # per skipped epoch, no data copies, nothing for the watchdog to see)
+    stream = train_iter.forever(skip=start_step)
     n_train = len(train_iter.dataset)
-    watchdog = None
-    monitor = None
-    if health_timeout > 0:
-        monitor = HealthMonitor(timeout=health_timeout)
-        watchdog = HealthWatchdog(
-            monitor, interval=min(health_timeout / 4, 10.0)
-        ).start()
-    try:
+    with heartbeat_watchdog(health_timeout, on_health_failure) as monitor:
         state = _distributed_steps(
             state, step_fn, eval_fn, stream, train_iter, test_iter, mesh,
             key, timer, n_train, start_step, max_steps, log_every, log_fn,
             eval_freq, save_freq, train_dir, compress_ckpt, monitor, lr_fn,
             profile_dir, profile_steps, batch_axes,
+            guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
         )
-    finally:
-        if watchdog is not None:
-            watchdog.stop()
     return state
 
 
@@ -770,15 +911,21 @@ def _distributed_steps(
     timer, n_train, start_step, max_steps, log_every, log_fn, eval_freq,
     save_freq, train_dir, compress_ckpt, monitor, lr_fn=None,
     profile_dir=None, profile_steps=3, batch_axes="dp",
+    guard=None, chaos=None, keep_ckpts=0,
 ):
-    from atomo_tpu.training.checkpoint import save_checkpoint
+    from atomo_tpu.training.resilience import retrying_saver
     from atomo_tpu.utils.metrics import StepMetrics, master_line
     from atomo_tpu.utils.tracing import profile
 
+    save_fn = retrying_saver(log_fn)
+    last_saved = start_step
     # trace steady-state steps only: step 1 is dominated by compilation
     prof_first = start_step + 2 if profile_dir else None
     prof_ctx = None
     for step in range(start_step + 1, max_steps + 1):
+        if chaos is not None:
+            chaos.maybe_die(step)
+            chaos.maybe_sleep(step)
         if prof_first is not None and step == prof_first:
             prof_ctx = profile(profile_dir)
             prof_ctx.__enter__()
@@ -795,6 +942,22 @@ def _distributed_steps(
         if monitor is not None:
             jax.block_until_ready(metrics["loss"])
             monitor.beat(step)
+        # guard diagnostics share the log cadence: a per-step device->host
+        # fetch would serialize async dispatch even on all-healthy steps
+        if (
+            guard is not None
+            and log_every and step % log_every == 0
+            and float(metrics.get("dropped", 0.0)) > 0
+        ):
+            n_drop = int(float(metrics["dropped"]))
+            action = (
+                "skip" if float(metrics.get("skipped", 0.0)) > 0
+                else "rescale"
+            )
+            log_fn(
+                f"Guard: Step: {step}, Dropped: {n_drop}, Action: {action} "
+                "(anomalous contribution masked from the aggregate)"
+            )
         if log_every and step % log_every == 0:
             rec = StepMetrics(
                 rank=0,
@@ -861,7 +1024,23 @@ def _distributed_steps(
                     "--test-batch-size that is a mesh multiple for exact totals"
                 )
         if save_freq and train_dir and step % save_freq == 0:
-            save_checkpoint(train_dir, jax.device_get(state), step, compress=compress_ckpt)
+            path = save_fn(
+                train_dir, jax.device_get(state), step,
+                compress=compress_ckpt, keep=keep_ckpts,
+            )
+            last_saved = step
+            if chaos is not None:
+                chaos.maybe_corrupt_checkpoint(path, step)
+    # autosave the final state so a restart never replays the tail
+    # (strictly `<`: a resume past max_steps runs no steps and must not
+    # write a file whose name disagrees with the state's step field)
+    if save_freq and train_dir and last_saved < max_steps:
+        path = save_fn(
+            train_dir, jax.device_get(state), max_steps,
+            compress=compress_ckpt, keep=keep_ckpts,
+        )
+        if chaos is not None:  # ckpt faults target autosaves too
+            chaos.maybe_corrupt_checkpoint(path, max_steps)
     if prof_ctx is not None:  # run shorter than the profiled window
         prof_ctx.__exit__(None, None, None)
     return state

@@ -2,14 +2,22 @@
 optimizers, checkpointing."""
 
 from atomo_tpu.training.checkpoint import (  # noqa: F401
+    CorruptCheckpointError,
     latest_step,
+    latest_valid_step,
     list_steps,
     load_checkpoint,
     load_params,
     load_sharded_checkpoint,
     save_checkpoint,
+    verify_checkpoint,
 )
 from atomo_tpu.training.optim import make_optimizer, stepwise_shrink  # noqa: F401
+from atomo_tpu.training.resilience import (  # noqa: F401
+    GuardConfig,
+    grad_ok,
+    with_retries,
+)
 from atomo_tpu.training.trainer import (  # noqa: F401
     TrainState,
     create_state,

@@ -14,20 +14,42 @@ optional lossless byte compression through the C++ native codec
 it is meaningful on TPU: the host-side artifact path, not the ICI wire.
 File naming keeps the reference's ``model_step_N`` contract so external
 polling tooling ports over unchanged.
+
+Self-healing (fault-tolerance tentpole): the current header is
+``magic(4) | crc32(payload, 4 bytes LE) | payload`` so every read verifies
+integrity end-to-end; a truncated, bit-flipped, or foreign file raises
+:class:`CorruptCheckpointError`. Loading with ``step=None`` walks the
+``model_step_N`` files newest-first and returns the newest *valid* one
+(warning about each corpse it skips) — a job restarted after a crash that
+tore its final write resumes from the last good state instead of dying on
+the bad file. ``save_checkpoint(..., keep=K)`` prunes all but the newest K
+steps after a successful atomic rename. Legacy headers (pre-CRC ``ATMO``/
+``ATMZ``) still load; they simply have no CRC to check.
 """
 
 from __future__ import annotations
 
 import os
 import re
+import subprocess
+import warnings
+import zlib
 from typing import Optional
 
 import jax
 from flax import serialization
 
 _STEP_RE = re.compile(r"^model_step_(\d+)$")
-_MAGIC_RAW = b"ATMO"  # uncompressed msgpack
-_MAGIC_LZ = b"ATMZ"  # native-codec-compressed msgpack
+_MAGIC_RAW_V1 = b"ATMO"  # legacy: uncompressed msgpack, no CRC
+_MAGIC_LZ_V1 = b"ATMZ"  # legacy: native-codec-compressed msgpack, no CRC
+_MAGIC_RAW = b"ATR2"  # uncompressed msgpack + crc32
+_MAGIC_LZ = b"ATZ2"  # native-codec-compressed msgpack + crc32
+_HEADER_LEN = 8  # magic + crc32 (legacy headers are 4; handled on read)
+
+
+class CorruptCheckpointError(ValueError):
+    """A model_step_N file exists but cannot be trusted: truncated, failed
+    its CRC, bad magic, or undecodable payload."""
 
 
 def checkpoint_path(train_dir: str, step: int) -> str:
@@ -52,8 +74,21 @@ def latest_step(train_dir: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
-def save_checkpoint(train_dir: str, state, step: Optional[int] = None, compress: bool = True) -> str:
-    """Serialize a TrainState to train_dir/model_step_N (atomic rename)."""
+_warned_compress_fallback = False
+
+
+def save_checkpoint(
+    train_dir: str,
+    state,
+    step: Optional[int] = None,
+    compress: bool = True,
+    keep: int = 0,
+) -> str:
+    """Serialize a TrainState to train_dir/model_step_N (atomic rename,
+    CRC32 header). ``keep`` > 0 prunes all but the newest ``keep`` steps
+    after the new file is durably in place (retention never runs on a
+    failed write — the rename is the commit point)."""
+    global _warned_compress_fallback
     os.makedirs(train_dir, exist_ok=True)
     if step is None:
         step = int(state.step)
@@ -65,38 +100,166 @@ def save_checkpoint(train_dir: str, state, step: Optional[int] = None, compress:
 
             payload = lossless.compress(payload)
             magic = _MAGIC_LZ
-        except Exception:
-            pass  # native lib unavailable: fall back to raw msgpack
+        except (
+            ImportError,
+            OSError,
+            RuntimeError,
+            subprocess.CalledProcessError,
+        ) as exc:
+            # native lib unavailable (no module / no g++ / failed compile /
+            # load failure) or its compressor refused the buffer
+            # (lossless.compress raises RuntimeError): fall back to raw
+            # msgpack — but say so, once; a silent pass here hid real build
+            # breakage behind bigger checkpoints
+            if not _warned_compress_fallback:
+                _warned_compress_fallback = True
+                warnings.warn(
+                    "checkpoint compression unavailable "
+                    f"({type(exc).__name__}: {exc}); writing raw msgpack"
+                )
     path = checkpoint_path(train_dir, step)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(magic + payload)
+        f.write(magic + zlib.crc32(payload).to_bytes(4, "little") + payload)
     os.replace(tmp, path)
+    if keep > 0:
+        # retention = the file just written + the newest keep-1 VALID
+        # others. Two traps this avoids: (a) pruning by raw step order
+        # would delete the file just written whenever a stale
+        # higher-numbered corpse exists (post-corruption-fallback
+        # timelines are numbered below the corpse); (b) letting a
+        # known-corrupt file consume a retention slot silently halves the
+        # promised redundancy and preserves the corpse forever. The CRC
+        # probe costs one file read per retained candidate — proportional
+        # to the write this save just did.
+        retained = 0
+        for s in sorted(
+            (s for s in list_steps(train_dir) if s != step), reverse=True
+        ):
+            if retained < keep - 1 and _crc_ok(checkpoint_path(train_dir, s)):
+                retained += 1
+                continue
+            try:
+                os.remove(checkpoint_path(train_dir, s))
+            except OSError:
+                pass  # already gone / perms: retention is best-effort
     return path
 
 
-def _read_state_dict(train_dir: str, step: Optional[int]):
-    if step is None:
-        step = latest_step(train_dir)
-        if step is None:
-            raise FileNotFoundError(f"no model_step_N checkpoints in {train_dir!r}")
-    path = checkpoint_path(train_dir, step)
+def _crc_ok(path: str) -> bool:
+    """Cheap integrity probe for retention: header + CRC only (no
+    decompress / msgpack parse). Legacy headers have no CRC and pass."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return False
+    magic = blob[:4]
+    if magic in (_MAGIC_RAW, _MAGIC_LZ):
+        return len(blob) >= _HEADER_LEN and zlib.crc32(
+            blob[_HEADER_LEN:]
+        ) == int.from_bytes(blob[4:_HEADER_LEN], "little")
+    return magic in (_MAGIC_RAW_V1, _MAGIC_LZ_V1)
+
+
+def _read_blob(path: str) -> bytes:
+    """Read + verify one checkpoint file down to its msgpack bytes.
+
+    Raises CorruptCheckpointError for anything untrustworthy; FileNotFound
+    passes through (a missing file is a different condition from a torn
+    one)."""
     with open(path, "rb") as f:
         blob = f.read()
-    magic, payload = blob[:4], blob[4:]
-    if magic == _MAGIC_LZ:
+    magic = blob[:4]
+    if magic in (_MAGIC_RAW, _MAGIC_LZ):
+        if len(blob) < _HEADER_LEN:
+            raise CorruptCheckpointError(f"{path!r}: truncated header")
+        want_crc = int.from_bytes(blob[4:_HEADER_LEN], "little")
+        payload = blob[_HEADER_LEN:]
+        got_crc = zlib.crc32(payload)
+        if got_crc != want_crc:
+            raise CorruptCheckpointError(
+                f"{path!r}: CRC mismatch (header {want_crc:#010x}, "
+                f"payload {got_crc:#010x}) — truncated or corrupted file"
+            )
+        compressed = magic == _MAGIC_LZ
+    elif magic in (_MAGIC_RAW_V1, _MAGIC_LZ_V1):
+        payload = blob[4:]  # legacy header: no CRC to verify
+        compressed = magic == _MAGIC_LZ_V1
+    else:
+        raise CorruptCheckpointError(
+            f"{path!r}: not an atomo_tpu checkpoint (magic {magic!r})"
+        )
+    if compressed:
         from atomo_tpu.native import lossless
 
-        payload = lossless.decompress(payload)
-    elif magic != _MAGIC_RAW:
-        raise ValueError(f"{path!r}: not an atomo_tpu checkpoint (magic {magic!r})")
-    return serialization.msgpack_restore(payload)
+        try:
+            payload = lossless.decompress(payload)
+        except ValueError as exc:
+            raise CorruptCheckpointError(f"{path!r}: {exc}") from exc
+    return payload
+
+
+def _restore_state_dict(path: str):
+    payload = _read_blob(path)
+    try:
+        return serialization.msgpack_restore(payload)
+    except Exception as exc:  # msgpack raises library-specific errors
+        raise CorruptCheckpointError(
+            f"{path!r}: undecodable msgpack payload ({exc})"
+        ) from exc
+
+
+def verify_checkpoint(train_dir: str, step: int) -> bool:
+    """True iff model_step_N exists and passes header/CRC/msgpack checks."""
+    try:
+        _restore_state_dict(checkpoint_path(train_dir, step))
+        return True
+    except (CorruptCheckpointError, OSError):
+        return False
+
+
+def _read_state_dict(train_dir: str, step: Optional[int]):
+    if step is not None:
+        # explicit step: corruption is an error the caller asked to see
+        return _restore_state_dict(checkpoint_path(train_dir, step))
+    steps = list_steps(train_dir)
+    if not steps:
+        raise FileNotFoundError(f"no model_step_N checkpoints in {train_dir!r}")
+    # self-healing: newest valid wins; warn about every corpse we skip so
+    # operators know a write was torn (and can prune/investigate)
+    for s in reversed(steps):
+        path = checkpoint_path(train_dir, s)
+        try:
+            return _restore_state_dict(path)
+        except (CorruptCheckpointError, OSError) as exc:
+            warnings.warn(
+                f"skipping invalid checkpoint {path!r}: {exc}; "
+                "falling back to the previous step"
+            )
+    raise FileNotFoundError(
+        f"no VALID model_step_N checkpoints in {train_dir!r} "
+        f"(all {len(steps)} candidates failed integrity checks)"
+    )
+
+
+def latest_valid_step(train_dir: str) -> Optional[int]:
+    """Newest step whose file passes integrity checks (None if none do)."""
+    for s in reversed(list_steps(train_dir)):
+        if verify_checkpoint(train_dir, s):
+            return s
+    return None
 
 
 def load_checkpoint(train_dir: str, state_template, step: Optional[int] = None):
     """Restore a full TrainState; ``state_template`` supplies the pytree
     structure (build it with training.create_state on the same
-    model/optimizer — resuming training needs matching opt_state)."""
+    model/optimizer — resuming training needs matching opt_state).
+
+    ``step=None`` loads the newest checkpoint that passes integrity
+    verification, skipping corrupt/truncated files with a warning; an
+    explicit ``step`` raises :class:`CorruptCheckpointError` instead of
+    silently substituting different weights."""
     return serialization.from_state_dict(
         state_template, _read_state_dict(train_dir, step)
     )

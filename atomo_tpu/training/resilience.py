@@ -1,0 +1,199 @@
+"""Anomaly-guarded stepping + bounded retries — the train loop's immune
+system.
+
+Why skip-and-rescale is *valid here*: ATOMO's whole construction is an
+unbiased gradient estimator (PAPER.md — E[decode(encode(g))] = g). The mean
+over any subset of replicas is therefore still an unbiased estimate of the
+true gradient, just with more variance; dropping an anomalous contribution
+and re-scaling the surviving average by n/kept is statistically equivalent
+to one step at a smaller world size. The reference has no analogue: one
+worker shipping a NaN gradient NaNs the PS momentum buffer permanently
+(sync_replicas_master_nn.py:281-296 averages whatever arrives).
+
+Two layers:
+
+  * In-graph screening (:func:`grad_ok`, used by trainer.make_train_step and
+    parallel.replicated.make_distributed_train_step): finiteness plus an
+    optional global-L2-norm ceiling, computed on the raw per-replica
+    gradient BEFORE it is encoded/aggregated. Single host: an anomalous
+    step is skipped outright (params, opt state, BN stats all held).
+    Distributed: the anomalous replica's payload is masked out of the
+    gather/psum and the surviving mean is re-scaled; only a step with zero
+    survivors is skipped.
+
+  * Host-side bounded retries (:func:`with_retries`): checkpoint IO, the
+    data pipeline, and ``jax.distributed.initialize`` are fallible host ops
+    whose transient failures (NFS blips, coordinator races) should cost a
+    backoff, not the job.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Callable, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    """Anomaly screen settings.
+
+    max_grad_norm: reject a contribution whose global L2 norm exceeds this
+        (0 = finiteness check only). This is a *screen*, not clipping — the
+        gradient is dropped, not shrunk, so the estimator stays unbiased.
+    """
+
+    max_grad_norm: float = 0.0
+
+
+def grad_ok(grads, max_grad_norm: float = 0.0):
+    """Traced bool scalar: True iff every leaf is finite (and the global L2
+    norm is within ``max_grad_norm`` when > 0). An overflowing
+    sum-of-squares is itself non-finite, so the norm screen also catches
+    exploding gradients whose square overflows f32."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    ok = jnp.bool_(True)
+    sq = jnp.float32(0.0)
+    for leaf in leaves:
+        lf = leaf.astype(jnp.float32)
+        ok &= jnp.all(jnp.isfinite(lf))
+        sq += jnp.sum(lf * lf)
+    if max_grad_norm and max_grad_norm > 0:
+        ok &= sq <= jnp.float32(max_grad_norm) ** 2
+    return ok
+
+
+def select_state(ok, new_tree, old_tree):
+    """Per-leaf ``where(ok, new, old)`` — the skip: holding params, opt
+    state and BN stats at their pre-step values when ``ok`` is False."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree
+    )
+
+
+def zero_if(bad, tree):
+    """Zero every leaf when ``bad`` — keeps non-finite values out of the
+    optimizer update (whose arithmetic would propagate NaN into the
+    momentum buffers even if the result is later discarded)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda g: jnp.where(bad, jnp.zeros((), g.dtype), g), tree
+    )
+
+
+def resolve_chaos(chaos):
+    """Default the fault injector from the ATOMO_CHAOS env when the caller
+    passed none — the flagless path subprocess drills use. One definition
+    for both train loops."""
+    from atomo_tpu.utils.chaos import ChaosInjector
+
+    return ChaosInjector.from_env() if chaos is None else chaos
+
+
+@contextlib.contextmanager
+def heartbeat_watchdog(health_timeout: float, on_failure=None):
+    """Arm the step-heartbeat watchdog around a train loop body (no-op at
+    timeout 0). Yields the HealthMonitor to ``beat()`` — or None — and
+    guarantees the watchdog thread stops on the way out. One definition
+    for both train loops, so arming/stop semantics cannot drift."""
+    from atomo_tpu.parallel.launch import HealthMonitor, HealthWatchdog
+
+    monitor = watchdog = None
+    if health_timeout > 0:
+        monitor = HealthMonitor(timeout=health_timeout)
+        watchdog = HealthWatchdog(
+            monitor,
+            interval=min(health_timeout / 4, 10.0),
+            on_failure=on_failure,
+        ).start()
+    try:
+        yield monitor
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+
+
+def retrying_saver(log_fn=print):
+    """save_checkpoint wrapped in the standard bounded backoff — the one
+    saver both train loops (single-host and distributed) use, so retry
+    policy and logging cannot drift between them."""
+    from atomo_tpu.training.checkpoint import save_checkpoint
+
+    return with_retries(
+        save_checkpoint,
+        on_retry=lambda i, exc: log_fn(
+            f"Checkpoint save failed (attempt {i}): {exc}; retrying"
+        ),
+    )
+
+
+def masked_mean(tree, ok, kept, axis):
+    """Skip-and-rescale, psum form: zero this replica's contribution when
+    ``ok`` is False, sum over ``axis``, divide by the surviving count
+    (floored at 1 so the zero-survivor step stays finite; the caller's
+    select_state discards it anyway)."""
+    import jax
+    import jax.numpy as jnp
+
+    summed = jax.lax.psum(zero_if(~ok, tree), axis)
+    return jax.tree_util.tree_map(
+        lambda s: s / jnp.maximum(kept, 1.0).astype(s.dtype), summed
+    )
+
+
+def rescale_by_survivors(tree, n_contrib, kept):
+    """Skip-and-rescale, gather form: a mean taken over all ``n_contrib``
+    slots (anomalous ones masked to zero) re-scaled by n/kept so it equals
+    the mean over survivors alone."""
+    import jax
+    import jax.numpy as jnp
+
+    scale = n_contrib / jnp.maximum(kept, 1.0)
+    return jax.tree_util.tree_map(
+        lambda g: g * scale.astype(g.dtype), tree
+    )
+
+
+def with_retries(
+    fn: Callable,
+    *,
+    attempts: int = 3,
+    base_delay: float = 0.1,
+    max_delay: float = 5.0,
+    exceptions: Sequence[type] = (OSError,),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> Callable:
+    """Wrap a fallible host-side op with bounded exponential backoff.
+
+    Returns a callable with ``fn``'s signature that retries on the listed
+    exception types, sleeping base_delay * 2**i (capped at max_delay)
+    between attempts, and re-raises the last failure once ``attempts`` are
+    exhausted. Anything not in ``exceptions`` propagates immediately —
+    retrying a programming error just hides it.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    exc_types = tuple(exceptions)
+
+    def wrapped(*args, **kwargs):
+        for i in range(attempts):
+            try:
+                return fn(*args, **kwargs)
+            except exc_types as exc:
+                if i + 1 >= attempts:
+                    raise
+                if on_retry is not None:
+                    on_retry(i + 1, exc)
+                sleep(min(base_delay * (2 ** i), max_delay))
+
+    return wrapped

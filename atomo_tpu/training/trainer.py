@@ -90,7 +90,7 @@ def create_state(model, optimizer, rng, sample_input) -> TrainState:
 
 
 def make_train_step(model, optimizer, codec=None, augment: bool = False,
-                    compute_dtype=None):
+                    compute_dtype=None, guard=None, chaos=None):
     """Build the jitted single-host train step.
 
     codec != None applies encode->decode to the gradient pytree in-graph
@@ -102,7 +102,19 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
     stay float32; the forward/backward matmuls and convs run in the given
     dtype — the MXU's native bf16 path, a TPU capability the all-f32
     CPU-torch reference has no analogue for. None = full f32.
+
+    guard (resilience.GuardConfig) arms in-graph anomaly screening: a step
+    whose raw gradient is non-finite (or beyond guard.max_grad_norm) is
+    skipped — params, optimizer state and BN stats hold their pre-step
+    values, the step counter still advances (the batch was consumed), and
+    metrics["skipped"] is 1. Single host has no surviving contributions to
+    rescale; skipping outright is the n=kept=0 case of the distributed
+    skip-and-rescale policy (resilience.py rationale).
+
+    chaos (utils.chaos.ChaosInjector) bakes the configured gradient faults
+    into the compiled step — test/validation hook, zero-cost when None.
     """
+    from atomo_tpu.training.resilience import grad_ok, select_state, zero_if
 
     def loss_fn(params, batch_stats, images, labels, dropout_key):
         if compute_dtype is not None:
@@ -134,6 +146,15 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
             loss_fn, has_aux=True
         )(state.params, state.batch_stats, images, labels, k_drop)
 
+        if chaos is not None:
+            grads = chaos.inject_grads(grads, state.step + 1)
+        ok = None
+        if guard is not None:
+            ok = grad_ok(grads, guard.max_grad_norm)
+            # keep non-finite values out of the codec/optimizer arithmetic;
+            # the skipped step's outputs are discarded below regardless
+            grads = zero_if(~ok, grads)
+
         msg_bytes = 0
         if codec is not None:
             payloads, stats = encode_tree(codec, k_codec, grads)
@@ -142,12 +163,19 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
 
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        skipped = jnp.float32(0.0)
+        if ok is not None:
+            new_params = select_state(ok, new_params, state.params)
+            new_opt = select_state(ok, new_opt, state.opt_state)
+            new_stats = select_state(ok, new_stats, state.batch_stats)
+            skipped = 1.0 - ok.astype(jnp.float32)
         prec1, prec5 = accuracy(logits, labels)
         metrics = {
             "loss": loss,
             "prec1": prec1,
             "prec5": prec5,
             "msg_bytes": jnp.asarray(msg_bytes, jnp.int32),
+            "skipped": skipped,
         }
         return (
             TrainState(
@@ -208,51 +236,118 @@ def train_loop(
     log_fn=print,
     log_every: int = 1,
     compute_dtype=None,
+    guard=None,
+    chaos=None,
+    health_timeout: float = 0.0,
+    on_health_failure=None,
+    keep_ckpts: int = 0,
 ) -> TrainState:
     """The reference train_and_validate loop (nn_ops.py:123-169), jitted,
-    plus working checkpoint/resume (gap §5.4)."""
-    from atomo_tpu.training.checkpoint import latest_step, load_checkpoint, save_checkpoint
+    plus working checkpoint/resume (gap §5.4) and the fault-tolerance
+    stack: anomaly-guarded stepping (``guard``), deterministic fault
+    injection (``chaos``), a heartbeat watchdog (``health_timeout`` > 0,
+    ``on_health_failure`` pluggable), retry-wrapped checkpoint IO, and
+    keep-last-K retention (``keep_ckpts``).
 
+    Resume determinism: on resume the data stream is fast-forwarded past
+    the ``start_step`` batches the interrupted run consumed, so a
+    kill→restart→resume run replays the exact batch sequence of an
+    uninterrupted one (host-side numpy indexing — cheap relative to a
+    step). ``chaos`` defaults to the ATOMO_CHAOS env config so subprocess
+    harnesses inject faults without plumbing."""
+    from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
+    from atomo_tpu.training.resilience import (
+        heartbeat_watchdog,
+        resolve_chaos,
+        retrying_saver,
+    )
+
+    chaos = resolve_chaos(chaos)
     sample_images, _ = next(iter(train_iter.epoch()))
     state = create_state(
         model, optimizer, jax.random.PRNGKey(seed), jnp.asarray(sample_images)
     )
     start_step = 0
     if resume and train_dir and latest_step(train_dir) is not None:
-        state = load_checkpoint(train_dir, state)
-        start_step = int(state.step)
-        log_fn(f"Resumed from {train_dir} at step {start_step}")
+        try:
+            state = load_checkpoint(train_dir, state)
+            start_step = int(state.step)
+            log_fn(f"Resumed from {train_dir} at step {start_step}")
+        except FileNotFoundError as exc:
+            # files exist but none passed integrity checks — a fresh start
+            # beats dying when the operator asked for elastic restarts
+            log_fn(f"Resume requested but {exc}; starting fresh")
     step_fn = make_train_step(
-        model, optimizer, codec=codec, augment=augment, compute_dtype=compute_dtype
+        model, optimizer, codec=codec, augment=augment,
+        compute_dtype=compute_dtype, guard=guard, chaos=chaos,
     )
+    save_fn = retrying_saver(log_fn)
     key = jax.random.PRNGKey(seed + 1)
     timer = Timer()
-    stream = train_iter.forever()
+    # replay: skip the batches the interrupted run consumed so the resumed
+    # data order matches the uninterrupted run's (docstring); index-only
+    stream = train_iter.forever(skip=start_step)
     n_train = len(train_iter.dataset)
-    for step in range(start_step + 1, max_steps + 1):
-        images, labels = next(stream)
-        state, metrics = step_fn(state, key, jnp.asarray(images), jnp.asarray(labels))
-        if log_every and step % log_every == 0:
-            rec = StepMetrics(
-                rank=0,
-                step=step,
-                epoch=step * train_iter.batch_size // max(n_train, 1),
-                samples_seen=(step * train_iter.batch_size) % max(n_train, 1),
-                dataset_size=n_train,
-                loss=float(metrics["loss"]),
-                time_cost=timer.lap(),
-                msg_bytes=int(metrics["msg_bytes"]),
-                prec1=float(metrics["prec1"]),
-                prec5=float(metrics["prec5"]),
-            )
-            log_fn(rec.worker_line())
-        if eval_freq and test_iter is not None and step % eval_freq == 0:
-            ev = evaluate(model, state, test_iter)
-            log_fn(
-                "Validation: Step: {}, Loss: {:.4f}, Prec@1: {:.4f}, Prec@5: {:.4f}".format(
-                    step, ev["loss"], ev["prec1"], ev["prec5"]
+    last_saved = start_step
+    with heartbeat_watchdog(health_timeout, on_health_failure) as monitor:
+        for step in range(start_step + 1, max_steps + 1):
+            if chaos is not None:
+                chaos.maybe_die(step)
+                chaos.maybe_sleep(step)
+            images, labels = next(stream)
+            state, metrics = step_fn(state, key, jnp.asarray(images), jnp.asarray(labels))
+            if monitor is not None:
+                jax.block_until_ready(metrics["loss"])
+                monitor.beat(step)
+            # guard diagnostics share the log cadence: fetching the skip
+            # flag every step would block host dispatch on every step's
+            # result even when nothing is ever dropped
+            if (
+                guard is not None
+                and log_every and step % log_every == 0
+                and float(metrics["skipped"]) > 0
+            ):
+                log_fn(
+                    f"Guard: Step: {step}, Dropped: 1/1, Action: skip "
+                    "(anomalous gradient; params/opt state held)"
                 )
+            if log_every and step % log_every == 0:
+                rec = StepMetrics(
+                    rank=0,
+                    step=step,
+                    epoch=step * train_iter.batch_size // max(n_train, 1),
+                    samples_seen=(step * train_iter.batch_size) % max(n_train, 1),
+                    dataset_size=n_train,
+                    loss=float(metrics["loss"]),
+                    time_cost=timer.lap(),
+                    msg_bytes=int(metrics["msg_bytes"]),
+                    prec1=float(metrics["prec1"]),
+                    prec5=float(metrics["prec5"]),
+                )
+                log_fn(rec.worker_line())
+            if eval_freq and test_iter is not None and step % eval_freq == 0:
+                ev = evaluate(model, state, test_iter)
+                log_fn(
+                    "Validation: Step: {}, Loss: {:.4f}, Prec@1: {:.4f}, Prec@5: {:.4f}".format(
+                        step, ev["loss"], ev["prec1"], ev["prec5"]
+                    )
+                )
+            if save_freq and train_dir and step % save_freq == 0:
+                path = save_fn(
+                    train_dir, state, step, compress=compress_ckpt,
+                    keep=keep_ckpts,
+                )
+                last_saved = step
+                if chaos is not None:
+                    chaos.maybe_corrupt_checkpoint(path, step)
+        # autosave the final state so a restart never replays the tail
+        # (strictly `<`: a resume past max_steps runs no steps and must not
+        # write a file whose name disagrees with the state's step field)
+        if save_freq and train_dir and last_saved < max_steps:
+            path = save_fn(
+                train_dir, state, max_steps, compress=compress_ckpt,
+                keep=keep_ckpts,
             )
-        if save_freq and train_dir and step % save_freq == 0:
-            save_checkpoint(train_dir, state, step, compress=compress_ckpt)
+            if chaos is not None:  # ckpt faults target autosaves too
+                chaos.maybe_corrupt_checkpoint(path, max_steps)
     return state

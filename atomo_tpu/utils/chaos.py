@@ -1,0 +1,278 @@
+"""Chaos harness: deterministic, seedable fault injection for the
+fault-tolerance stack.
+
+The reference has no failure story at all — a dead MPI worker hangs its
+master's ``waitany`` forever (SURVEY.md §5.3) and nothing can *cause* a
+failure on purpose to test any of it. This module is the missing half of
+the proof: every recovery path (anomaly-guarded stepping, self-healing
+checkpoint loads, watchdog restart) is exercised by injecting the failure
+it defends against, at an exact step, reproducibly.
+
+Fault kinds
+-----------
+  nan@S       gradient becomes non-finite (NaN) at step S   (in-graph)
+  inf@S       gradient becomes non-finite (Inf) at step S   (in-graph)
+  explode@S   gradient norm blows up (finite) at step S     (in-graph)
+  slow@S:SEC  host sleeps SEC seconds before step S         (host)
+  kill@S      process dies (os._exit) before step S runs    (host)
+  truncate@S  the checkpoint written at step S is truncated (host, post-save)
+  bitflip@S   one bit of the step-S checkpoint is flipped   (host, post-save)
+  badmagic@S  the step-S checkpoint's magic is clobbered    (host, post-save)
+
+Specs are comma-separated (``"nan@3,kill@6"``) and come from the
+``ATOMO_CHAOS`` env var or the ``--chaos`` CLI flag. The in-graph faults
+are baked into the compiled step as constant (step, code) tables, so they
+are exactly reproducible and add one predicated multiply-add per leaf —
+``jnp.where`` on a scalar the XLA scheduler hoists; zero cost when no
+chaos config is given (the hook is simply absent).
+
+Distributed targeting: ``target_replica`` (default 0) confines a gradient
+fault to one replica's contribution so skip-and-rescale has survivors to
+rescale. A starred fault (spec suffix ``@S*``) poisons every replica — the
+all-dead skip path — per fault: ``"nan@2,inf@5*"`` hits only the target
+replica at step 2 but all replicas at step 5. ``target_replica=-1``
+(direct construction) makes every fault all-replica.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import sys
+import time
+from typing import Optional
+
+GRAD_FAULTS = {"nan": 1, "inf": 2, "explode": 3}
+CKPT_FAULTS = ("truncate", "bitflip", "badmagic")
+CHAOS_EXIT_CODE = 43  # distinct from crashes (1) and the watchdog's 13
+
+_SPEC_RE = re.compile(
+    r"^(?P<kind>[a-z]+)@(?P<step>\d+)(?P<all>\*)?(?::(?P<arg>[0-9.e+-]+))?$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Parsed fault plan. ``slow_steps``/``ckpt_faults`` are (step, ...)
+    tuples; steps are the 1-based trainer step numbers. ``grad_faults``
+    entries are (step, kind, all_replicas): the ``@S*`` spec suffix sets
+    ``all_replicas`` for THAT fault only — un-starred faults in the same
+    plan still hit just ``target_replica``."""
+
+    grad_faults: tuple[tuple[int, str, bool], ...] = ()
+    slow_steps: tuple[tuple[int, float], ...] = ()
+    kill_steps: tuple[int, ...] = ()
+    ckpt_faults: tuple[tuple[int, str], ...] = ()
+    explode_scale: float = 1e12
+    target_replica: int = 0
+    exit_code: int = CHAOS_EXIT_CODE
+    seed: int = 0
+
+    def __post_init__(self):
+        # one gradient fault per step: the in-graph selector sums the
+        # matching codes, so two faults on one step would silently combine
+        # into a DIFFERENT fault kind (nan+inf -> explode's code)
+        steps = [f[0] for f in self.grad_faults]
+        if len(steps) != len(set(steps)):
+            raise ValueError(
+                "multiple gradient faults configured for the same step "
+                f"({sorted(steps)}); pick one fault kind per step"
+            )
+
+    @classmethod
+    def from_spec(cls, spec: str, *, seed: int = 0) -> "ChaosConfig":
+        grad, slow, kill, ckpt = [], [], [], []
+        for raw in spec.split(","):
+            tok = raw.strip().lower()
+            if not tok:
+                continue
+            m = _SPEC_RE.match(tok)
+            if m is None:
+                raise ValueError(
+                    f"bad chaos token {tok!r}; expected kind@step[*][:arg] "
+                    f"with kind in {sorted(GRAD_FAULTS) + ['slow', 'kill'] + list(CKPT_FAULTS)}"
+                )
+            kind, step = m.group("kind"), int(m.group("step"))
+            arg = m.group("arg")
+            if kind in GRAD_FAULTS:
+                grad.append((step, kind, bool(m.group("all"))))
+            elif kind == "slow":
+                slow.append((step, float(arg) if arg else 0.25))
+            elif kind == "kill":
+                kill.append(step)
+            elif kind in CKPT_FAULTS:
+                ckpt.append((step, kind))
+            else:
+                raise ValueError(f"unknown chaos fault kind {kind!r}")
+        return cls(
+            grad_faults=tuple(grad),
+            slow_steps=tuple(slow),
+            kill_steps=tuple(kill),
+            ckpt_faults=tuple(ckpt),
+            seed=seed,
+        )
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["ChaosConfig"]:
+        """ATOMO_CHAOS spec (ATOMO_CHAOS_SEED seeds the corruption RNG);
+        None when unset/empty — the zero-cost default."""
+        env = os.environ if environ is None else environ
+        spec = env.get("ATOMO_CHAOS", "")
+        if not spec.strip():
+            return None
+        return cls.from_spec(spec, seed=int(env.get("ATOMO_CHAOS_SEED", "0")))
+
+    def enabled(self) -> bool:
+        return bool(
+            self.grad_faults or self.slow_steps or self.kill_steps
+            or self.ckpt_faults
+        )
+
+
+class ChaosInjector:
+    """Applies a :class:`ChaosConfig`. In-graph methods take traced step
+    scalars; host methods take Python ints."""
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+
+    @classmethod
+    def from_env(cls, environ=None) -> Optional["ChaosInjector"]:
+        cfg = ChaosConfig.from_env(environ)
+        return cls(cfg) if cfg is not None and cfg.enabled() else None
+
+    # ---- in-graph gradient faults -------------------------------------
+
+    def grad_fault_code(self, step):
+        """Traced int32 fault code for ``step`` (0 = none; steps are unique
+        per config validation, so the sum selects exactly one entry).
+        ``step`` is the 1-based loop step; in-graph callers pass
+        ``state.step + 1`` (the step being computed)."""
+        import jax.numpy as jnp
+
+        if not self.config.grad_faults:
+            return jnp.int32(0)
+        steps = jnp.asarray(
+            [f[0] for f in self.config.grad_faults], jnp.int32
+        )
+        codes = jnp.asarray(
+            [GRAD_FAULTS[f[1]] for f in self.config.grad_faults], jnp.int32
+        )
+        step = jnp.asarray(step, jnp.int32)
+        return jnp.sum(jnp.where(steps == step, codes, 0)).astype(jnp.int32)
+
+    def inject_grads(self, grads, step, replica=None):
+        """Poison the gradient pytree when ``step`` matches a grad fault.
+        With ``replica`` (a traced replica index) given, a fault hits only
+        ``target_replica`` — unless that fault was starred (``@S*``), which
+        hits every replica (the zero-survivors drill)."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self.config.grad_faults:
+            return grads
+        code = self.grad_fault_code(step)
+        if replica is not None:
+            step_t = jnp.asarray(step, jnp.int32)
+            steps = jnp.asarray(
+                [f[0] for f in self.config.grad_faults], jnp.int32
+            )
+            alls = jnp.asarray(
+                [1 if f[2] else 0 for f in self.config.grad_faults], jnp.int32
+            )
+            fault_is_all = jnp.sum(jnp.where(steps == step_t, alls, 0)) > 0
+            tr = self.config.target_replica
+            on_target = (
+                jnp.bool_(True)
+                if tr < 0  # config-wide "all replicas"
+                else jnp.asarray(replica, jnp.int32) == tr
+            )
+            code = jnp.where(fault_is_all | on_target, code, 0)
+        # none: g*1 + 0; explode: g*scale + 0; nan/inf: g*1 + (nan|inf)
+        mul = jnp.where(code == 3, jnp.float32(self.config.explode_scale), 1.0)
+        add = jnp.where(
+            code == 1,
+            jnp.float32(jnp.nan),
+            jnp.where(code == 2, jnp.float32(jnp.inf), jnp.float32(0.0)),
+        )
+        return jax.tree_util.tree_map(
+            lambda g: g * mul.astype(g.dtype) + add.astype(g.dtype), grads
+        )
+
+    # ---- host-side faults ---------------------------------------------
+
+    def maybe_sleep(self, step: int) -> float:
+        """Sleep if a slow@ fault targets ``step``; returns seconds slept."""
+        total = 0.0
+        for s, sec in self.config.slow_steps:
+            if s == step:
+                time.sleep(sec)
+                total += sec
+        return total
+
+    def should_die(self, step: int) -> bool:
+        return step in self.config.kill_steps
+
+    def maybe_die(self, step: int) -> None:
+        """Simulated process death: flush and hard-exit BEFORE the step runs
+        (no finally blocks, no atexit — like a real OOM-kill/preemption)."""
+        if self.should_die(step):
+            print(
+                f"CHAOS: killing process before step {step} "
+                f"(exit {self.config.exit_code})",
+                file=sys.stderr,
+                flush=True,
+            )
+            os._exit(self.config.exit_code)
+
+    def ckpt_fault_for(self, step: int) -> Optional[str]:
+        for s, kind in self.config.ckpt_faults:
+            if s == step:
+                return kind
+        return None
+
+    def maybe_corrupt_checkpoint(self, path: str, step: int) -> Optional[str]:
+        """Apply the configured corruption to a just-written checkpoint."""
+        kind = self.ckpt_fault_for(step)
+        if kind is None:
+            return None
+        corrupt_file(path, kind, seed=self.config.seed ^ step)
+        print(f"CHAOS: corrupted checkpoint {path} ({kind})", file=sys.stderr,
+              flush=True)
+        return kind
+
+
+# ---- checkpoint corruption primitives (also used directly by tests) ----
+
+
+def corrupt_file(path: str, kind: str, seed: int = 0) -> None:
+    """Deterministically damage a file in place.
+
+    truncate: drop the trailing 60% (keeps a valid-looking header; the
+              payload and any trailing CRC-covered bytes are gone)
+    bitflip:  flip one pseudorandom bit in the body (past the 8-byte
+              header so the magic still matches and the CRC must catch it)
+    badmagic: overwrite the first 4 bytes
+    """
+    import numpy as np
+
+    with open(path, "rb") as f:
+        blob = bytearray(f.read())
+    if kind == "truncate":
+        keep = max(9, int(len(blob) * 0.4))
+        blob = blob[:keep]
+    elif kind == "bitflip":
+        if len(blob) <= 8:
+            raise ValueError(f"{path!r} too small to bitflip past its header")
+        rng = np.random.default_rng(seed)
+        pos = 8 + int(rng.integers(0, len(blob) - 8))
+        blob[pos] ^= 1 << int(rng.integers(0, 8))
+    elif kind == "badmagic":
+        blob[:4] = b"XXXX"
+    else:
+        raise ValueError(f"unknown corruption kind {kind!r}")
+    tmp = path + ".chaos"
+    with open(tmp, "wb") as f:
+        f.write(bytes(blob))
+    os.replace(tmp, path)

@@ -1,0 +1,68 @@
+"""Worker for the kill→restart→resume fault-tolerance drill.
+
+Launched (never imported) by tests/test_fault_tolerance.py: trains a small
+deterministic single-host job (LeNet, synthetic MNIST, no dropout/augment)
+with anomaly-guarded stepping, periodic checkpoints, and whatever chaos the
+ATOMO_CHAOS env injects (the train loop reads it itself). The parent
+compares per-step loss lines and the final parameter hash across
+  * an uninterrupted oracle run,
+  * a run the chaos harness kills mid-training, and
+  * its --resume restart,
+proving the restart recovers the oracle's exact trajectory (data-stream
+replay + full opt-state checkpoints make it bit-reproducible on one
+backend).
+
+Env: ATOMO_FT_DIR (train_dir), ATOMO_FT_RESUME=1 (resume), ATOMO_FT_STEPS
+(default 8), ATOMO_CHAOS (fault plan, e.g. "nan@3,kill@6").
+"""
+
+import hashlib
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from atomo_tpu.data import SPECS, BatchIterator, synthetic_dataset  # noqa: E402
+from atomo_tpu.models import get_model  # noqa: E402
+from atomo_tpu.training import (  # noqa: E402
+    GuardConfig,
+    make_optimizer,
+    train_loop,
+)
+
+
+def main() -> None:
+    train_dir = os.environ["ATOMO_FT_DIR"]
+    resume = os.environ.get("ATOMO_FT_RESUME") == "1"
+    max_steps = int(os.environ.get("ATOMO_FT_STEPS", "8"))
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.05, momentum=0.9)  # momentum: the
+    # restart must restore the optimizer state, not just params
+    ds = synthetic_dataset(SPECS["mnist"], True, size=128)
+    it = BatchIterator(ds, 16, seed=0)
+    state = train_loop(
+        model,
+        opt,
+        it,
+        max_steps=max_steps,
+        train_dir=train_dir,
+        save_freq=2,
+        resume=resume,
+        log_every=1,
+        seed=0,
+        guard=GuardConfig(),
+        log_fn=lambda s: print(s, flush=True),
+    )
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(state.params)):
+        h.update(np.asarray(leaf).tobytes())
+    print("FTFINAL " + h.hexdigest(), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 from atomo_tpu.parallel import launch  # noqa: E402
+from atomo_tpu.utils.chaos import ChaosInjector  # noqa: E402
+
+# simulated process death (kill@1) BEFORE the distributed handshake, so the
+# fault-tolerance drill can kill real workers without deadlocking the peer
+# in a collective (tests/test_fault_tolerance.py)
+_chaos = ChaosInjector.from_env()
+if _chaos is not None:
+    _chaos.maybe_die(1)
 
 launch.initialize()  # env path: JAX_COORDINATOR_ADDRESS / _NUM_PROCESSES / _ID
 

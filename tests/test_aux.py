@@ -181,3 +181,23 @@ def test_adam_amsgrad_variants_build():
     ):
         opt = make_optimizer(**kwargs)
         assert isinstance(opt, optax.GradientTransformation)
+
+
+def test_initialize_retries_transient_failure(monkeypatch):
+    """The restart race: the coordinator is not listening yet on the first
+    attempt; initialize() must back off and retry instead of dying (and
+    must reset jax's half-initialized distributed state between tries)."""
+    calls = []
+
+    def flaky(**kw):
+        calls.append(kw)
+        if len(calls) == 1:
+            raise RuntimeError("connect timed out")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky)
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    initialize(backoff=0.01)
+    assert len(calls) == 2
+    assert calls[1]["coordinator_address"] == "10.0.0.1:1234"

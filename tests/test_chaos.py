@@ -1,0 +1,159 @@
+"""Chaos harness unit tests: spec parsing, deterministic in-graph fault
+injection, and file-corruption primitives (utils/chaos.py)."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from atomo_tpu.utils.chaos import (
+    CHAOS_EXIT_CODE,
+    ChaosConfig,
+    ChaosInjector,
+    corrupt_file,
+)
+
+
+def test_spec_parsing_all_kinds():
+    cfg = ChaosConfig.from_spec(
+        "nan@3,inf@5,explode@7,slow@2:0.5,kill@6,truncate@4,bitflip@8,badmagic@9"
+    )
+    assert cfg.grad_faults == (
+        (3, "nan", False), (5, "inf", False), (7, "explode", False)
+    )
+    assert cfg.slow_steps == ((2, 0.5),)
+    assert cfg.kill_steps == (6,)
+    assert cfg.ckpt_faults == ((4, "truncate"), (8, "bitflip"), (9, "badmagic"))
+    assert cfg.target_replica == 0
+    assert cfg.exit_code == CHAOS_EXIT_CODE
+    assert cfg.enabled()
+
+
+def test_spec_star_is_per_fault():
+    """@S* marks THAT fault all-replica; other faults in the same plan
+    keep hitting only the target replica."""
+    cfg = ChaosConfig.from_spec("nan@2,inf@5*")
+    assert cfg.grad_faults == ((2, "nan", False), (5, "inf", True))
+    assert cfg.target_replica == 0  # unchanged by the star
+
+
+def test_spec_rejects_garbage():
+    for bad in ("frobnicate@3", "nan", "nan@x", "kill@3:oops,"):
+        with pytest.raises(ValueError):
+            ChaosConfig.from_spec(bad)
+
+
+def test_spec_rejects_duplicate_grad_fault_steps():
+    """Two gradient faults on one step would sum their in-graph codes into
+    a different fault kind (nan+inf == explode's code) — refused up front."""
+    with pytest.raises(ValueError, match="same step"):
+        ChaosConfig.from_spec("nan@4,inf@4")
+    with pytest.raises(ValueError, match="same step"):
+        ChaosConfig(grad_faults=((4, "nan", False), (4, "explode", False)))
+
+
+def test_from_env():
+    assert ChaosConfig.from_env({}) is None
+    assert ChaosConfig.from_env({"ATOMO_CHAOS": "  "}) is None
+    cfg = ChaosConfig.from_env({"ATOMO_CHAOS": "kill@4", "ATOMO_CHAOS_SEED": "7"})
+    assert cfg.kill_steps == (4,) and cfg.seed == 7
+    assert ChaosInjector.from_env({"ATOMO_CHAOS": "kill@4"}).should_die(4)
+    assert ChaosInjector.from_env({}) is None
+
+
+def test_inject_grads_deterministic_per_step():
+    inj = ChaosInjector(ChaosConfig.from_spec("nan@2,inf@3,explode@4"))
+    grads = {"w": jnp.ones((4,)), "b": jnp.full((2,), 2.0)}
+
+    @jax.jit
+    def poisoned(step):
+        return inj.inject_grads(grads, step)
+
+    g1 = poisoned(1)
+    np.testing.assert_array_equal(np.asarray(g1["w"]), np.ones(4))
+    assert np.isnan(np.asarray(poisoned(2)["w"])).all()
+    assert np.isinf(np.asarray(poisoned(3)["b"])).all()
+    g4 = np.asarray(poisoned(4)["w"])
+    assert np.isfinite(g4).all() and (g4 > 1e11).all()
+    # steps past the plan are untouched
+    np.testing.assert_array_equal(np.asarray(poisoned(5)["b"]), np.full(2, 2.0))
+
+
+def test_inject_grads_replica_targeting():
+    inj = ChaosInjector(ChaosConfig.from_spec("nan@2"))
+    grads = {"w": jnp.ones((4,))}
+    hit = inj.inject_grads(grads, 2, replica=jnp.int32(0))
+    miss = inj.inject_grads(grads, 2, replica=jnp.int32(1))
+    assert np.isnan(np.asarray(hit["w"])).all()
+    np.testing.assert_array_equal(np.asarray(miss["w"]), np.ones(4))
+    # starred fault poisons every replica...
+    inj_all = ChaosInjector(ChaosConfig.from_spec("nan@2*"))
+    for r in (0, 3):
+        assert np.isnan(
+            np.asarray(inj_all.inject_grads(grads, 2, replica=jnp.int32(r))["w"])
+        ).all()
+    # ...without widening the other faults in the same plan
+    inj_mix = ChaosInjector(ChaosConfig.from_spec("nan@2,inf@5*"))
+    off_target = inj_mix.inject_grads(grads, 2, replica=jnp.int32(1))
+    np.testing.assert_array_equal(np.asarray(off_target["w"]), np.ones(4))
+    assert np.isinf(
+        np.asarray(inj_mix.inject_grads(grads, 5, replica=jnp.int32(1))["w"])
+    ).all()
+
+
+def test_maybe_sleep_and_die_steps():
+    inj = ChaosInjector(ChaosConfig.from_spec("slow@3:0.05,kill@9"))
+    t0 = time.monotonic()
+    assert inj.maybe_sleep(3) == 0.05
+    assert time.monotonic() - t0 >= 0.05
+    assert inj.maybe_sleep(4) == 0.0
+    assert inj.should_die(9) and not inj.should_die(8)
+    inj.maybe_die(8)  # must NOT exit on a non-kill step
+
+
+def _write(path, data: bytes):
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def test_corrupt_truncate(tmp_path):
+    p = str(tmp_path / "f")
+    _write(p, bytes(range(100)))
+    corrupt_file(p, "truncate")
+    assert 9 <= os.path.getsize(p) < 100
+
+
+def test_corrupt_bitflip_deterministic(tmp_path):
+    blob = bytes(100)
+    p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+    _write(p1, blob)
+    _write(p2, blob)
+    corrupt_file(p1, "bitflip", seed=5)
+    corrupt_file(p2, "bitflip", seed=5)
+    with open(p1, "rb") as f:
+        d1 = f.read()
+    with open(p2, "rb") as f:
+        d2 = f.read()
+    assert d1 == d2 != blob  # same seed, same flip
+    assert d1[:8] == blob[:8]  # header untouched: the CRC must catch it
+    diff = [i for i in range(100) if d1[i] != blob[i]]
+    assert len(diff) == 1
+    assert bin(d1[diff[0]] ^ blob[diff[0]]).count("1") == 1
+
+
+def test_corrupt_badmagic(tmp_path):
+    p = str(tmp_path / "f")
+    _write(p, b"ATR2" + bytes(60))
+    corrupt_file(p, "badmagic")
+    with open(p, "rb") as f:
+        assert f.read(4) == b"XXXX"
+
+
+def test_corrupt_unknown_kind(tmp_path):
+    p = str(tmp_path / "f")
+    _write(p, bytes(20))
+    with pytest.raises(ValueError):
+        corrupt_file(p, "gamma-ray")

@@ -62,6 +62,16 @@ def _run_two_process(mode: str, extra_env: dict | None = None):
                 pool.map(lambda p: p.communicate(timeout=_TIMEOUT_S), procs)
             )
         for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0 and (
+                "Multiprocess computations aren't implemented" in err
+            ):
+                # installed jaxlib's CPU backend has no cross-process
+                # collectives (API drift); the test is only meaningful on
+                # runtimes that support them (real pods, newer jaxlib)
+                pytest.skip(
+                    "CPU backend lacks multiprocess collectives in this "
+                    "jaxlib; 2-process smoke needs a capable runtime"
+                )
             assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
             for line in out.splitlines():
                 if line.startswith("RESULT "):

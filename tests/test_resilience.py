@@ -1,0 +1,278 @@
+"""Anomaly-guarded stepping + retry wrapper tests (training/resilience.py;
+skip-and-rescale wiring in trainer.py / parallel/replicated.py).
+
+The policy under test: drop an anomalous replica's contribution and
+re-scale the surviving average by n/kept — valid because ATOMO's estimator
+is unbiased (resilience.py docstring). The psum-mode test checks the
+arithmetic EXACTLY against per-shard gradients computed outside the SPMD
+step (LeNet is deterministic: no dropout, no BN)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from atomo_tpu.codecs import SvdCodec
+from atomo_tpu.models import get_model
+from atomo_tpu.parallel.mesh import make_mesh
+from atomo_tpu.parallel.replicated import (
+    make_distributed_train_step,
+    replicate_state,
+    shard_batch,
+)
+from atomo_tpu.training import GuardConfig, create_state, grad_ok, with_retries
+from atomo_tpu.training.trainer import make_train_step
+from atomo_tpu.utils.chaos import ChaosConfig, ChaosInjector
+
+
+# ---------------- grad_ok ----------------
+
+
+def test_grad_ok_screens_nonfinite_and_norm():
+    good = {"a": jnp.ones((3,)), "b": jnp.zeros((2, 2))}
+    assert bool(grad_ok(good))
+    assert not bool(grad_ok({"a": jnp.array([1.0, jnp.nan])}))
+    assert not bool(grad_ok({"a": jnp.array([jnp.inf])}))
+    # norm screen: ||g|| = 2 over 4 unit entries
+    g = {"a": jnp.ones((4,))}
+    assert bool(grad_ok(g, max_grad_norm=3.0))
+    assert not bool(grad_ok(g, max_grad_norm=1.0))
+    # f32 overflow in the sum of squares reads as non-finite -> dropped
+    assert not bool(grad_ok({"a": jnp.full((4,), 1e30)}, max_grad_norm=1e6))
+
+
+# ---------------- with_retries ----------------
+
+
+def test_with_retries_recovers_and_backs_off():
+    calls, slept, notes = [], [], []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("disk on fire")
+        return "ok"
+
+    wrapped = with_retries(
+        flaky,
+        attempts=4,
+        base_delay=0.1,
+        on_retry=lambda i, exc: notes.append((i, str(exc))),
+        sleep=slept.append,
+    )
+    assert wrapped() == "ok"
+    assert len(calls) == 3
+    assert slept == [0.1, 0.2]  # exponential
+    assert [i for i, _ in notes] == [1, 2]
+
+
+def test_with_retries_exhausts_and_raises():
+    slept = []
+    wrapped = with_retries(
+        lambda: (_ for _ in ()).throw(OSError("nope")),
+        attempts=3,
+        sleep=slept.append,
+    )
+    with pytest.raises(OSError):
+        wrapped()
+    assert len(slept) == 2
+
+
+def test_with_retries_unlisted_exception_propagates_immediately():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise KeyError("bug, not flake")
+
+    with pytest.raises(KeyError):
+        with_retries(boom, attempts=5, sleep=lambda s: None)()
+    assert len(calls) == 1
+
+
+def test_with_retries_rejects_zero_attempts():
+    with pytest.raises(ValueError):
+        with_retries(lambda: None, attempts=0)
+
+
+# ---------------- single-host guarded step ----------------
+
+
+def _lenet_setup(lr=0.1):
+    model = get_model("lenet", 10)
+    opt = optax.sgd(lr)
+    rng = np.random.RandomState(0)
+    images = rng.rand(8, 28, 28, 1).astype(np.float32)
+    labels = rng.randint(0, 10, (8,)).astype(np.int32)
+    state = create_state(model, opt, jax.random.PRNGKey(0), jnp.asarray(images))
+    return model, opt, state, jnp.asarray(images), jnp.asarray(labels)
+
+
+def _leaves(tree):
+    return [np.asarray(jax.device_get(l)) for l in jax.tree_util.tree_leaves(tree)]
+
+
+def test_single_host_guard_skips_injected_nan_step():
+    model, opt, state, images, labels = _lenet_setup()
+    chaos = ChaosInjector(ChaosConfig.from_spec("nan@2"))
+    step = make_train_step(model, opt, guard=GuardConfig(), chaos=chaos)
+    key = jax.random.PRNGKey(1)
+
+    state1, m1 = step(state, key, images, labels)
+    assert float(m1["skipped"]) == 0.0
+    state2, m2 = step(state1, key, images, labels)
+    # the poisoned step is skipped: params/opt state held, counter advances
+    assert float(m2["skipped"]) == 1.0
+    assert int(state2.step) == 2
+    for a, b in zip(_leaves(state2.params), _leaves(state1.params)):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(_leaves(state2.opt_state), _leaves(state1.opt_state)):
+        np.testing.assert_array_equal(a, b)
+    # and training continues afterwards with finite params
+    state3, m3 = step(state2, key, images, labels)
+    assert float(m3["skipped"]) == 0.0
+    for leaf in _leaves(state3.params):
+        assert np.isfinite(leaf).all()
+    assert any(
+        not np.array_equal(a, b)
+        for a, b in zip(_leaves(state3.params), _leaves(state2.params))
+    )
+
+
+def test_single_host_norm_screen_drops_exploding_step():
+    model, opt, state, images, labels = _lenet_setup()
+    chaos = ChaosInjector(ChaosConfig.from_spec("explode@1"))
+    step = make_train_step(
+        model, opt, guard=GuardConfig(max_grad_norm=1e4), chaos=chaos
+    )
+    state1, m1 = step(state, jax.random.PRNGKey(1), images, labels)
+    assert float(m1["skipped"]) == 1.0  # finite but enormous -> screened
+    for a, b in zip(_leaves(state1.params), _leaves(state.params)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_single_host_unguarded_step_reports_not_skipped():
+    model, opt, state, images, labels = _lenet_setup()
+    step = make_train_step(model, opt)
+    _, m = step(state, jax.random.PRNGKey(1), images, labels)
+    assert float(m["skipped"]) == 0.0
+
+
+# ---------------- distributed skip-and-rescale ----------------
+
+
+def _per_shard_grads(model, params, images, labels, n_shards):
+    """Oracle: each replica's raw gradient, computed outside the SPMD step."""
+    from atomo_tpu.training.trainer import cross_entropy_loss
+
+    def loss_fn(p, im, lb):
+        return cross_entropy_loss(model.apply({"params": p}, im), lb)
+
+    per = len(images) // n_shards
+    return [
+        jax.grad(loss_fn)(params, images[i * per:(i + 1) * per],
+                          labels[i * per:(i + 1) * per])
+        for i in range(n_shards)
+    ]
+
+
+def test_distributed_psum_skip_and_rescale_exact():
+    """Replica 0's NaN contribution is dropped; the update must equal
+    params - lr * mean(g1, g2, g3) exactly (surviving average re-scaled by
+    n/kept = 4/3 of the masked sum/4... i.e. sum(g1..g3)/3)."""
+    lr = 0.1
+    model, opt, state0, images, labels = _lenet_setup(lr)
+    # host snapshot first: the step donates its state input, and the
+    # replicated copy may alias these buffers
+    params_host = jax.device_get(state0.params)
+    mesh = make_mesh(4)
+    state = replicate_state(mesh, state0)
+    chaos = ChaosInjector(ChaosConfig.from_spec("nan@1"))
+    step = make_distributed_train_step(
+        model, opt, mesh, codec=None, aggregate="psum",
+        guard=GuardConfig(), chaos=chaos,
+    )
+    gi, gl = shard_batch(mesh, images, labels)
+    state1, m = step(state, jax.random.PRNGKey(1), gi, gl)
+    assert float(m["dropped"]) == 1.0
+    assert float(m["skipped"]) == 0.0
+    assert np.isfinite(float(m["loss"]))
+
+    g = _per_shard_grads(model, params_host, images, labels, 4)
+    mean_surv = jax.tree_util.tree_map(
+        lambda a, b, c: (a + b + c) / 3.0, g[1], g[2], g[3]
+    )
+    expected = jax.tree_util.tree_map(
+        lambda p, m_: p - lr * m_, params_host, mean_surv
+    )
+    for got, want in zip(_leaves(state1.params), _leaves(expected)):
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+def test_distributed_gather_guard_rescales_and_stays_finite():
+    model, opt, state0, images, labels = _lenet_setup()
+    mesh = make_mesh(4)
+    state_host = jax.device_get(state0)  # donation-proof template
+    chaos = ChaosInjector(ChaosConfig.from_spec("inf@1"))
+
+    def run():
+        step = make_distributed_train_step(
+            model, opt, mesh, codec=SvdCodec(rank=2), aggregate="gather",
+            guard=GuardConfig(), chaos=chaos,
+        )
+        gi, gl = shard_batch(mesh, images, labels)
+        return step(replicate_state(mesh, state_host), jax.random.PRNGKey(1), gi, gl)
+
+    s1, m1 = run()
+    assert float(m1["dropped"]) == 1.0 and float(m1["skipped"]) == 0.0
+    for leaf in _leaves(s1.params):
+        assert np.isfinite(leaf).all()
+    # the surviving replicas DID move the params
+    assert any(
+        not np.array_equal(a, b)
+        for a, b in zip(_leaves(s1.params), _leaves(state_host.params))
+    )
+    # deterministic: the chaos plan and codec keys are reproducible
+    s2, m2 = run()
+    for a, b in zip(_leaves(s1.params), _leaves(s2.params)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_distributed_all_replicas_bad_skips_step():
+    model, opt, state0, images, labels = _lenet_setup()
+    params_host = jax.device_get(state0.params)
+    mesh = make_mesh(4)
+    state = replicate_state(mesh, state0)
+    chaos = ChaosInjector(ChaosConfig.from_spec("nan@1*"))  # every replica
+    step = make_distributed_train_step(
+        model, opt, mesh, codec=SvdCodec(rank=2), aggregate="gather",
+        guard=GuardConfig(), chaos=chaos,
+    )
+    gi, gl = shard_batch(mesh, images, labels)
+    s1, m = step(state, jax.random.PRNGKey(1), gi, gl)
+    assert float(m["skipped"]) == 1.0
+    assert float(m["dropped"]) == 4.0
+    assert int(s1.step) == 1  # counter advances; weights do not
+    for got, want in zip(
+        _leaves(s1.params), [np.asarray(l) for l in jax.tree_util.tree_leaves(params_host)]
+    ):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_hierarchical_guard_drops_poisoned_inner_group():
+    model, opt, state0, images, labels = _lenet_setup()
+    mesh = make_mesh(4, axes=(("dp", 2), ("ici", 2)))
+    state = replicate_state(mesh, state0)
+    chaos = ChaosInjector(ChaosConfig.from_spec("nan@1"))  # chip 0 -> group 0
+    step = make_distributed_train_step(
+        model, opt, mesh, codec=SvdCodec(rank=2), aggregate="hierarchical",
+        inner_axis="ici", guard=GuardConfig(), chaos=chaos,
+    )
+    gi, gl = shard_batch(mesh, images, labels, axis=("dp", "ici"))
+    s1, m = step(state, jax.random.PRNGKey(1), gi, gl)
+    # the unit of drop is the inner group (its dense pmean is poisoned)
+    assert float(m["dropped"]) == 1.0
+    assert float(m["skipped"]) == 0.0
+    for leaf in _leaves(s1.params):
+        assert np.isfinite(leaf).all()
