@@ -153,6 +153,18 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                    help="fault-injection spec for drills, e.g. "
                         "'nan@3,kill@6,truncate@4' (see utils/chaos.py); "
                         "defaults to the ATOMO_CHAOS env var")
+    t.add_argument("--superstep", type=int, default=0, metavar="K",
+                   help="fuse K optimizer steps into ONE device dispatch "
+                        "(lax.scan) with device-resident (K, batch, ...) "
+                        "data blocks and one metric fetch per block — "
+                        "amortizes host dispatch, the dominant per-step "
+                        "cost on tunneled backends (README 'Performance'). "
+                        "Log/eval/checkpoint cadence, watchdog beats and "
+                        "chaos kill/sleep snap to block boundaries; "
+                        "trajectories are bit-identical across K (resume "
+                        "works at any step, boundary or not). 0 (default) "
+                        "= auto: 8 on TPU, 1 elsewhere; 1 = the per-step "
+                        "loop exactly as before")
     t.add_argument("--phase-metrics", action="store_true", default=False,
                    help="split the step into separately-jitted phases and "
                         "log real Comp/Encode/Comm (+ master Gather/Decode) "
@@ -404,6 +416,24 @@ def cmd_train(args: argparse.Namespace) -> int:
         chaos = ChaosInjector(ChaosConfig.from_spec(args.chaos))
     # (no --chaos: the train loops read ATOMO_CHAOS from the env)
 
+    superstep = args.superstep
+    if superstep < 0:
+        raise SystemExit(
+            f"--superstep {superstep}: must be >= 1 (or 0 for the "
+            "per-backend auto default)"
+        )
+    if superstep == 0:
+        # backend default: dispatch overhead is what superstepping buys
+        # back — material on tunneled TPU backends (~ms per dispatch),
+        # noise on the local CPU backend, so K=1 preserves exact legacy
+        # behavior where the win is absent
+        superstep = 8 if jax.default_backend() == "tpu" else 1
+    if superstep > 1 and args.phase_metrics:
+        warnings.warn(
+            "--phase-metrics times individual phase programs and cannot "
+            "run under a fused superstep scan; forcing --superstep 1"
+        )
+        superstep = 1
     n_dev = args.n_devices or len(jax.devices())
     if n_dev > 1:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
@@ -483,6 +513,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             lr_fn=stepwise_shrink(args.lr, args.lr_shrinkage, args.shrinkage_freq),
             profile_dir=args.profile_dir or None,
             compute_dtype=jnp.bfloat16 if args.bf16 else None,
+            superstep=superstep,
         )
     else:
         from atomo_tpu.training import train_loop
@@ -511,7 +542,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             compress_ckpt=args.compress, log_every=args.log_interval,
             compute_dtype=jnp.bfloat16 if args.bf16 else None,
             guard=guard, chaos=chaos, health_timeout=args.health_timeout,
-            keep_ckpts=args.keep_ckpts,
+            keep_ckpts=args.keep_ckpts, superstep=superstep,
         )
     return 0
 

@@ -105,3 +105,53 @@ class BatchIterator:
                     skip -= 1
                     continue
                 yield self.images[sel], self.labels[sel]
+
+
+class BlockStream:
+    """Stack consecutive batches of an endless stream into ``(K, batch,
+    ...)`` superstep blocks.
+
+    The batch sequence is exactly the underlying stream's — step t of a
+    K-block is the same array a per-step loop would have fed at step t —
+    so superstep runs replay (and resume) bit-identically against K=1
+    runs. ``take(k)`` accepts a different ``k`` each call: the train loops
+    shrink the final block to ``max_steps`` instead of overrunning it.
+    """
+
+    def __init__(self, stream: Iterator[tuple[np.ndarray, np.ndarray]]):
+        self._stream = stream
+
+    def take(self, k: int) -> tuple[np.ndarray, np.ndarray]:
+        pairs = [next(self._stream) for _ in range(k)]
+        return (
+            np.stack([p[0] for p in pairs]),
+            np.stack([p[1] for p in pairs]),
+        )
+
+
+class SuperstepFeed:
+    """One-block device lookahead over a :class:`BlockStream`.
+
+    ``start(k)`` stacks the next k batches and hands them to ``put_fn``
+    (``jax.device_put`` / ``shard_superbatch``) immediately; jax transfers
+    are asynchronous, so when the train loop calls ``start`` right after
+    dispatching a superstep, the NEXT block's host->device copy overlaps
+    the current block's compute — the double-buffering half of the
+    superstep design (the other half is the fused scan itself). ``take()``
+    returns the block ``start`` staged, as ``(k, device_images,
+    device_labels)``."""
+
+    def __init__(self, blocks: BlockStream, put_fn):
+        self._blocks = blocks
+        self._put = put_fn
+        self._staged = None
+
+    def start(self, k: int) -> None:
+        if k > 0:
+            im, lb = self._blocks.take(k)
+            dev_im, dev_lb = self._put(im, lb)
+            self._staged = (k, dev_im, dev_lb)
+
+    def take(self):
+        staged, self._staged = self._staged, None
+        return staged

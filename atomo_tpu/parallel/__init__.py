@@ -18,6 +18,7 @@ from atomo_tpu.parallel.replicated import (  # noqa: F401
     make_phase_train_steps,
     replicate_state,
     shard_batch,
+    shard_superbatch,
 )
 from atomo_tpu.parallel.tp import (  # noqa: F401
     create_tp_lm_state,

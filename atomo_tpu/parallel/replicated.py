@@ -49,7 +49,7 @@ from atomo_tpu.codecs import (
     tree_nbytes,
 )
 from atomo_tpu.data.pipeline import augment_batch
-from atomo_tpu.parallel.mesh import batch_sharded, replicated
+from atomo_tpu.parallel.mesh import replicated
 from atomo_tpu.training.resilience import (
     grad_ok,
     masked_mean,
@@ -137,8 +137,28 @@ def make_distributed_train_step(
     inner_axis: Optional[str] = None,
     guard=None,
     chaos=None,
+    superstep: int = 1,
 ):
     """Build the jitted SPMD train step over ``mesh``.
+
+    DONATION: the returned step donates its state argument (argnum 0) —
+    after the call the caller's reference points at deleted buffers, and
+    on jax 0.4.37 ``replicate_state``/``jax.device_put`` may ALIAS their
+    source, so even the host tree the state was built from can be
+    poisoned. Code that needs pre-step values must copy them out with
+    ``training.trainer.snapshot_state`` (a forced ``jax.device_get`` deep
+    copy) BEFORE stepping.
+
+    ``superstep`` > 1 builds the fused variant: K full optimizer steps —
+    encode/aggregate/decode, guard skip-and-rescale, ZeRO-1 slice update,
+    all of it — under one ``lax.scan`` inside the shard_map, amortizing
+    host dispatch over K. Feed ``images``/``labels`` with a leading (K,)
+    in-block axis (dim 1 sharded over the batch axes — use
+    :func:`shard_superbatch`); metrics come back as per-step (K,) series.
+    Per-step RNG folds from the carried ``state.step``, so results are
+    bit-identical for ANY block partition of the same step sequence
+    (tested: tests/test_superstep.py); the guard's skip/rescale decisions
+    ride the scan carry exactly as they would the host loop.
 
     ``guard`` (training.resilience.GuardConfig) arms per-replica anomaly
     screening with the skip-and-rescale policy: each replica screens its
@@ -204,6 +224,8 @@ def make_distributed_train_step(
     """
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if superstep < 1:
+        raise ValueError(f"superstep must be >= 1, got {superstep}")
     n_dev = mesh.shape[axis]
     hierarchical = aggregate == "hierarchical"
     if hierarchical:
@@ -486,10 +508,25 @@ def make_distributed_train_step(
             step=P(), params=P(), batch_stats=P(), opt_state=zero1_specs
         )
     )
+    if superstep > 1:
+        # fused block variant: scan the per-step SPMD body INSIDE the
+        # shard_map, so the K steps (collectives included) compile into
+        # one XLA program and the host dispatches once per block. The
+        # data block's leading (K,) axis is unsharded; dim 1 is the batch.
+        def spmd_fn(state: TrainState, key, images, labels):
+            def body(st, xs):
+                return spmd_step(st, key, xs[0], xs[1])
+
+            return jax.lax.scan(body, state, (images, labels))
+
+        data_spec = P(None, batch_axes)
+    else:
+        spmd_fn = spmd_step
+        data_spec = P(batch_axes)
     sharded = jax.shard_map(
-        spmd_step,
+        spmd_fn,
         mesh=mesh,
-        in_specs=(state_spec, P(), P(batch_axes), P(batch_axes)),
+        in_specs=(state_spec, P(), data_spec, data_spec),
         out_specs=(state_spec, P()),
         # decoded-mean of identically gathered payloads is replicated by
         # construction; the vma tracker cannot see that through all_gather,
@@ -667,6 +704,7 @@ def distributed_train_loop(
     chaos=None,
     on_health_failure=None,
     keep_ckpts: int = 0,
+    superstep: int = 1,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -689,7 +727,15 @@ def distributed_train_loop(
     ``profile_dir`` captures a jax.profiler device trace (TensorBoard /
     XProf loadable) around ``profile_steps`` steady-state steps — the
     honest way to see encode/decode cost INSIDE the fused program, where
-    host-side spans cannot reach (utils/tracing rationale)."""
+    host-side spans cannot reach (utils/tracing rationale).
+
+    ``superstep`` > 1 runs fused K-step blocks (one dispatch, one metric
+    fetch, data double-buffered onto the device per block — see
+    training.train_loop's superstep notes; identical boundary-snapped
+    cadence for log/eval/checkpoint/watchdog/chaos). Incompatible with
+    ``phase_metrics`` (whose whole point is host-visible phase
+    boundaries). ``profile_dir`` profiles the second block instead of
+    ``profile_steps`` individual steps."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import heartbeat_watchdog, resolve_chaos
     from atomo_tpu.training.trainer import create_state
@@ -783,9 +829,17 @@ def distributed_train_loop(
                 # rather than dying inside an elastic-restart loop
                 log_fn(f"Resume requested but {exc}; starting fresh")
         state = replicate_state(mesh, state)
+    if superstep < 1:
+        raise ValueError(f"superstep must be >= 1, got {superstep}")
     if phase_metrics:
         import warnings
 
+        if superstep > 1:
+            raise ValueError(
+                "--phase-metrics times individual phase programs and cannot "
+                "run under a fused superstep scan; drop --phase-metrics or "
+                "use --superstep 1"
+            )
         if guard is not None or chaos is not None:
             raise ValueError(
                 "--phase-metrics is an observability mode without the "
@@ -822,6 +876,7 @@ def distributed_train_loop(
             num_aggregate=num_aggregate, compute_dtype=compute_dtype,
             zero1_specs=zero1_specs, grad_accum=grad_accum,
             inner_axis=inner_axis, guard=guard, chaos=chaos,
+            superstep=superstep,
         )
     batch_axes = ("dp", inner_axis) if aggregate == "hierarchical" else "dp"
     eval_fn = (
@@ -836,14 +891,28 @@ def distributed_train_loop(
     # per skipped epoch, no data copies, nothing for the watchdog to see)
     stream = train_iter.forever(skip=start_step)
     n_train = len(train_iter.dataset)
-    with heartbeat_watchdog(health_timeout, on_health_failure) as monitor:
-        state = _distributed_steps(
-            state, step_fn, eval_fn, stream, train_iter, test_iter, mesh,
-            key, timer, n_train, start_step, max_steps, log_every, log_fn,
-            eval_freq, save_freq, train_dir, compress_ckpt, monitor, lr_fn,
-            profile_dir, profile_steps, batch_axes,
-            guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
-        )
+    # superstep mode beats the watchdog once per BLOCK: scale the budget
+    # by K so a per-step-tuned --health-timeout does not falsely fire
+    with heartbeat_watchdog(
+        health_timeout * superstep if superstep > 1 else health_timeout,
+        on_health_failure,
+    ) as monitor:
+        if superstep > 1:
+            state = _distributed_superstep_steps(
+                state, step_fn, eval_fn, stream, train_iter, test_iter,
+                mesh, key, timer, n_train, start_step, max_steps, superstep,
+                log_every, log_fn, eval_freq, save_freq, train_dir,
+                compress_ckpt, monitor, profile_dir, batch_axes,
+                guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
+            )
+        else:
+            state = _distributed_steps(
+                state, step_fn, eval_fn, stream, train_iter, test_iter, mesh,
+                key, timer, n_train, start_step, max_steps, log_every, log_fn,
+                eval_freq, save_freq, train_dir, compress_ckpt, monitor, lr_fn,
+                profile_dir, profile_steps, batch_axes,
+                guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
+            )
     return state
 
 
@@ -985,44 +1054,9 @@ def _distributed_steps(
                     )
                 )
         if eval_freq and eval_fn is not None and step % eval_freq == 0:
-            # trim divisor = product of the axes the batch actually shards
-            # over (hierarchical mode shards eval over BOTH data axes —
-            # trimming by the outer axis alone would crash shard_batch)
-            if isinstance(batch_axes, (tuple, list)):
-                n_dev = 1
-                for a in batch_axes:
-                    n_dev *= mesh.shape[a]
-            else:
-                n_dev = mesh.shape[batch_axes]
-            totals = {"loss": 0.0, "prec1": 0.0, "prec5": 0.0}
-            n = 0
-            dropped = 0
-            for ti, tl in test_iter.epoch():
-                # trim a trailing partial batch to a mesh multiple; metrics
-                # stay exact over the samples actually evaluated and the
-                # drop is reported (a silent drop changes the metric
-                # denominator for batch sizes not divisible by the mesh)
-                trim = (ti.shape[0] // n_dev) * n_dev
-                dropped += ti.shape[0] - trim
-                if trim == 0:
-                    continue
-                sti, stl = shard_batch(mesh, ti[:trim], tl[:trim], axis=batch_axes)
-                m = eval_fn(state.params, state.batch_stats, sti, stl)
-                for k_ in totals:
-                    totals[k_] += float(m[k_]) * trim
-                n += trim
-            log_fn(
-                "Validation: Step: {}, Loss: {:.4f}, Prec@1: {:.4f}, Prec@5: {:.4f}".format(
-                    step, totals["loss"] / max(n, 1), totals["prec1"] / max(n, 1),
-                    totals["prec5"] / max(n, 1),
-                )
+            _distributed_eval(
+                eval_fn, state, test_iter, mesh, batch_axes, step, log_fn
             )
-            if dropped:
-                log_fn(
-                    f"Validation: dropped {dropped} tail samples not divisible "
-                    f"by the {n_dev}-device mesh (evaluated {n}); pick a "
-                    "--test-batch-size that is a mesh multiple for exact totals"
-                )
         if save_freq and train_dir and step % save_freq == 0:
             path = save_fn(
                 train_dir, jax.device_get(state), step,
@@ -1046,17 +1080,157 @@ def _distributed_steps(
     return state
 
 
-def shard_batch(mesh: Mesh, images, labels, axis="dp"):
-    """Shard the batch dim over ``axis`` — a mesh axis name, or a tuple of
-    names for 2-axis data parallelism (hierarchical aggregation)."""
+def _distributed_eval(eval_fn, state, test_iter, mesh, batch_axes, step, log_fn):
+    """Full-test-set validation at ``step`` — shared by the per-step and
+    superstep loops so trim/report semantics cannot drift."""
+    # trim divisor = product of the axes the batch actually shards
+    # over (hierarchical mode shards eval over BOTH data axes —
+    # trimming by the outer axis alone would crash shard_batch)
+    if isinstance(batch_axes, (tuple, list)):
+        n_dev = 1
+        for a in batch_axes:
+            n_dev *= mesh.shape[a]
+    else:
+        n_dev = mesh.shape[batch_axes]
+    totals = {"loss": 0.0, "prec1": 0.0, "prec5": 0.0}
+    n = 0
+    dropped = 0
+    for ti, tl in test_iter.epoch():
+        # trim a trailing partial batch to a mesh multiple; metrics
+        # stay exact over the samples actually evaluated and the
+        # drop is reported (a silent drop changes the metric
+        # denominator for batch sizes not divisible by the mesh)
+        trim = (ti.shape[0] // n_dev) * n_dev
+        dropped += ti.shape[0] - trim
+        if trim == 0:
+            continue
+        sti, stl = shard_batch(mesh, ti[:trim], tl[:trim], axis=batch_axes)
+        m = eval_fn(state.params, state.batch_stats, sti, stl)
+        for k_ in totals:
+            totals[k_] += float(m[k_]) * trim
+        n += trim
+    log_fn(
+        "Validation: Step: {}, Loss: {:.4f}, Prec@1: {:.4f}, Prec@5: {:.4f}".format(
+            step, totals["loss"] / max(n, 1), totals["prec1"] / max(n, 1),
+            totals["prec5"] / max(n, 1),
+        )
+    )
+    if dropped:
+        log_fn(
+            f"Validation: dropped {dropped} tail samples not divisible "
+            f"by the {n_dev}-device mesh (evaluated {n}); pick a "
+            "--test-batch-size that is a mesh multiple for exact totals"
+        )
+
+
+def _distributed_superstep_steps(
+    state, step_fn, eval_fn, stream, train_iter, test_iter, mesh, key,
+    timer, n_train, start_step, max_steps, superstep, log_every, log_fn,
+    eval_freq, save_freq, train_dir, compress_ckpt, monitor,
+    profile_dir=None, batch_axes="dp", guard=None, chaos=None, keep_ckpts=0,
+):
+    """distributed_train_loop's fused block path: one SPMD dispatch per K
+    steps, one metric fetch per block, next block's shard_superbatch
+    transfer double-buffered behind the running block. Cadence semantics
+    match training.trainer._superstep_steps (boundary-snapped)."""
+    import numpy as np
+
+    from atomo_tpu.data.pipeline import BlockStream, SuperstepFeed
+    from atomo_tpu.training.resilience import retrying_saver
+    from atomo_tpu.training.trainer import (
+        _block_log_record,
+        _chaos_corrupt_range,
+        _crossed,
+    )
+    from atomo_tpu.utils.tracing import profile
+
+    save_fn = retrying_saver(log_fn)
+    feed = SuperstepFeed(
+        BlockStream(stream),
+        lambda im, lb: shard_superbatch(mesh, im, lb, axis=batch_axes),
+    )
+    s = start_step
+    last_saved = start_step
+    last_logged = start_step
+    block_idx = 0
+    prof_ctx = None
+    feed.start(min(superstep, max_steps - s))
+    while s < max_steps:
+        kb, dev_im, dev_lb = feed.take()
+        b0, s = s, s + kb
+        block_idx += 1
+        if chaos is not None:
+            # host faults resolve at the block boundary (the block is one
+            # dispatch; a kill aimed inside it fires before it runs)
+            for t in range(b0 + 1, s + 1):
+                chaos.maybe_die(t)
+                chaos.maybe_sleep(t)
+        if profile_dir and block_idx == 2 and prof_ctx is None:
+            # block 1 is dominated by compilation; trace the second block
+            prof_ctx = profile(profile_dir)
+            prof_ctx.__enter__()
+            log_fn(f"Profiling superstep block {b0 + 1}..{s} -> {profile_dir}")
+        state, mblk = step_fn(state, key, dev_im, dev_lb)
+        feed.start(min(superstep, max_steps - s))  # overlap next transfer
+        m = jax.device_get(mblk)  # the block's ONE host sync
+        if prof_ctx is not None:
+            prof_ctx.__exit__(None, None, None)
+            prof_ctx = None
+        if monitor is not None:
+            monitor.beat(s)
+        if guard is not None and _crossed(log_every, b0, s):
+            n_drop = float(np.sum(m.get("dropped", 0.0)))
+            if n_drop > 0:
+                n_skip = float(np.sum(m.get("skipped", 0.0)))
+                action = "skip" if n_skip > 0 else "rescale"
+                log_fn(
+                    f"Guard: Step: {s}, Dropped: {int(n_drop)}, Action: "
+                    f"{action} (anomalous contributions masked inside the "
+                    "superstep)"
+                )
+        if _crossed(log_every, b0, s):
+            rec = _block_log_record(
+                s, m, train_iter, n_train, timer.lap(), last_logged
+            )
+            last_logged = s
+            log_fn(rec.worker_line())
+        if eval_freq and eval_fn is not None and _crossed(eval_freq, b0, s):
+            _distributed_eval(
+                eval_fn, state, test_iter, mesh, batch_axes, s, log_fn
+            )
+        if save_freq and train_dir and _crossed(save_freq, b0, s):
+            path = save_fn(
+                train_dir, jax.device_get(state), s,
+                compress=compress_ckpt, keep=keep_ckpts,
+            )
+            last_saved = s
+            # ckpt faults snap like kill/sleep: a fault aimed anywhere in
+            # this block corrupts the boundary file
+            _chaos_corrupt_range(chaos, path, b0, s)
+    # autosave the final state (same strictly-< contract as the K=1 loop)
+    if save_freq and train_dir and last_saved < max_steps:
+        path = save_fn(
+            train_dir, jax.device_get(state), max_steps,
+            compress=compress_ckpt, keep=keep_ckpts,
+        )
+        _chaos_corrupt_range(chaos, path, last_saved, max_steps)
+    return state
+
+
+def _shard_batch_impl(mesh: Mesh, images, labels, axis, batch_dim: int):
+    """Shared body of :func:`shard_batch` (batch_dim 0) and
+    :func:`shard_superbatch` (batch_dim 1, leading (K,) step axis
+    unsharded) — ONE copy of the sharding construction, the multi-host
+    local-shard assembly, and the divisibility contract."""
+    lead = (None,) * batch_dim
     if isinstance(axis, (tuple, list)):
         n_dev = 1
         for a in axis:
             n_dev *= mesh.shape[a]
-        sh = NamedSharding(mesh, P(tuple(axis)))
+        sh = NamedSharding(mesh, P(*lead, tuple(axis)))
     else:
         n_dev = mesh.shape[axis]
-        sh = batch_sharded(mesh, axis)
+        sh = NamedSharding(mesh, P(*lead, axis))
     if jax.process_count() > 1:
         # Multi-host SPMD: each process feeds its *local* shard (its own
         # independently shuffled batch slice — the reference's workers also
@@ -1068,16 +1242,16 @@ def shard_batch(mesh: Mesh, images, labels, axis="dp"):
         n_local = sum(
             1 for d in mesh.devices.flat if d.process_index == jax.process_index()
         )
-        if n_local == 0 or local_im.shape[0] % n_local != 0:
+        if n_local == 0 or local_im.shape[batch_dim] % n_local != 0:
             raise ValueError(
-                f"local batch {local_im.shape[0]} is not divisible by this "
-                f"process's {n_local} mesh devices"
+                f"local batch {local_im.shape[batch_dim]} is not divisible "
+                f"by this process's {n_local} mesh devices"
             )
         return (
             jax.make_array_from_process_local_data(sh, local_im),
             jax.make_array_from_process_local_data(sh, local_lb),
         )
-    bs = images.shape[0]
+    bs = images.shape[batch_dim]
     if bs % n_dev != 0:
         raise ValueError(
             f"batch size {bs} is not divisible by the {n_dev}-device "
@@ -1087,6 +1261,22 @@ def shard_batch(mesh: Mesh, images, labels, axis="dp"):
     return jax.device_put(jnp.asarray(images), sh), jax.device_put(
         jnp.asarray(labels), sh
     )
+
+
+def shard_batch(mesh: Mesh, images, labels, axis="dp"):
+    """Shard the batch dim over ``axis`` — a mesh axis name, or a tuple of
+    names for 2-axis data parallelism (hierarchical aggregation)."""
+    return _shard_batch_impl(mesh, images, labels, axis, batch_dim=0)
+
+
+def shard_superbatch(mesh: Mesh, images, labels, axis="dp"):
+    """:func:`shard_batch` for a superstep block: ``images``/``labels``
+    carry a leading ``(K, batch, ...)`` in-block step axis. Dim 0 (the
+    step index) stays unsharded — every chip holds its slice of all K
+    steps — and dim 1 shards over ``axis`` exactly as shard_batch shards
+    dim 0. ``jax.device_put`` transfers asynchronously, so staging the
+    next block behind a running superstep overlaps copy with compute."""
+    return _shard_batch_impl(mesh, images, labels, axis, batch_dim=1)
 
 
 def replicate_state(mesh: Mesh, state: TrainState) -> TrainState:

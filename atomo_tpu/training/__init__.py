@@ -25,5 +25,6 @@ from atomo_tpu.training.trainer import (  # noqa: F401
     evaluate,
     make_eval_step,
     make_train_step,
+    snapshot_state,
     train_loop,
 )

@@ -89,8 +89,29 @@ def create_state(model, optimizer, rng, sample_input) -> TrainState:
     )
 
 
+def snapshot_state(state) -> "TrainState":
+    """Host-side deep copy of a TrainState — the donation-aliasing guard.
+
+    ``make_train_step(..., superstep=K)`` and
+    ``make_distributed_train_step`` DONATE their state argument: after the
+    call, the caller's reference points at deleted (or reused) device
+    buffers. Worse, on jax 0.4.37 ``replicate_state``/``jax.device_put``
+    can ALIAS the source buffers instead of copying, so even a
+    "different" pre-step reference may share memory with the donated one.
+    Tests (and any debug code) that need pre-step values must snapshot
+    through ``jax.device_get`` BEFORE stepping — this helper additionally
+    forces a real copy of every leaf, because on the CPU backend
+    device_get itself can return views of the live buffers."""
+    import numpy as np
+
+    return jax.tree_util.tree_map(
+        lambda a: np.array(a, copy=True), jax.device_get(state)
+    )
+
+
 def make_train_step(model, optimizer, codec=None, augment: bool = False,
-                    compute_dtype=None, guard=None, chaos=None):
+                    compute_dtype=None, guard=None, chaos=None,
+                    superstep: int = 1):
     """Build the jitted single-host train step.
 
     codec != None applies encode->decode to the gradient pytree in-graph
@@ -113,8 +134,32 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
 
     chaos (utils.chaos.ChaosInjector) bakes the configured gradient faults
     into the compiled step — test/validation hook, zero-cost when None.
+
+    superstep > 1 returns the FUSED variant: one jitted program that runs
+    ``superstep`` full optimizer steps under a single ``lax.scan``
+    (amortizing host dispatch, the dominant per-step cost on tunneled
+    backends — see README "Performance"). Call it with ``images``/
+    ``labels`` carrying a leading (K,) in-block step axis; it returns
+    ``(state, metrics)`` where every metrics leaf is the per-step series
+    stacked to shape (K,). Per-step RNG folding is unchanged (keys fold
+    from the in-carry ``state.step``), so K fused steps are bit-identical
+    to K sequential K=1 steps on the same data; the guard's skip logic
+    lives in the scan carry, so an anomalous step inside the block holds
+    state exactly as the sequential path would. DONATION: the fused
+    variant donates the state argument — the caller's reference is
+    invalidated by the call; snapshot via :func:`snapshot_state` first if
+    pre-step values are needed (jax 0.4.37 device_put aliasing makes any
+    shallower copy unsafe). Compile cost: the scan length is baked into
+    the compiled program, so a run sees at most TWO compiles of this
+    variant — the K-block shape plus one shorter tail block when
+    (max_steps - start) % K != 0; padding the tail to K was rejected as
+    it would complicate the resume-replay data contract for a one-off
+    cost.
     """
     from atomo_tpu.training.resilience import grad_ok, select_state, zero_if
+
+    if superstep < 1:
+        raise ValueError(f"superstep must be >= 1, got {superstep}")
 
     def loss_fn(params, batch_stats, images, labels, dropout_key):
         if compute_dtype is not None:
@@ -137,8 +182,7 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
         loss = cross_entropy_loss(logits, labels)
         return loss, (logits, new_stats)
 
-    @jax.jit
-    def train_step(state: TrainState, key: jax.Array, images, labels):
+    def step_core(state: TrainState, key: jax.Array, images, labels):
         k_aug, k_drop, k_codec = jax.random.split(jax.random.fold_in(key, state.step), 3)
         if augment:
             images = augment_batch(k_aug, images)
@@ -187,7 +231,19 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
             metrics,
         )
 
-    return train_step
+    if superstep == 1:
+        return jax.jit(step_core)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def train_superstep(state: TrainState, key: jax.Array, images, labels):
+        # per-step keys fold from the in-carry state.step, so the scan body
+        # IS the sequential step — the fusion only removes dispatches
+        def body(st, xs):
+            return step_core(st, key, xs[0], xs[1])
+
+        return jax.lax.scan(body, state, (images, labels))
+
+    return train_superstep
 
 
 def make_eval_step(model):
@@ -241,6 +297,7 @@ def train_loop(
     health_timeout: float = 0.0,
     on_health_failure=None,
     keep_ckpts: int = 0,
+    superstep: int = 1,
 ) -> TrainState:
     """The reference train_and_validate loop (nn_ops.py:123-169), jitted,
     plus working checkpoint/resume (gap §5.4) and the fault-tolerance
@@ -254,7 +311,21 @@ def train_loop(
     kill→restart→resume run replays the exact batch sequence of an
     uninterrupted one (host-side numpy indexing — cheap relative to a
     step). ``chaos`` defaults to the ATOMO_CHAOS env config so subprocess
-    harnesses inject faults without plumbing."""
+    harnesses inject faults without plumbing.
+
+    ``superstep`` > 1 switches to fused block execution: K optimizer steps
+    per dispatch under one ``lax.scan`` (make_train_step's fused variant),
+    data fed as device-resident (K, batch, ...) blocks with the next
+    block's transfer double-buffered behind the current block's compute,
+    and metrics fetched ONCE per block. Host-side cadence — log lines,
+    eval, checkpoints, watchdog beats, chaos kill/sleep — is evaluated at
+    superstep boundaries: a cadence point crossed inside a block fires at
+    the block's final step (checkpoint steps snap to boundaries).
+    Trajectories are bit-identical to K=1 (per-step RNG folds from the
+    carried step counter; the data stream is index-determined), including
+    across kill→restart→resume at a step that is not a multiple of K —
+    the resumed run simply starts a fresh block at checkpoint_step+1.
+    K=1 preserves the original per-step loop exactly."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import (
         heartbeat_watchdog,
@@ -280,6 +351,7 @@ def train_loop(
     step_fn = make_train_step(
         model, optimizer, codec=codec, augment=augment,
         compute_dtype=compute_dtype, guard=guard, chaos=chaos,
+        superstep=superstep,
     )
     save_fn = retrying_saver(log_fn)
     key = jax.random.PRNGKey(seed + 1)
@@ -289,6 +361,20 @@ def train_loop(
     stream = train_iter.forever(skip=start_step)
     n_train = len(train_iter.dataset)
     last_saved = start_step
+    if superstep > 1:
+        # the watchdog beats once per BLOCK: scale its budget by K so a
+        # --health-timeout tuned for per-step beats does not falsely fire
+        # on a healthy fused run (K steps + one metric fetch per beat)
+        with heartbeat_watchdog(
+            health_timeout * superstep, on_health_failure
+        ) as monitor:
+            return _superstep_steps(
+                state, step_fn, model, stream, train_iter, test_iter, key,
+                timer, n_train, start_step, max_steps, superstep, log_every,
+                log_fn, eval_freq, save_freq, train_dir, compress_ckpt,
+                save_fn, monitor, guard=guard, chaos=chaos,
+                keep_ckpts=keep_ckpts,
+            )
     with heartbeat_watchdog(health_timeout, on_health_failure) as monitor:
         for step in range(start_step + 1, max_steps + 1):
             if chaos is not None:
@@ -350,4 +436,123 @@ def train_loop(
             )
             if chaos is not None:  # ckpt faults target autosaves too
                 chaos.maybe_corrupt_checkpoint(path, max_steps)
+    return state
+
+
+def _crossed(cadence: int, lo: int, hi: int) -> bool:
+    """True iff a multiple of ``cadence`` lies in (lo, hi] — the boundary
+    test that snaps every per-step cadence (log/eval/save) to superstep
+    boundaries: the event fires at ``hi``, the block's final step."""
+    return bool(cadence) and hi // cadence > lo // cadence
+
+
+def _chaos_corrupt_range(chaos, path, lo: int, hi: int) -> None:
+    """Apply chaos checkpoint faults aimed at ANY step in (lo, hi] to the
+    boundary checkpoint written at ``hi`` — the same block-boundary snap
+    kill/sleep get (a ``truncate@3`` drill must still corrupt the file the
+    save cadence snapped to step 4)."""
+    if chaos is None:
+        return
+    for t in range(lo + 1, hi + 1):
+        chaos.maybe_corrupt_checkpoint(path, t)
+
+
+def _block_log_record(s, m, train_iter, n_train, lap, last_logged):
+    """Worker-line record for a superstep block boundary: loss/precision
+    are PER-STEP AVERAGES over the block (msg_bytes is a per-step
+    constant), time_cost the per-step average of the span since the last
+    log. Shared by the single-host and distributed block loops so the log
+    format cannot drift between them."""
+    import numpy as np
+
+    return StepMetrics(
+        rank=0,
+        step=s,
+        epoch=s * train_iter.batch_size // max(n_train, 1),
+        samples_seen=(s * train_iter.batch_size) % max(n_train, 1),
+        dataset_size=n_train,
+        loss=float(np.mean(m["loss"])),
+        time_cost=lap / max(s - last_logged, 1),
+        msg_bytes=int(np.asarray(m["msg_bytes"]).reshape(-1)[-1]),
+        prec1=float(np.mean(m["prec1"])),
+        prec5=float(np.mean(m["prec5"])),
+    )
+
+
+def _superstep_steps(
+    state, step_fn, model, stream, train_iter, test_iter, key, timer,
+    n_train, start_step, max_steps, superstep, log_every, log_fn,
+    eval_freq, save_freq, train_dir, compress_ckpt, save_fn, monitor,
+    guard=None, chaos=None, keep_ckpts=0,
+):
+    """train_loop's fused block path: one dispatch per K steps, one metric
+    fetch per block (the fetch is also the fence the watchdog beats on),
+    next block double-buffered onto the device behind the current one."""
+    import numpy as np
+
+    from atomo_tpu.data.pipeline import BlockStream, SuperstepFeed
+
+    feed = SuperstepFeed(
+        BlockStream(stream),
+        lambda im, lb: (jax.device_put(jnp.asarray(im)),
+                        jax.device_put(jnp.asarray(lb))),
+    )
+    s = start_step
+    last_saved = start_step
+    last_logged = start_step
+    feed.start(min(superstep, max_steps - s))
+    while s < max_steps:
+        kb, dev_im, dev_lb = feed.take()
+        b0, s = s, s + kb
+        if chaos is not None:
+            # host faults resolve at the block boundary: the block is ONE
+            # dispatch, so a kill/sleep aimed at any step it covers fires
+            # before the block runs (none of its steps have executed yet
+            # — the checkpoint/resume contract is preserved)
+            for t in range(b0 + 1, s + 1):
+                chaos.maybe_die(t)
+                chaos.maybe_sleep(t)
+        state, mblk = step_fn(state, key, dev_im, dev_lb)
+        # enqueue the NEXT block's host->device transfer while the current
+        # superstep executes (async dispatch above returns immediately)
+        feed.start(min(superstep, max_steps - s))
+        m = jax.device_get(mblk)  # the block's ONE host sync
+        if monitor is not None:
+            monitor.beat(s)
+        n_skipped = float(np.sum(m["skipped"])) if guard is not None else 0.0
+        if guard is not None and _crossed(log_every, b0, s) and n_skipped > 0:
+            log_fn(
+                f"Guard: Step: {s}, Dropped: {int(n_skipped)}/{kb}, "
+                "Action: skip (anomalous gradient inside the superstep; "
+                "params/opt state held for those steps)"
+            )
+        if _crossed(log_every, b0, s):
+            rec = _block_log_record(
+                s, m, train_iter, n_train, timer.lap(), last_logged
+            )
+            last_logged = s
+            log_fn(rec.worker_line())
+        if eval_freq and test_iter is not None and _crossed(eval_freq, b0, s):
+            ev = evaluate(model, state, test_iter)
+            log_fn(
+                "Validation: Step: {}, Loss: {:.4f}, Prec@1: {:.4f}, Prec@5: {:.4f}".format(
+                    s, ev["loss"], ev["prec1"], ev["prec5"]
+                )
+            )
+        if save_freq and train_dir and _crossed(save_freq, b0, s):
+            path = save_fn(
+                train_dir, state, s, compress=compress_ckpt, keep=keep_ckpts
+            )
+            last_saved = s
+            # ckpt faults snap like kill/sleep: a fault aimed anywhere in
+            # this block corrupts the boundary file
+            _chaos_corrupt_range(chaos, path, b0, s)
+    # autosave the final state so a restart never replays the tail (same
+    # strictly-< contract as the per-step loop)
+    if save_freq and train_dir and last_saved < max_steps:
+        path = save_fn(
+            train_dir, state, max_steps, compress=compress_ckpt,
+            keep=keep_ckpts,
+        )
+        _chaos_corrupt_range(chaos, path, last_saved, max_steps)
     return state

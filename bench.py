@@ -26,10 +26,16 @@ steps, and N = 30 steps amortize the tunnel roundtrip. `measurement_valid`
 is emitted alongside: false (with `invalid_reason`) whenever the sync
 scalar is non-finite or a computed MFU falls outside (0, 1).
 
-By default ALL FIVE BASELINE.md ladder configs run: one JSON row per config
+By default the WHOLE ladder runs (the five BASELINE.md configs plus the LM
+config 6 and the shipped-loop superstep config 7): one JSON row per config
 as it completes, then ONE final aggregate line — the headline config-2 row
 with a "configs" list embedding every row (VERDICT r2 next-round #4; the
-driver parses the last line).
+driver parses the last line). The parent enforces a global wall-clock
+budget (ATOMO_BENCH_DEADLINE_S, default 840 s — under the driver's 870 s
+cap): child timeouts are clamped to the remaining budget and configs that
+cannot start emit an honest deadline row, so the final aggregate line is
+always complete (r05 hit rc=124 precisely because the fallback ladder had
+no global budget).
 
   {"metric": ..., "value": <ms/step>, "unit": "ms/step",
    "vs_baseline": <baseline_s / ours_s or null>,    # TIME ratio only
@@ -60,6 +66,8 @@ import time
 
 WARMUP = 3
 STEPS = 30  # enough steps between scalar fetches to amortize the tunnel RTT
+REPS = 3  # best-of-N timing repeats (shared-chip contention estimator);
+# fast mode drops to 1 via ATOMO_BENCH_REPS — precision is already gone there
 CHILD_TIMEOUT_S = 2400
 TPU_ATTEMPT_TIMEOUT_S = 1200  # per-attempt cap when dialing the chip (a
 # healthy config finishes well inside this; a wedged compile must not eat
@@ -99,6 +107,15 @@ CONFIGS = {
     6: dict(metric="transformer_lm_w512_svd48_step_time", kind="lm",
             width=512, depth=8, num_heads=8, vocab=8192, seq=512, batch=32,
             code="svd", rank=48, bf16=True, ways=8, dense_compare=True),
+    # Config 7 (PR-2 superstep tentpole): loop_as_shipped — times the
+    # ACTUAL train_loop (host machinery, data feed, metric fetch, watchdog
+    # hooks included) at --superstep 1 vs K, from the loop's own log-line
+    # timestamps. The other rows' scan-fenced device times deliberately
+    # exclude host dispatch; this row is where the ~ms-per-dispatch tunnel
+    # tax (r05: dispatch_ms_per_step ~1035 ms on the CPU-fallback backend
+    # vs ~5 ms scanned) shows up or is amortized away. Baseline "none".
+    7: dict(metric="train_loop_superstep_step_time", kind="loop",
+            network="lenet", dataset="mnist", batch=64, superstep=8, ways=1),
 }
 
 # Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
@@ -118,6 +135,12 @@ def _peak_tflops(device_kind: str):
 
 
 # --------------------------------------------------------------------- child
+
+
+class _FastModeSkip(Exception):
+    """Raised inside optional side-measurements to skip them in fast mode
+    (caught by the surrounding 'reported as absent, never fabricated'
+    handler)."""
 
 
 def _mark_invalid(row: dict, reason: str) -> None:
@@ -212,7 +235,7 @@ def measure_lm(cfg: dict) -> dict:
         st, last = multi(st, key, tokens)
         float(last)
         dt, sync = float("inf"), float("nan")
-        for _ in range(3):
+        for _ in range(REPS):
             t0 = time.perf_counter()
             st, last = multi(st, key, tokens)
             sync = float(last)
@@ -289,6 +312,114 @@ def measure_lm(cfg: dict) -> dict:
     return out
 
 
+def measure_loop(cfg: dict) -> dict:
+    """Config-7: the SHIPPED train_loop timed end-to-end at --superstep 1
+    vs K, from its own log-line timestamps (the steady tail; the compiling
+    head is discarded). Includes everything the scan-fenced rows exclude:
+    per-step host dispatch, data feed, metric fetch, log formatting. The
+    ratio ``dispatch_amortization`` is the superstep tentpole's win; it is
+    near 1 on a local CPU backend (dispatch is cheap there) and grows with
+    per-dispatch cost on tunneled TPU backends."""
+    import jax
+    import numpy as np
+
+    from atomo_tpu.data import SPECS, BatchIterator, synthetic_dataset
+    from atomo_tpu.models import get_model
+    from atomo_tpu.training import make_optimizer, train_loop
+
+    fast = os.environ.get("ATOMO_BENCH_FAST") == "1"
+    k = int(cfg["superstep"])
+    warm_blocks, steady_blocks = (1, 2) if fast else (2, 8)
+    n_steps = (warm_blocks + steady_blocks) * k  # same step count for both
+
+    def timed_loop(loop_call, superstep: int) -> float:
+        """Run ``loop_call(model, opt, it, superstep, log_fn)`` — one of
+        the two shipped loops — and return median steady-tail ms/step from
+        its Worker-line timestamps. ONE copy of the timing protocol so the
+        single-host and distributed amortization numbers stay comparable."""
+        model = get_model(cfg["network"], 10)
+        opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+        ds = synthetic_dataset(SPECS[cfg["dataset"]], True, size=cfg["batch"] * 2)
+        it = BatchIterator(ds, cfg["batch"], seed=0)
+        stamps = []
+
+        def log(line, _t=time.perf_counter):
+            if line.startswith("Worker:"):
+                stamps.append(_t())
+
+        loop_call(model, opt, it, superstep, log)
+        if len(stamps) < 3:
+            return float("nan")
+        deltas = np.diff(np.asarray(stamps))
+        # steady tail only: the head is dominated by jit compilation
+        tail = deltas[len(deltas) // 2 :]
+        return float(np.median(tail)) / superstep * 1e3
+
+    def single_host(model, opt, it, superstep, log):
+        train_loop(
+            model, opt, it, max_steps=n_steps, log_every=superstep,
+            log_fn=log, superstep=superstep, eval_freq=0,
+        )
+
+    ms_k1 = timed_loop(single_host, 1)
+    ms_k = timed_loop(single_host, k)
+    dev = jax.devices()[0]
+    valid = (
+        math.isfinite(ms_k1) and math.isfinite(ms_k) and ms_k1 > 0 and ms_k > 0
+    )
+    out = dict(
+        metric=cfg["metric"],
+        value=round(ms_k, 3) if math.isfinite(ms_k) else None,
+        unit="ms/step",
+        config=dict(
+            kind="loop", network=cfg["network"], dataset=cfg["dataset"],
+            batch=cfg["batch"], superstep=k, steps=n_steps,
+            warm_blocks=warm_blocks,
+        ),
+        loop_k1_ms_per_step=round(ms_k1, 3) if math.isfinite(ms_k1) else None,
+        superstep=k,
+        dispatch_amortization=round(ms_k1 / ms_k, 2) if valid else None,
+        byte_reduction=None,
+        mfu=None,
+        flops_per_step=None,
+        peak_tflops=None,
+        platform=dev.platform,
+        device=dev.device_kind,
+        ways=cfg.get("ways", 1),
+        chips_measured=1,
+        measurement_valid=valid,
+        invalid_reason=None if valid else "loop timing produced no finite ms/step",
+        timing="shipped-loop-wallclock",
+    )
+    # the distributed loop, same protocol, when a mesh is available (the
+    # single local chip cannot form one; fast mode skips the extra compiles)
+    if len(jax.devices()) >= 2 and not fast:
+        from atomo_tpu.codecs import QsgdCodec
+        from atomo_tpu.parallel import distributed_train_loop, make_mesh
+
+        mesh = make_mesh(2)
+
+        def distributed(model, opt, it, superstep, log):
+            distributed_train_loop(
+                model, opt, mesh, it, max_steps=n_steps,
+                codec=QsgdCodec(bits=4, bucket_size=512), aggregate="gather",
+                log_every=superstep, log_fn=log, superstep=superstep,
+            )
+
+        d1, dk = timed_loop(distributed, 1), timed_loop(distributed, k)
+        out["dist_loop_k1_ms_per_step"] = (
+            round(d1, 3) if math.isfinite(d1) else None
+        )
+        out["dist_loop_ms_per_step"] = round(dk, 3) if math.isfinite(dk) else None
+        if math.isfinite(d1) and math.isfinite(dk) and dk > 0:
+            out["dist_dispatch_amortization"] = round(d1 / dk, 2)
+    else:
+        out["dist_loop_skipped"] = (
+            "fast mode" if fast else "single local device: no mesh to form"
+        )
+    return out
+
+
 def measure_ours(cfg: dict) -> dict:
     import jax
     import jax.numpy as jnp
@@ -299,6 +430,8 @@ def measure_ours(cfg: dict) -> dict:
 
     if cfg.get("kind") == "lm":
         return measure_lm(cfg)
+    if cfg.get("kind") == "loop":
+        return measure_loop(cfg)
 
     model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
@@ -363,7 +496,7 @@ def measure_ours(cfg: dict) -> dict:
         # 1.41 ms minutes apart); the MIN is the standard contention-robust
         # estimator of true device time
         dt, scan_sync = float("inf"), float("nan")
-        for _ in range(3):
+        for _ in range(REPS):
             t0 = time.perf_counter()
             st, last = multi(st, key, images, labels)
             scan_sync = float(last)  # one dispatch fences all STEPS steps
@@ -381,9 +514,14 @@ def measure_ours(cfg: dict) -> dict:
 
     # isolate the ENCODE phase (VERDICT r3 next-round #3: "encode_ms
     # printed per config"): time encode_tree alone on a real gradient
-    # pytree, scan-fenced like everything else
+    # pytree, scan-fenced like everything else. Skipped in fast mode —
+    # it is a whole extra compile + REPS scans per config, and the r05
+    # ladder lost its window to exactly this class of side-measurement
+    # on the 1-core fallback host (rc=124)
     encode_ms = None
     try:
+        if os.environ.get("ATOMO_BENCH_FAST") == "1":
+            raise _FastModeSkip("encode isolation skipped in fast mode")
         from atomo_tpu.codecs import encode_tree
 
         def _loss(p):
@@ -418,7 +556,7 @@ def measure_ours(cfg: dict) -> dict:
 
         float(enc_many(key, grads))  # compile + warm
         best = float("inf")
-        for _ in range(3):
+        for _ in range(REPS):
             t0 = time.perf_counter()
             esync = float(enc_many(key, grads))
             best = min(best, (time.perf_counter() - t0) / STEPS)
@@ -611,7 +749,7 @@ def _flash_attention_compare() -> dict:
 
             float(many(q, k, v))  # compile + warm
             best = float("inf")
-            for _ in range(3):
+            for _ in range(REPS):
                 t0 = time.perf_counter()
                 sync = float(many(q, k, v))
                 best = min(best, (time.perf_counter() - t0) / reps)
@@ -661,7 +799,7 @@ def _qsgd_encode_compare() -> dict:
 
             float(many(key, g))  # compile + warm
             best = float("inf")
-            for _ in range(3):  # best-of-3 (shared-chip contention)
+            for _ in range(REPS):  # best-of-N (shared-chip contention)
                 t0 = time.perf_counter()
                 sync = float(many(key, g))  # one dispatch, scalar fence
                 best = min(best, (time.perf_counter() - t0) / reps)
@@ -817,7 +955,7 @@ def _backend_or_die(timeout_s: int = BACKEND_TIMEOUT_S):
 
 
 def child_main(args) -> int:
-    global STEPS, WARMUP
+    global STEPS, WARMUP, REPS
     _honor_platform_env()
     _backend_or_die()
     cfg = dict(CONFIGS[args.config if args.config is not None else 2])
@@ -826,10 +964,11 @@ def child_main(args) -> int:
         # fast mode (set by the parent's CPU-fallback path): a ResNet config
         # at the full 30-step x best-of-3 protocol cannot finish on this
         # box's one CPU core inside the child timeout — trade precision for
-        # existence. The step/warmup overrides are honored ONLY here so a
-        # stray env var cannot silently change the normal TPU protocol.
+        # existence. The step/warmup/reps overrides are honored ONLY here so
+        # a stray env var cannot silently change the normal TPU protocol.
         STEPS = int(os.environ.get("ATOMO_BENCH_STEPS", STEPS))
         WARMUP = int(os.environ.get("ATOMO_BENCH_WARMUP", WARMUP))
+        REPS = int(os.environ.get("ATOMO_BENCH_REPS", REPS))
         # side-compares are TPU evidence; in CPU-fallback mode they only
         # multiply the time to a already-degraded number (each is at least
         # one extra multi-minute 1-core compile)
@@ -848,8 +987,9 @@ def child_main(args) -> int:
         # the metric NAME is kept stable for consumers, so mark explicitly
         # which protocol parts were dropped (e.g. config 4's ckpt timing)
         out["degraded_protocol"] = (
-            f"cpu-fallback fast mode: {STEPS} steps, batch {cfg.get('batch')}, "
-            "side-compares (dense/bf16/qsgd/ckpt/attn/wire) skipped"
+            f"cpu-fallback fast mode: {STEPS} steps, best-of-{REPS}, batch "
+            f"{cfg.get('batch')}, side-compares (dense/bf16/qsgd/ckpt/attn/"
+            "wire) and encode isolation skipped"
         )
     # flush an intermediate row before the (slow, host-CPU) torch baseline:
     # if the baseline is killed by the parent's timeout, the accelerator
@@ -877,6 +1017,30 @@ def child_main(args) -> int:
 
 
 # -------------------------------------------------------------------- parent
+
+# Ladder wall-clock deadline (seconds, ATOMO_BENCH_DEADLINE_S; set by main
+# from invocation start). The driver runs `python bench.py` under a hard
+# ~870 s timeout; r05 hit it (rc=124) because the CPU-fallback ladder has
+# no concept of a global budget — each config individually fit its child
+# timeout while the SUM ran past the window, truncating the final
+# aggregate line mid-write. Now every config checks the remaining budget,
+# child timeouts are clamped to it, and configs that cannot start emit an
+# honest deadline row — so the LAST line is always a complete aggregate.
+_DEADLINE = None
+
+
+def _remaining() -> float:
+    return float("inf") if _DEADLINE is None else _DEADLINE - time.monotonic()
+
+
+def _deadline_row(cfg: dict) -> dict:
+    return dict(
+        metric=cfg["metric"], value=None, unit="ms/step", vs_baseline=None,
+        baseline="none", byte_reduction=None, mfu=None, platform=None,
+        device=None, chips_measured=1, measurement_valid=False,
+        invalid_reason="ladder deadline exhausted before this config ran",
+        error="ladder deadline exhausted (ATOMO_BENCH_DEADLINE_S)",
+    )
 
 
 def _run_child(
@@ -924,7 +1088,9 @@ def _probe_tpu() -> bool:
         rc = subprocess.run(
             [sys.executable, "-c", code],
             cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
-            timeout=BACKEND_TIMEOUT_S + 60,
+            # clamped to the ladder budget: a wedged relay dial must not
+            # eat the window the CPU fallback needs (r05's rc=124)
+            timeout=min(BACKEND_TIMEOUT_S + 60, max(30, _remaining() - 300)),
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
         ).returncode
@@ -934,6 +1100,11 @@ def _probe_tpu() -> bool:
 
 
 def _bench_one(config: int, no_baseline: bool, try_tpu: bool = True) -> dict:
+    cfg = CONFIGS[config]
+    if _remaining() < 45:
+        # not enough budget to even start a fallback child: report the
+        # truncation honestly instead of eating the driver's timeout
+        return _deadline_row(cfg)
     tail = ["--config", str(config)]
     if no_baseline:
         tail.append("--no-baseline")
@@ -945,26 +1116,37 @@ def _bench_one(config: int, no_baseline: bool, try_tpu: bool = True) -> dict:
     for attempt in range(retries if try_tpu else 0):
         if attempt:
             time.sleep(15 * attempt)  # axon tunnel contention backoff
+        if _remaining() < 120:
+            last_err = "ladder deadline: skipping further tpu attempts"
+            break
         # TPU attempts get a TIGHTER budget than the generous child default
         # (which exists for 1-core CPU-fallback runs): a healthy chip
         # finishes any config in a few minutes, while round 3 lost its
         # whole end-of-round window to one wedged ResNet-50 compile —
         # better to fail fast, retry, and leave time for the rest of the
-        # ladder (the driver records the LAST aggregate line).
-        parsed, err = _run_child(tail, {}, timeout_s=TPU_ATTEMPT_TIMEOUT_S)
+        # ladder (the driver records the LAST aggregate line). The per-
+        # attempt cap is additionally clamped to the remaining ladder
+        # budget, minus headroom for the CPU fallback.
+        parsed, err = _run_child(
+            tail, {},
+            timeout_s=int(min(TPU_ATTEMPT_TIMEOUT_S, max(60, _remaining() - 75))),
+        )
         if parsed is not None:
             return parsed
         last_err = err
     if not try_tpu:
         last_err = "tpu probe failed at ladder start; skipped tpu attempts"
     # final fallback: measure on the CPU backend rather than report nothing
-    # (fast mode: 4 steps, no side-compares — existence beats precision on
-    # a 1-core host; the row carries the degraded-protocol marker in error)
+    # (fast mode: 3 steps, best-of-1, batch 8, no side-compares/encode
+    # isolation — existence beats precision on a 1-core host; the row
+    # carries the degraded-protocol marker). Timeout clamped to what the
+    # ladder budget still allows.
     parsed, err = _run_child(
         tail + ["--no-baseline"],
         {"JAX_PLATFORMS": "cpu", "ATOMO_BENCH_FAST": "1",
-         "ATOMO_BENCH_STEPS": "4", "ATOMO_BENCH_WARMUP": "1",
-         "ATOMO_BENCH_BATCH": "16"},
+         "ATOMO_BENCH_STEPS": "3", "ATOMO_BENCH_WARMUP": "1",
+         "ATOMO_BENCH_REPS": "1", "ATOMO_BENCH_BATCH": "8"},
+        timeout_s=int(min(CHILD_TIMEOUT_S, max(45, _remaining() - 10))),
     )
     if parsed is not None:
         parsed["error"] = f"tpu attempts failed ({last_err}); cpu fallback"
@@ -995,6 +1177,10 @@ def main() -> int:
     args = ap.parse_args()
     if args.child:
         return child_main(args)
+    global _DEADLINE
+    _DEADLINE = time.monotonic() + float(
+        os.environ.get("ATOMO_BENCH_DEADLINE_S", "840")
+    )
     if args.config is not None and args.all:
         ap.error("--config and --all are mutually exclusive")
     if args.config is not None:

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Bench smoke (<60 s): run ONE cheap ladder config — 7, the shipped-loop
+# superstep row (lenet, synthetic data, no side-compares) — on the CPU
+# backend in fast mode, and validate the JSON contract the driver parses
+# (metric/value/unit/measurement_valid/platform on the LAST line).
+#
+# Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
+# the bench entrypoint still emits parseable rows without burning the
+# full-ladder window. A failure here means the driver's end-of-round
+# bench pass would have produced nothing useful.
+# Usage: scripts/bench_smoke.sh   (from the repo root or anywhere)
+cd "$(dirname "$0")/.." || exit 2
+set -o pipefail
+# JAX_PLATFORMS=cpu makes the first child attempt a real CPU measurement
+# (valid row); the internal deadline stays above the 120 s attempt floor
+# so that attempt actually runs — the OUTER timeout is the <60 s cap.
+out=$(timeout -k 5 55 env JAX_PLATFORMS=cpu ATOMO_BENCH_FAST=1 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=240 \
+      python bench.py --config 7 --no-baseline 2>/dev/null)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: bench.py exited rc=$rc (timeout or crash)"
+  exit 1
+fi
+tmp=$(mktemp)
+trap 'rm -f "$tmp"' EXIT
+printf '%s\n' "$out" > "$tmp"
+python - "$tmp" <<'EOF'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+assert lines, "bench_smoke FAIL: no JSON emitted"
+row = json.loads(lines[-1])  # the driver parses the LAST line
+missing = [k for k in
+           ("metric", "value", "unit", "measurement_valid", "platform",
+            "timing", "error") if k not in row]
+assert not missing, f"bench_smoke FAIL: missing keys {missing}: {row}"
+assert row["unit"] == "ms/step", row
+assert row["metric"] == "train_loop_superstep_step_time", row
+state = "valid" if row["measurement_valid"] else \
+    f"invalid ({row.get('invalid_reason')})"
+print(f"bench_smoke OK: {row['metric']} = {row['value']} {row['unit']} "
+      f"[{row['platform']}, {state}, K={row.get('superstep')}, "
+      f"amortization={row.get('dispatch_amortization')}]")
+EOF

@@ -13,7 +13,10 @@ replay + full opt-state checkpoints make it bit-reproducible on one
 backend).
 
 Env: ATOMO_FT_DIR (train_dir), ATOMO_FT_RESUME=1 (resume), ATOMO_FT_STEPS
-(default 8), ATOMO_CHAOS (fault plan, e.g. "nan@3,kill@6").
+(default 8), ATOMO_CHAOS (fault plan, e.g. "nan@3,kill@6"),
+ATOMO_FT_SUPERSTEP (default 1: fused K-step blocks — the superstep drill
+runs crash/resume legs with DIFFERENT K values to prove block-partition
+invariance of the recovered trajectory).
 """
 
 import hashlib
@@ -40,6 +43,7 @@ def main() -> None:
     train_dir = os.environ["ATOMO_FT_DIR"]
     resume = os.environ.get("ATOMO_FT_RESUME") == "1"
     max_steps = int(os.environ.get("ATOMO_FT_STEPS", "8"))
+    superstep = int(os.environ.get("ATOMO_FT_SUPERSTEP", "1"))
     model = get_model("lenet", 10)
     opt = make_optimizer("sgd", lr=0.05, momentum=0.9)  # momentum: the
     # restart must restore the optimizer state, not just params
@@ -57,6 +61,7 @@ def main() -> None:
         seed=0,
         guard=GuardConfig(),
         log_fn=lambda s: print(s, flush=True),
+        superstep=superstep,
     )
     h = hashlib.sha256()
     for leaf in jax.tree_util.tree_leaves(jax.device_get(state.params)):

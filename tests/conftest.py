@@ -34,6 +34,14 @@ def pytest_configure(config):
         'slow" is the <5 min smoke selection. The real-CIFAR convergence '
         "test additionally gates on ATOMO_RUN_SLOW=1.",
     )
+    config.addinivalue_line(
+        "markers",
+        "perf: wall-clock performance sweeps (superstep dispatch "
+        "amortization etc.). Opt-in only — they measure time, not "
+        "correctness, and are meaningless on a contended 1-core CI box: "
+        "additionally gate on ATOMO_RUN_PERF=1. Correctness-equivalence "
+        "superstep tests are NOT marked perf and stay in tier-1.",
+    )
 
 
 @pytest.fixture

@@ -26,7 +26,7 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_bench_one", fake_bench_one)
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     assert bench.main() == 0
-    assert order == [2, 1, 3, 4, 5, 6]
+    assert order == [2, 1, 3, 4, 5, 6, 7]
 
     lines = [
         json.loads(ln)
@@ -38,7 +38,7 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     assert aggs and all(a["metric"] == "m2" for a in aggs)
     assert aggs[-1]["configs_complete"] is True
     assert [c["metric"] for c in aggs[-1]["configs"]] == [
-        "m1", "m2", "m3", "m4", "m5", "m6"
+        "m1", "m2", "m3", "m4", "m5", "m6", "m7"
     ]
     # an aggregate exists right after the FIRST config completes
     assert "configs" in lines[1]
@@ -94,6 +94,42 @@ def test_dead_relay_skips_tpu_attempts(monkeypatch):
     assert tpu_children["n"] == 0
     assert row["measurement_valid"] is False
     assert "probe failed" in row["error"]
+
+
+def test_ladder_deadline_truncates_honestly(monkeypatch):
+    """r05 postmortem (BENCH_r05.json rc=124): the CPU-fallback ladder ran
+    past the driver's 870 s window with no global budget, truncating the
+    final aggregate mid-write. With the deadline exhausted, _bench_one
+    must emit an honest deadline row — no children, no timeout."""
+    def boom(*a, **k):
+        raise AssertionError("no child may be spawned past the deadline")
+
+    monkeypatch.setattr(bench, "_run_child", boom)
+    monkeypatch.setattr(bench, "_DEADLINE", bench.time.monotonic() + 1.0)
+    row = bench._bench_one(3, no_baseline=True)
+    assert row["measurement_valid"] is False
+    assert "deadline" in row["invalid_reason"]
+    assert row["metric"] == bench.CONFIGS[3]["metric"]
+
+
+def test_fallback_child_timeout_clamped_to_deadline(monkeypatch):
+    """With some budget left but less than the child default, the CPU
+    fallback child's timeout must be clamped to the remaining window."""
+    seen = {}
+
+    def fake_run_child(tail, env, timeout_s=None):
+        seen.setdefault("timeouts", []).append(timeout_s)
+        if env.get("JAX_PLATFORMS") == "cpu":
+            return {"metric": "m", "value": 1.0, "measurement_valid": True,
+                    "platform": "cpu"}, ""
+        return None, "rc=17: wedged"
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_DEADLINE", bench.time.monotonic() + 200.0)
+    row = bench._bench_one(1, no_baseline=True, try_tpu=False)
+    assert row["measurement_valid"] is False  # cpu fallback is never headline
+    assert all(t <= 200 for t in seen["timeouts"]), seen
 
 
 def test_comm_model_attached_is_json_safe():
