@@ -81,15 +81,26 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
     t.add_argument("--n-devices", type=int, default=0,
                    help="devices in the dp mesh; 0 = all visible, 1 = single-host")
     t.add_argument("--aggregate", type=str, default="auto",
-                   choices=["auto", "gather", "psum", "hierarchical"],
+                   choices=["auto", "gather", "ring", "psum", "hierarchical"],
                    help="gradient exchange mode: gather = factor all_gather "
-                        "(compressed wire), psum = dense all-reduce, "
-                        "hierarchical = dense psum over the fast fabric "
-                        "(ICI) then factor all_gather over the slow one "
-                        "(DCN) — see --dcn-ways. auto (default) picks per "
-                        "deployment from the measured comm-cost model and "
-                        "prints why (utils/comm_model.choose_aggregate, "
+                        "(compressed wire), ring = the streamed form of "
+                        "gather (payloads rotate via ppermute, each hop's "
+                        "decode overlaps the next transfer, no O(N) "
+                        "gathered buffer — see --ring-bucket-size), psum = "
+                        "dense all-reduce, hierarchical = dense psum over "
+                        "the fast fabric (ICI) then factor all_gather over "
+                        "the slow one (DCN) — see --dcn-ways. auto "
+                        "(default) picks per deployment from the measured "
+                        "comm-cost model and prints why "
+                        "(utils/comm_model.choose_aggregate, "
                         "artifacts/COMM_CROSSOVER.md)")
+    t.add_argument("--ring-bucket-size", type=int, default=65536, metavar="N",
+                   help="ring aggregation: elements per packed rotation "
+                        "bucket (parallel.common.pack_tree_buckets) — every "
+                        "same-dtype payload leaf rides one ppermute per hop "
+                        "regardless of model depth; <= 0 packs each dtype "
+                        "into a single unpadded bucket. Any value produces "
+                        "bit-identical results (layout only; tested)")
     t.add_argument("--fabric", type=str, default="auto", metavar="F",
                    help="fabric for --aggregate auto's ADVISORY (the mode "
                         "itself is decided by wire bytes + host topology): "
@@ -210,13 +221,14 @@ def _warn_dead_flags(args: argparse.Namespace) -> None:
             "parameter in the reference too, README.md:111)"
         )
     if args.num_aggregate is not None and (
-        args.aggregate not in ("gather", "auto")
+        args.aggregate not in ("gather", "ring", "auto")
         or args.code.lower() in DENSE_CODES
     ):
         warnings.warn(
-            "--num-aggregate only applies to compressed gather aggregation "
-            "(a dense psum cannot subset replicas); ignoring it — note the "
-            "reference ignores it always (sync_replicas_master_nn.py:113,124)"
+            "--num-aggregate only applies to compressed gather/ring "
+            "aggregation (a dense psum cannot subset replicas); ignoring it "
+            "— note the reference ignores it always "
+            "(sync_replicas_master_nn.py:113,124)"
         )
     if args.enable_gpu or args.no_cuda:
         warnings.warn("--enable-gpu/--no-cuda are ignored: device selection is JAX's")
@@ -317,7 +329,8 @@ def _codec_byte_budget(codec, model_init_fn) -> tuple[int, int]:
 
 
 def _resolve_auto_aggregate(
-    args, codec, model_init_fn, n_dev, *, allow_hierarchical=True, log=print
+    args, codec, model_init_fn, n_dev, *, allow_hierarchical=True,
+    allow_ring=True, log=print,
 ) -> str:
     """``--aggregate auto`` (VERDICT r4 #3): pick the exchange mode from
     the measured comm-cost model and always say why in one line."""
@@ -355,6 +368,7 @@ def _resolve_auto_aggregate(
         fabric_bw=bw,
         tax_s=None if args.codec_tax_ms is None else args.codec_tax_ms / 1e3,
         cross_host=cross_host,
+        allow_ring=allow_ring,
     )
     log(f"--aggregate auto -> {mode} ({reason})")
     return mode
@@ -461,12 +475,13 @@ def cmd_train(args: argparse.Namespace) -> int:
             if (
                 args.num_aggregate is not None
                 and codec is not None
-                and args.aggregate != "gather"
+                and args.aggregate not in ("gather", "ring")
             ):
                 warnings.warn(
-                    "--num-aggregate only applies to gather aggregation; "
-                    f"--aggregate auto resolved to {args.aggregate!r} — "
-                    "pass --aggregate gather explicitly to subset replicas"
+                    "--num-aggregate only applies to gather/ring "
+                    f"aggregation; --aggregate auto resolved to "
+                    f"{args.aggregate!r} — pass --aggregate gather "
+                    "explicitly to subset replicas"
                 )
         inner_axis = None
         if args.aggregate == "hierarchical":
@@ -489,7 +504,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         k_agg = 0
         if (
             args.num_aggregate is not None
-            and args.aggregate == "gather"
+            and args.aggregate in ("gather", "ring")
             and codec is not None
         ):
             k_agg = args.num_aggregate
@@ -514,6 +529,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             profile_dir=args.profile_dir or None,
             compute_dtype=jnp.bfloat16 if args.bf16 else None,
             superstep=superstep,
+            ring_bucket_size=args.ring_bucket_size,
         )
     else:
         from atomo_tpu.training import train_loop
@@ -674,7 +690,8 @@ def cmd_lm(args: argparse.Namespace) -> int:
             )["params"]
 
         aggregate = _resolve_auto_aggregate(
-            args, codec, _init_params, dp, allow_hierarchical=False
+            args, codec, _init_params, dp, allow_hierarchical=False,
+            allow_ring=False,  # the lm layouts ship gather/psum only
         )
 
     # layout-inapplicable flags: warn, don't silently ignore (the train
@@ -1097,6 +1114,11 @@ def _honor_platform_env() -> None:
 
 def main(argv=None) -> int:
     _honor_platform_env()
+    from atomo_tpu.compat import enable_compile_cache
+
+    # opt-in (ATOMO_COMPILE_CACHE=dir): ladder re-runs and elastic
+    # restarts skip recompiling identical XLA programs; no-op otherwise
+    enable_compile_cache()
     argv = list(sys.argv[1:] if argv is None else argv)
     known = {"train", "evaluate", "tune", "lm", "-h", "--help"}
     if argv and argv[0] not in known:

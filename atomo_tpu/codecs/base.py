@@ -118,7 +118,8 @@ def encode_tree(
 
 
 def decode_mean_tree(
-    codec: Codec, gathered: Any, grads_like: Any, n_replicas: int
+    codec: Codec, gathered: Any, grads_like: Any, n_replicas: int,
+    fused: bool = True,
 ) -> Any:
     """Decode all_gather-ed payloads (leading axis = replica) and average.
 
@@ -127,14 +128,23 @@ def decode_mean_tree(
     matmul — MXU-sized instead of N slivers, and no N dense intermediates);
     falls back to vmap-decode + mean otherwise. Bit-stable across replicas
     because every chip runs the identical reduction on identical bytes.
+
+    ``fused=False`` forces the vmap-decode + canonical ``jnp.mean(axis=0)``
+    path even when the codec offers a fused kernel. This is the decode
+    ORDER the ring-streamed aggregation reproduces exactly (per-replica
+    decode, then an elementwise mean over replica index 0..N-1): the fused
+    SVD matmul reassociates the sum over the flattened (replica, atom)
+    axis and differs from the canonical mean in the last mantissa bits
+    (~1e-6 relative, same class as XLA fusion drift — measured). Codecs
+    without a fused kernel (qsgd/terngrad/dense) are identical either way.
     """
     leaves, treedef = jax.tree_util.tree_flatten(grads_like)
     p_leaves = treedef.flatten_up_to(gathered)
     out = []
     for p, g in zip(p_leaves, leaves):
-        fused = getattr(codec, "decode_mean", None)
-        if fused is not None:
-            decoded = fused(p, tuple(g.shape), g.dtype, n_replicas)
+        fused_fn = getattr(codec, "decode_mean", None) if fused else None
+        if fused_fn is not None:
+            decoded = fused_fn(p, tuple(g.shape), g.dtype, n_replicas)
             if decoded is not None:
                 out.append(decoded)
                 continue

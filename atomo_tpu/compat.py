@@ -42,6 +42,63 @@ def install() -> None:
     jax.shard_map = shard_map
 
 
+def enable_compile_cache(log_fn=print):
+    """Opt-in persistent XLA compilation cache (``ATOMO_COMPILE_CACHE=dir``).
+
+    Ladder re-runs, elastic restarts, and superstep/bench children
+    recompile the exact same XLA programs from scratch — multi-minute on
+    the 1-core fallback host. With the env var set, compiled executables
+    persist under the given directory (``jax_compilation_cache_dir``) and
+    subsequent processes load them instead of recompiling; the min-
+    compile-time floor is dropped to 0 so even small programs cache.
+
+    Hit/miss visibility: programs already in the cache at enable time are
+    the hit pool (logged); every compile that happens anyway writes a new
+    entry, so the caller-registered exit report of NEW entries is the
+    session's miss count. Returns the cache dir, or None when disabled
+    (zero behavior change without the env var — the cache must never
+    surprise a bench measurement).
+    """
+    import atexit
+    import os
+
+    path = os.environ.get("ATOMO_COMPILE_CACHE")
+    if not path:
+        return None
+    os.makedirs(path, exist_ok=True)
+
+    def _entries() -> int:
+        try:
+            return sum(1 for e in os.scandir(path) if e.is_file())
+        except OSError:
+            return 0
+
+    before = _entries()
+    jax.config.update("jax_compilation_cache_dir", path)
+    for opt, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except Exception:  # older jax without the knob: cache still works
+            pass
+    log_fn(
+        f"XLA compilation cache: {path} ({before} entries available as "
+        "hits; new compiles are misses and persist for the next run)"
+    )
+
+    def _report():
+        after = _entries()
+        log_fn(
+            f"XLA compilation cache: {max(after - before, 0)} misses "
+            f"written this run, {after} entries total in {path}"
+        )
+
+    atexit.register(_report)
+    return path
+
+
 def pallas_tpu_interpret_mode(interpret: bool):
     """Value for ``pl.pallas_call(interpret=...)``: the TPU-semantics
     interpreter where the installed jax has it, plain interpret mode

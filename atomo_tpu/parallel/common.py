@@ -7,13 +7,102 @@ without import cycles.
 from __future__ import annotations
 
 import math
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from atomo_tpu.training.trainer import TrainState
+
+
+class PackSpec(NamedTuple):
+    """Static layout of a bucket-packed pytree (see :func:`pack_tree_buckets`).
+
+    ``leaves[i] = (group, offset, size, shape, dtype)`` locates flattened
+    leaf ``i`` inside buffer ``group``; all fields are Python ints/tuples
+    known at trace time, so unpacking is static slicing — a pure relayout
+    with zero arithmetic, hence bit-exact by construction.
+    """
+
+    treedef: Any
+    leaves: tuple  # ((group, offset, size, shape, dtype_name), ...)
+    group_dtypes: tuple  # dtype name per buffer, sorted
+
+
+def pack_tree_buckets(tree: Any, bucket_size: int = 0):
+    """Pack a pytree of arrays into one flat (n_buckets, bucket_size) buffer
+    per dtype — the rotation unit of ring-streamed aggregation.
+
+    A deep model's encoded payload has dozens of small leaves; rotating
+    them leaf-by-leaf would issue one ``ppermute`` per leaf per hop. Packing
+    concatenates every same-dtype leaf into a single buffer (padded with
+    zeros to a whole number of ``bucket_size``-element buckets, <= one
+    bucket of overhead per dtype), so each ring hop is one collective per
+    dtype (typically f32 + uint32 = two) regardless of model depth —
+    "small layers amortize into one rotation slot". ``bucket_size <= 0``
+    packs each dtype into a single unpadded bucket.
+
+    Packing is concat/reshape/zero-pad only; :func:`unpack_tree_buckets`
+    inverts it exactly (bit-level round trip for ANY bucket size — tested
+    as a property in tests/test_ring_aggregate.py). Kept codec-free in
+    this module per the ring design: the rotation layer never interprets
+    payload semantics.
+
+    Returns ``(buffers, spec)`` where ``buffers`` is a tuple (sorted by
+    dtype name, stable across chips) and ``spec`` a :class:`PackSpec`.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    groups: dict[str, list[int]] = {}
+    for i, leaf in enumerate(leaves):
+        groups.setdefault(jnp.dtype(leaf.dtype).name, []).append(i)
+    keys = sorted(groups)
+    bufs = []
+    where: dict[int, tuple[int, int]] = {}
+    for gi, dname in enumerate(keys):
+        idxs = groups[dname]
+        off = 0
+        flats = []
+        for i in idxs:
+            where[i] = (gi, off)
+            off += int(leaves[i].size)
+            flats.append(leaves[i].reshape(-1))
+        cat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        if bucket_size > 0:
+            n_buckets = max(1, -(-off // bucket_size))
+            padded = n_buckets * bucket_size
+            if padded > off:
+                cat = jnp.concatenate(
+                    [cat, jnp.zeros((padded - off,), cat.dtype)]
+                )
+            bufs.append(cat.reshape(n_buckets, bucket_size))
+        else:
+            bufs.append(cat.reshape(1, -1))
+    spec = PackSpec(
+        treedef=treedef,
+        leaves=tuple(
+            (
+                where[i][0],
+                where[i][1],
+                int(leaves[i].size),
+                tuple(leaves[i].shape),
+                jnp.dtype(leaves[i].dtype).name,
+            )
+            for i in range(len(leaves))
+        ),
+        group_dtypes=tuple(keys),
+    )
+    return tuple(bufs), spec
+
+
+def unpack_tree_buckets(bufs, spec: PackSpec):
+    """Exact inverse of :func:`pack_tree_buckets` (static slicing only)."""
+    flat = [b.reshape(-1) for b in bufs]
+    leaves = [
+        flat[g][off : off + size].reshape(shape)
+        for g, off, size, shape, _ in spec.leaves
+    ]
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
 
 
 def dense_init(key, shape, in_axis: int = 0):

@@ -16,6 +16,15 @@ aggregation is one of
   * ``psum``    — decode locally, pmean dense gradients. Mathematically
     identical mean; moves dense bytes. This is the reference's `--code=sgd`
     dense baseline when codec is None (and a useful ablation otherwise).
+  * ``ring``    — the streaming form of ``gather``: payloads rotate around
+    the axis with ``ppermute`` (N-1 hops of bucket-packed payload), each
+    hop's decode overlapping the next hop's transfer, and each chip
+    reduces its own flat-gradient segment in canonical source order
+    before one tiled all_gather republishes the mean. No O(N·payload)
+    gathered buffer; replicas bit-identical by construction; the
+    aggregation operator is bit-identical to gather's canonical decode
+    order (see _ring_stream_mean for the determinism design and the
+    fusion-drift caveat on full fused-step trajectories).
 
 Replicated-PS equivalence (SURVEY.md §7 hard-part 4): optimizer state and
 params live replicated; every chip computes the same decoded mean (same
@@ -49,7 +58,9 @@ from atomo_tpu.codecs import (
     tree_nbytes,
 )
 from atomo_tpu.data.pipeline import augment_batch
+from atomo_tpu.parallel.common import pack_tree_buckets, unpack_tree_buckets
 from atomo_tpu.parallel.mesh import replicated
+from atomo_tpu.utils.tracing import named_phase
 from atomo_tpu.training.resilience import (
     grad_ok,
     masked_mean,
@@ -87,6 +98,134 @@ def _mask_gathered(gathered, okg):
         return jnp.where(okg.reshape(shape) > 0, p, jnp.zeros((), p.dtype))
 
     return jax.tree_util.tree_map(m, gathered)
+
+
+def _ring_stream_mean(
+    codec,
+    payloads,
+    grads,
+    *,
+    axis: str,
+    n_dev: int,
+    my,
+    ok=None,
+    sel=None,
+    n_contrib: int,
+    bucket_size: int = 0,
+):
+    """Ring-streamed decode-mean: rotate encoded payloads around ``axis``
+    with ``jax.lax.ppermute`` while each chip folds every arriving payload's
+    decode into ITS OWN flat gradient segment — chunk t's decode overlaps
+    chunk t+1's ICI transfer (both read the same pre-rotation buffer, so
+    XLA schedules the collective-permute concurrently with the decode
+    compute, exactly the parallel/ring.py attention pattern), and the
+    O(N·payload) replicated gather buffer never exists: live payload
+    memory is ONE rotating packed payload per chip.
+
+    Determinism and replication (the load-bearing design decisions):
+
+      * Each chip stages the decoded slice of source ``s`` at canonical
+        index ``s`` of an (N, chunk) buffer and reduces with ONE
+        ``jnp.mean(axis=0)`` AFTER the rotation — the same elementwise
+        canonical-order reduction the gather path's vmap-decode + mean
+        performs. As standalone aggregation programs the two are
+        bit-identical per codec (tested; for SVD that is gather's
+        ``fused=False`` decode order — see codecs.base.decode_mean_tree).
+        Inside the fully-fused train step, XLA fuses the two program
+        STRUCTURES differently and full trajectories agree to last-
+        mantissa-bit fusion drift (~1e-8, allclose) — the same measured
+        class as the scan-vs-standalone drift documented for superstep.
+        A running scalar fold was rejected:
+        chip r receives sources in rotated order (r, r+1, ...), and fp
+        addition is non-associative, so sequential folding would give
+        every replica different last-mantissa bits and break the
+        replicated-PS invariant (measured, not hypothetical).
+      * Each flat-gradient element is summed by exactly ONE chip (its
+        segment owner) and broadcast by the final tiled all_gather, so
+        replicas are bit-identical BY CONSTRUCTION — stronger than
+        gather's "same program over same bytes" argument.
+
+    Wire accounting (utils/comm_model.ring_stream_wire_bytes): N-1 payload
+    hops per chip (the rotation) plus the dense/n_dev-sized segment
+    all_gather — the segment exchange is the price of exact cross-chip
+    determinism. The staging buffer is one dense-gradient-sized transient
+    (N x D/N), the same order as the decoded mean itself.
+
+    ``ok`` (guard mode) is a (1,) health flag that ROTATES alongside the
+    payload, so each arriving contribution is masked by its source's
+    health before staging (NaN payloads never touch the mean — the
+    skip-and-rescale contract of _mask_gathered, applied mid-ring).
+    Returns (mean_tree, ok_stage) where ok_stage is the (N,) canonical
+    health vector (None without guard). ``sel`` (num_aggregate) selects a
+    rotating source subset from the staged buffer with the same
+    ``jnp.take`` + mean arithmetic the gather path applies to gathered
+    payloads.
+    """
+    from jax.flatten_util import ravel_pytree
+
+    flat_tpl, unravel = ravel_pytree(grads)
+    d_flat = flat_tpl.size
+    chunk = -(-d_flat // n_dev)
+    pad = chunk * n_dev - d_flat
+
+    bufs, spec = pack_tree_buckets(payloads, bucket_size)
+    guard_on = ok is not None
+    ok_buf = (
+        ok.astype(jnp.float32).reshape(1) if guard_on else jnp.zeros((1,))
+    )
+    perm = [(i, (i - 1) % n_dev) for i in range(n_dev)]
+
+    def decode_slice(bufs_t, ok_t):
+        payload_t = unpack_tree_buckets(bufs_t, spec)
+        decoded = decode_tree(codec, payload_t, grads)
+        flat = ravel_pytree(decoded)[0]
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        sl = jax.lax.dynamic_slice(flat, (my * chunk,), (chunk,))
+        if guard_on:
+            # mask BEFORE staging: an anomalous source's NaNs must never
+            # enter the mean (where(), not multiply — NaN * 0 is NaN)
+            sl = jnp.where(ok_t[0] > 0, sl, jnp.zeros((), sl.dtype))
+        return sl
+
+    def stage_one(t, bufs_t, ok_t, stage, ok_stage):
+        src = jax.lax.rem(my + t, n_dev)
+        sl = decode_slice(bufs_t, ok_t)
+        stage = jax.lax.dynamic_update_slice(stage, sl[None], (src, 0))
+        if guard_on:
+            ok_stage = jax.lax.dynamic_update_slice(ok_stage, ok_t, (src,))
+        return stage, ok_stage
+
+    def body(t, carry):
+        bufs_t, ok_t, stage, ok_stage = carry
+        stage, ok_stage = stage_one(t, bufs_t, ok_t, stage, ok_stage)
+        # rotate AFTER reading: the ppermute and the decode above both
+        # consume the pre-rotation buffer, so the hop overlaps the decode
+        bufs_t = tuple(jax.lax.ppermute(b, axis, perm) for b in bufs_t)
+        if guard_on:
+            ok_t = jax.lax.ppermute(ok_t, axis, perm)
+        return bufs_t, ok_t, stage, ok_stage
+
+    stage0 = jnp.zeros((n_dev, chunk), flat_tpl.dtype)
+    ok_stage0 = jnp.zeros((n_dev,), jnp.float32)
+    # exactly N-1 sends per chip: the last arrival is decoded and staged
+    # without an onward hop
+    bufs, ok_buf, stage, ok_stage = jax.lax.fori_loop(
+        0, n_dev - 1, body, (bufs, ok_buf, stage0, ok_stage0)
+    )
+    stage, ok_stage = stage_one(n_dev - 1, bufs, ok_buf, stage, ok_stage)
+
+    if sel is not None:
+        stage = jnp.take(stage, sel, axis=0)
+        if guard_on:
+            ok_stage = jnp.take(ok_stage, sel, axis=0)
+    # stage now has exactly n_contrib rows (N, or the k_agg-selected
+    # subset): one canonical elementwise mean, the gather path's reduction
+    assert stage.shape[0] == n_contrib, (stage.shape, n_contrib)
+    seg_mean = jnp.mean(stage, axis=0)
+    full = jax.lax.all_gather(seg_mean, axis, tiled=True)
+    mean_tree = unravel(full[:d_flat])
+    return mean_tree, (ok_stage if guard_on else None)
 
 
 def _healthy_mean(x, ok, kept_chips, metric_axes):
@@ -138,8 +277,37 @@ def make_distributed_train_step(
     guard=None,
     chaos=None,
     superstep: int = 1,
+    ring_bucket_size: int = 65536,
+    unfused_decode: bool = False,
 ):
     """Build the jitted SPMD train step over ``mesh``.
+
+    ``aggregate="ring"`` is the streaming form of ``gather``: the same
+    fixed-shape encoded payloads move, but instead of one all_gather into
+    an O(N·payload) replicated buffer followed by an O(N) decode-mean,
+    the payloads rotate around the mesh axis with ``jax.lax.ppermute``
+    (N-1 hops, ``ring_bucket_size``-element packed buckets so every layer
+    rides one collective per hop — parallel.common.pack_tree_buckets) and
+    each hop's decode overlaps the next hop's ICI transfer
+    (:func:`_ring_stream_mean` — the parallel/ring.py attention schedule
+    applied to gradient aggregation). Live payload memory is O(1) per
+    chip; each chip reduces its own flat-gradient segment in canonical
+    source order and one tiled all_gather republishes the mean, which
+    makes replicas bit-identical BY CONSTRUCTION and the aggregation
+    operator bit-identical to gather's canonical (unfused) decode order —
+    tested across codecs, with superstep/ZeRO-1/guard/chaos/num_aggregate
+    composing unchanged (full fused-step trajectories track gather to
+    XLA's cross-program fusion drift, ~1e-8 — the scan-vs-standalone
+    class). The extra segment all_gather moves
+    dense/N-sized slices (comm_model.ring_stream_wire_bytes keeps the
+    accounting honest); ``--aggregate auto`` picks ring when the gathered
+    buffer would outgrow a dense gradient (N >= byte reduction).
+
+    ``unfused_decode`` (gather mode only) forces the canonical
+    vmap-decode + mean reduction even for codecs with a fused decode_mean
+    (SVD): it is the decode-order ablation that makes gather's arithmetic
+    match ring exactly — the parity oracle in tests/test_ring_aggregate.py
+    — at the cost of the fused matmul's MXU efficiency.
 
     DONATION: the returned step donates its state argument (argnum 0) —
     after the call the caller's reference points at deleted buffers, and
@@ -242,13 +410,13 @@ def make_distributed_train_step(
     elif inner_axis is not None:
         raise ValueError("inner_axis only applies to aggregate='hierarchical'")
     k_agg = num_aggregate if 0 < num_aggregate < n_dev else 0
-    if k_agg and (codec is None or aggregate != "gather"):
+    if k_agg and (codec is None or aggregate not in ("gather", "ring")):
         raise ValueError(
-            "num_aggregate requires a codec with aggregate='gather' "
-            "(a dense psum cannot subset replicas)"
+            "num_aggregate requires a codec with aggregate='gather' or "
+            "'ring' (a dense psum cannot subset replicas)"
         )
-    if codec is None and aggregate == "gather":
-        aggregate = "psum"  # dense gather would be strictly worse than psum
+    if codec is None and aggregate in ("gather", "ring"):
+        aggregate = "psum"  # dense gather/ring would be strictly worse
 
     batch_axes = (axis, inner_axis) if hierarchical else axis
     metric_axes = batch_axes
@@ -378,21 +546,25 @@ def make_distributed_train_step(
                 # propagate NaN/Inf into payloads, so post-encode checks
                 # could not tell an anomalous gradient from codec overflow
                 ok = grad_ok(grads, guard.max_grad_norm)
-            payloads, stats = encode_tree(codec, k_codec, grads)
+            with named_phase("encode"):
+                payloads, stats = encode_tree(codec, k_codec, grads)
             msg_bytes = stats.payload_bytes
+            # deterministic rotating subset (num_aggregate) — identical on
+            # every chip, so replicas stay bit-equal
+            sel = (
+                (state.step + jnp.arange(k_agg)) % n_dev if k_agg else None
+            )
             if aggregate == "gather":
                 # factors on the wire: all_gather fixed-shape payloads,
                 # decode all replicas identically, mean.
-                gathered = jax.lax.all_gather(payloads, axis)  # leading axis n_dev
+                with named_phase("exchange"):
+                    gathered = jax.lax.all_gather(payloads, axis)  # leading axis n_dev
                 okg = (
                     jax.lax.all_gather(ok.astype(jnp.float32), axis)
                     if guard is not None
                     else None
                 )
-                if k_agg:
-                    # deterministic rotating subset — identical on every
-                    # chip, so replicas stay bit-equal
-                    sel = (state.step + jnp.arange(k_agg)) % n_dev
+                if sel is not None:
                     gathered = jax.tree.map(
                         lambda a: jnp.take(a, sel, axis=0), gathered
                     )
@@ -401,20 +573,41 @@ def make_distributed_train_step(
                 # fused decode_mean where the codec provides it (SVD: the N
                 # rank-k factor blocks concatenate into ONE (m, N·k)@(N·k, n)
                 # matmul — MXU-sized, no N dense intermediates); vmap-decode
-                # + mean otherwise.
-                if guard is not None:
-                    kept = jnp.sum(okg)
-                    mean_grads = rescale_by_survivors(
-                        decode_mean_tree(
-                            codec, _mask_gathered(gathered, okg), grads,
+                # + mean otherwise (always, under unfused_decode — the
+                # ring-parity decode order).
+                with named_phase("decode_mean"):
+                    if guard is not None:
+                        kept = jnp.sum(okg)
+                        mean_grads = rescale_by_survivors(
+                            decode_mean_tree(
+                                codec, _mask_gathered(gathered, okg), grads,
+                                n_contrib, fused=not unfused_decode,
+                            ),
                             n_contrib,
-                        ),
-                        n_contrib,
-                        kept,
+                            kept,
+                        )
+                    else:
+                        mean_grads = decode_mean_tree(
+                            codec, gathered, grads, n_contrib,
+                            fused=not unfused_decode,
+                        )
+            elif aggregate == "ring":
+                # the streaming form of gather: ppermute rotation, decode
+                # overlapped with transfer, no O(N·payload) buffer — see
+                # _ring_stream_mean for the determinism design
+                with named_phase("ring_exchange_decode"):
+                    mean_grads, ok_stage = _ring_stream_mean(
+                        codec, payloads, grads,
+                        axis=axis, n_dev=n_dev, my=my,
+                        ok=ok, sel=sel, n_contrib=n_contrib,
+                        bucket_size=ring_bucket_size,
                     )
-                else:
-                    mean_grads = decode_mean_tree(
-                        codec, gathered, grads, n_contrib
+                if guard is not None:
+                    # ok_stage comes back sel-subset already (the helper
+                    # applies num_aggregate to flags and slices together)
+                    kept = jnp.sum(ok_stage)
+                    mean_grads = rescale_by_survivors(
+                        mean_grads, n_contrib, kept
                     )
             elif aggregate == "psum":
                 decoded = decode_tree(codec, payloads, grads)
@@ -705,6 +898,7 @@ def distributed_train_loop(
     on_health_failure=None,
     keep_ckpts: int = 0,
     superstep: int = 1,
+    ring_bucket_size: int = 65536,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -876,7 +1070,7 @@ def distributed_train_loop(
             num_aggregate=num_aggregate, compute_dtype=compute_dtype,
             zero1_specs=zero1_specs, grad_accum=grad_accum,
             inner_axis=inner_axis, guard=guard, chaos=chaos,
-            superstep=superstep,
+            superstep=superstep, ring_bucket_size=ring_bucket_size,
         )
     batch_axes = ("dp", inner_axis) if aggregate == "hierarchical" else "dp"
     eval_fn = (

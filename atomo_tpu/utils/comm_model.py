@@ -78,8 +78,9 @@ def choose_aggregate(
     fabric_bw: float,
     tax_s: float | None = None,
     cross_host: bool = False,
+    allow_ring: bool = True,
 ) -> tuple[str, str]:
-    """``--aggregate auto``: pick gather / psum / hierarchical + why.
+    """``--aggregate auto``: pick gather / psum / hierarchical / ring + why.
 
     The reference never had this choice — its one PS pushed every message
     over one 10 GbE fabric (src/distributed_worker.py:330-335). Here the
@@ -96,7 +97,14 @@ def choose_aggregate(
         wire — the quantization noise is the user's algorithm choice, not
         ours to silently drop), so the tax cancels and the choice reduces
         to wire bytes: gather iff P*(N-1) < 2*D*(N-1)/N, i.e.
-        N < 2*(byte reduction). The fabric and tax still decide the
+        N < 2*(byte reduction). Within the gather-wins region, the
+        gathered buffer N*P is checked against the dense gradient D:
+        once it would be the larger transient (N >= byte reduction) the
+        pick upgrades to ``ring`` — the streamed schedule that rotates
+        the same payloads with ppermute, overlaps decode with transfer,
+        and never materializes the buffer (``allow_ring=False`` for
+        callers without the ring step, e.g. the lm layouts). The fabric
+        and tax still decide the
         ADVISORY: when the wire saving at this fabric is smaller than the
         tax, compression itself is costing wall-clock vs dense training
         (--code sgd) and the printed line says so with numbers — the
@@ -133,19 +141,57 @@ def choose_aggregate(
         )
     if tax_s is None:
         tax_s = estimate_codec_tax_s(dense_bytes)
-    saved_s = (ar - ag) / fabric_bw
-    reason = (
-        f"factor all_gather wins at {ways} ways: {ag / 1e6:.2f} MB/chip "
-        f"vs {ar / 1e6:.2f} MB/chip dense (both modes pay the codec "
-        "round trip, so wire bytes decide)"
-    )
-    if saved_s < tax_s:
-        reason += (
+
+    def tax_advisory(saved_s: float) -> str:
+        """The gather pick's honesty NOTE when the wire saving at this
+        fabric is smaller than the codec tax. The ring pick carries a
+        strictly STRONGER always-on note instead (its total wire is >=
+        the dense all-reduce in the whole regime auto selects it, so
+        "saving vs tax" arithmetic is moot there — wire alone already
+        costs more than dense)."""
+        if saved_s >= tax_s:
+            return ""
+        return (
             f"; NOTE on {fabric_bw / 1e9:.2f} GB/s/chip the wire saving "
             f"{saved_s * 1e3:.2f} ms < codec tax ~{tax_s * 1e3:.2f} ms — "
             "compression is costing wall-clock here; dense training "
             "(--code sgd) would be faster end-to-end"
         )
+
+    buf = gather_buffer_bytes(payload_bytes, ways)
+    if allow_ring and buf >= dense_bytes:
+        # the gathered buffer has outgrown a dense gradient (N >= byte
+        # reduction): stream it instead — same payloads, ppermute
+        # rotation with decode overlapped, O(1) live payload memory. The
+        # wire pays the dense/N-sized segment all_gather on top of the
+        # N-1 payload hops (ring_stream_wire_bytes) — cheap next to the
+        # buffer it deletes in exactly this regime.
+        rs = ring_stream_wire_bytes(payload_bytes, dense_bytes, ways)
+        # honesty note, ALWAYS true in this regime: N >= byte reduction
+        # implies P >= D/N, so ring's rotation + segment all_gather moves
+        # at least the dense all-reduce's bytes (rs - ar = (N-1)(P - D/N)
+        # >= 0). The pick trades wire for memory/overlap and the line
+        # says so outright — stronger than the gather path's conditional
+        # saving-vs-tax advisory, which compares a different pair (gather
+        # wire vs dense) and would understate ring's wire cost
+        return (
+            "ring",
+            f"ring-streamed gather at {ways} ways: the gathered buffer "
+            f"would hold {buf / 1e6:.2f} MB/chip >= the {dense_bytes / 1e6:.2f} "
+            f"MB dense gradient; streaming rotates payloads over {ways - 1} "
+            f"ppermute hops with decode overlapped ({rs / 1e6:.2f} MB/chip "
+            f"on the wire incl. the segment all_gather) and never "
+            "materializes the buffer; NOTE total wire >= the "
+            f"{ar / 1e6:.2f} MB/chip dense all-reduce at this N — the pick "
+            "buys O(1) payload memory and decode/transfer overlap, not "
+            "bytes (use --aggregate gather to minimize wire)",
+        )
+    saved_s = (ar - ag) / fabric_bw
+    reason = (
+        f"factor all_gather wins at {ways} ways: {ag / 1e6:.2f} MB/chip "
+        f"vs {ar / 1e6:.2f} MB/chip dense (both modes pay the codec "
+        "round trip, so wire bytes decide)"
+    ) + tax_advisory(saved_s)
     return "gather", reason
 
 
@@ -157,6 +203,37 @@ def ring_allreduce_wire_bytes(dense_bytes: float, ways: int) -> float:
 def ring_allgather_wire_bytes(payload_bytes: float, ways: int) -> float:
     """Per-chip wire traffic of a ring all-gather of per-chip payloads."""
     return float(payload_bytes) * (ways - 1)
+
+
+def ring_stream_wire_bytes(
+    payload_bytes: float, dense_bytes: float, ways: int
+) -> float:
+    """Per-chip wire traffic of ``aggregate='ring'`` — honest accounting.
+
+    Two components, both counted (the Msg(MB) honesty rule): the ppermute
+    rotation sends each chip's payload N-1 times (identical to the ring
+    all_gather's hop count, but the O(N·payload) destination buffer never
+    materializes), PLUS the tiled all_gather of the decoded mean's
+    per-chip segments — dense/N bytes received from each of the other N-1
+    chips. The segment exchange is the price of exact cross-chip
+    determinism (each flat-gradient element is summed by exactly one
+    owner chip and republished); it is what makes ring's replicas
+    bit-identical by construction. Consequence: ring always moves MORE
+    wire bytes than gather (by ~dense_bytes at large N) — its wins are
+    the O(1) live payload memory and the decode/transfer overlap, which
+    is why ``choose_aggregate`` only picks it when the gathered buffer
+    would outgrow a dense gradient (ways >= byte reduction)."""
+    return float(payload_bytes) * (ways - 1) + float(dense_bytes) * (
+        ways - 1
+    ) / ways
+
+
+def gather_buffer_bytes(payload_bytes: float, ways: int) -> float:
+    """Live memory of gather mode's replicated all_gather destination —
+    the O(N·payload) transient ``aggregate='ring'`` eliminates (ring's
+    live payload memory is one rotating payload; its staging transient is
+    one dense-gradient-sized buffer, independent of N)."""
+    return float(payload_bytes) * ways
 
 
 def max_beneficial_ways(dense_bytes: float, payload_bytes: float) -> float:

@@ -51,6 +51,38 @@ def annotate(name: str) -> Iterator[None]:
 
 
 @contextlib.contextmanager
+def named_phase(name: str) -> Iterator[None]:
+    """Name a TRACED region (jax.named_scope): unlike :func:`span`/
+    :func:`annotate`, which mark host wall-time, this labels the ops traced
+    under it so the phase survives INTO the compiled program — XLA HLO op
+    names and jax.profiler device timelines show ``encode``/``exchange``/
+    ``decode_mean``/``ring_exchange_decode`` regions inside the fused step,
+    which is the only place the fused step's phase costs are visible
+    (host spans cannot cut a single XLA program). Used by the aggregation
+    paths in parallel/replicated.py and reported per-phase by bench.py's
+    ring-vs-gather comparison row. No-op when jax lacks named_scope.
+
+    The scope ACQUISITION alone is guarded; the body's ``yield`` stays
+    outside any try/except — a bare ``except: yield`` would swallow
+    exceptions contextlib throws INTO the generator and re-raise them as
+    an opaque "generator didn't stop after throw()", masking real
+    trace-time errors (codec misconfig, shape mismatch) in the hot step.
+    """
+    scope = None
+    try:
+        import jax
+
+        scope = jax.named_scope(name)
+    except Exception:
+        scope = None
+    if scope is None:
+        yield
+    else:
+        with scope:
+            yield
+
+
+@contextlib.contextmanager
 def profile(log_dir: str) -> Iterator[None]:
     """Capture a jax.profiler trace (TensorBoard-loadable) around a block."""
     import jax.profiler

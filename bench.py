@@ -116,6 +116,15 @@ CONFIGS = {
     # vs ~5 ms scanned) shows up or is amortized away. Baseline "none".
     7: dict(metric="train_loop_superstep_step_time", kind="loop",
             network="lenet", dataset="mnist", batch=64, superstep=8, ways=1),
+    # Config 8 (PR-3 ring tentpole): ring-vs-gather aggregation compare on
+    # a REAL multi-device mesh. The locally attached accelerator is one
+    # chip, so this row always runs on a forced 4-virtual-device CPU mesh
+    # (platform recorded honestly): it is a SEMANTICS + dispatch + phase
+    # micro-compare (encode / exchange / decode programs timed separately,
+    # aggregation-operator bit parity asserted in-row), not a chip-speed
+    # claim. Baseline "none".
+    8: dict(metric="ring_vs_gather_dispatch", kind="ringcmp",
+            network="lenet", batch=32, n_dev=4, ways=4, force_cpu_mesh=True),
 }
 
 # Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
@@ -420,6 +429,194 @@ def measure_loop(cfg: dict) -> dict:
     return out
 
 
+def measure_ring_compare(cfg: dict) -> dict:
+    """Config-8: ring vs gather aggregation on a multi-device mesh.
+
+    Times the full distributed step in both modes (dispatch-loop, scalar-
+    fenced) plus the SEPARATELY-JITTED phase programs — encode, gather's
+    exchange (all_gather) and decode (decode_mean), and ring's fused
+    exchange+decode rotation (one program BY DESIGN: the overlap is the
+    tentpole; a host-visible boundary between them would un-fuse it) — and
+    asserts the aggregation-operator bit-parity contract in-row
+    (tests/test_ring_aggregate.py is the oracle; this row is the per-round
+    evidence the artifact carries)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from atomo_tpu.codecs import QsgdCodec, decode_mean_tree, encode_tree
+    from atomo_tpu.models import get_model
+    from atomo_tpu.parallel import (
+        make_distributed_train_step,
+        make_mesh,
+        replicate_state,
+        shard_batch,
+    )
+    from atomo_tpu.parallel.replicated import _ring_stream_mean
+    from atomo_tpu.training import create_state, make_optimizer
+
+    dev = jax.devices()[0]
+    n_dev = min(int(cfg.get("n_dev", 4)), len(jax.devices()))
+    base = dict(
+        metric=cfg["metric"], unit="ms/step", value=None,
+        byte_reduction=None, mfu=None, flops_per_step=None,
+        peak_tflops=None, platform=dev.platform, device=dev.device_kind,
+        ways=n_dev, chips_measured=n_dev,
+        timing="dispatch-loop-scalar-fenced",
+        config=dict(kind="ringcmp", network=cfg["network"],
+                    batch=cfg["batch"], n_dev=n_dev, code="qsgd-4bit"),
+        note=("semantics + dispatch + phase micro-compare on a "
+              f"{n_dev}-device {dev.platform} mesh; not a chip-speed row"),
+    )
+    if n_dev < 2:
+        base.update(measurement_valid=False,
+                    invalid_reason="single device: no mesh to compare on")
+        return base
+
+    mesh = make_mesh(n_dev)
+    model = get_model(cfg["network"], 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (cfg["batch"], 28, 28, 1), jnp.float32)
+    labels = jax.random.randint(rng, (cfg["batch"],), 0, 10)
+    state0 = create_state(model, opt, rng, images)
+    codec = QsgdCodec(bits=4, bucket_size=512)
+    key = jax.random.PRNGKey(1)
+    si, sl = shard_batch(mesh, images, labels)
+    # rep-count override honored ONLY in fast mode — same env discipline
+    # as child_main's STEPS/WARMUP/REPS guard (a stray var must not
+    # silently change the normal protocol)
+    reps = 10
+    if os.environ.get("ATOMO_BENCH_FAST") == "1":
+        reps = int(os.environ.get("ATOMO_BENCH_STEPS", reps))
+
+    def fence(tree):
+        leaf = jax.tree_util.tree_leaves(tree)[0]
+        return float(jnp.sum(leaf).astype(jnp.float32))
+
+    def timed_calls(fn, *args):
+        out = fn(*args)
+        s = fence(out)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        s = fence(out)
+        dt = (time.perf_counter() - t0) / reps
+        if not math.isfinite(s):
+            raise RuntimeError("fence scalar not finite")
+        return dt, out
+
+    out = dict(base, measurement_valid=True, invalid_reason=None)
+    try:
+        # --- full steps, both modes (fresh deep-copied states: donation)
+        def fresh():
+            return replicate_state(
+                mesh, jax.tree_util.tree_map(jnp.array, state0)
+            )
+
+        step_times = {}
+        stepped = {}
+        for mode in ("gather", "ring"):
+            step = make_distributed_train_step(
+                model, opt, mesh, codec, aggregate=mode
+            )
+            st = fresh()
+            for _ in range(3):  # warm: compile + settle the program
+                st, m = step(st, key, si, sl)
+                if not math.isfinite(float(m["loss"])):
+                    raise RuntimeError(f"{mode} loss not finite")
+            # dispatch loop over the warm program
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                st, m = step(st, key, si, sl)
+            float(m["loss"])
+            step_times[mode] = (time.perf_counter() - t0) / reps
+            stepped[mode] = jax.device_get(st)
+        out["value"] = round(step_times["ring"] * 1e3, 3)
+        out["gather_ms_per_step"] = round(step_times["gather"] * 1e3, 3)
+        out["ring_vs_gather_step_ratio"] = round(
+            step_times["gather"] / step_times["ring"], 3
+        )
+        out["step_param_maxdiff"] = float(max(
+            np.max(np.abs(np.asarray(a) - np.asarray(b)))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(stepped["gather"].params),
+                jax.tree_util.tree_leaves(stepped["ring"].params),
+            )
+        ))
+
+        # --- phase programs over a fixed gradient-shaped tree
+        grads = jax.tree_util.tree_map(
+            lambda a: jax.random.normal(
+                jax.random.PRNGKey(7), a.shape, jnp.float32
+            ),
+            jax.device_get(state0).params,
+        )
+
+        def sm(fn, in_specs, out_specs):
+            return jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            ))
+
+        def enc(g):
+            my = jax.lax.axis_index("dp")
+            p, _ = encode_tree(codec, jax.random.fold_in(key, my), g)
+            return jax.tree_util.tree_map(lambda a: a[None], p)
+
+        enc_fn = sm(enc, (P(),), P("dp"))
+        dt_enc, payloads_x = timed_calls(enc_fn, grads)
+        out["encode_ms"] = round(dt_enc * 1e3, 3)
+
+        def gx(px):
+            local = jax.tree_util.tree_map(lambda a: a[0], px)
+            return jax.lax.all_gather(local, "dp")
+
+        gx_fn = sm(gx, (P("dp"),), P())
+        dt_gx, gathered = timed_calls(gx_fn, payloads_x)
+        out["gather_exchange_ms"] = round(dt_gx * 1e3, 3)
+
+        dec_fn = sm(
+            lambda gth: decode_mean_tree(codec, gth, grads, n_dev),
+            (P(),), P(),
+        )
+        dt_dec, mean_g = timed_calls(dec_fn, gathered)
+        out["gather_decode_ms"] = round(dt_dec * 1e3, 3)
+
+        def ring_exdec(px):
+            my = jax.lax.axis_index("dp")
+            local = jax.tree_util.tree_map(lambda a: a[0], px)
+            # bucket_size matches the full step's default packing layout,
+            # so the phase timing decomposes the program the step runs
+            mean, _ = _ring_stream_mean(
+                codec, local, grads, axis="dp", n_dev=n_dev, my=my,
+                n_contrib=n_dev, bucket_size=65536,
+            )
+            return mean
+
+        ring_fn = sm(ring_exdec, (P("dp"),), P())
+        dt_ring, mean_r = timed_calls(ring_fn, payloads_x)
+        out["ring_exchange_decode_ms"] = round(dt_ring * 1e3, 3)
+        out["aggregation_bit_parity"] = bool(all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(jax.device_get(mean_g)),
+                jax.tree_util.tree_leaves(jax.device_get(mean_r)),
+            )
+        ))
+        if not out["aggregation_bit_parity"]:
+            _mark_invalid(
+                out,
+                "ring aggregation operator is NOT bit-identical to "
+                "gather's decode-mean (the PR-3 contract)",
+            )
+    except Exception as exc:  # noqa: BLE001 — a failed compare is a failed row
+        _mark_invalid(out, f"ring compare failed: {str(exc)[:200]}")
+    return out
+
+
 def measure_ours(cfg: dict) -> dict:
     import jax
     import jax.numpy as jnp
@@ -432,6 +629,8 @@ def measure_ours(cfg: dict) -> dict:
         return measure_lm(cfg)
     if cfg.get("kind") == "loop":
         return measure_loop(cfg)
+    if cfg.get("kind") == "ringcmp":
+        return measure_ring_compare(cfg)
 
     model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
@@ -958,6 +1157,14 @@ def child_main(args) -> int:
     global STEPS, WARMUP, REPS
     _honor_platform_env()
     _backend_or_die()
+    # opt-in persistent XLA compile cache (ATOMO_COMPILE_CACHE=dir): ladder
+    # re-runs and restarted rounds skip recompiling identical programs —
+    # measured step times are unaffected (warmup runs either way), only
+    # the compile wall-time ahead of them shrinks. Logged to stderr so the
+    # stdout JSON contract stays clean.
+    from atomo_tpu.compat import enable_compile_cache
+
+    enable_compile_cache(log_fn=lambda m: print(m, file=sys.stderr, flush=True))
     cfg = dict(CONFIGS[args.config if args.config is not None else 2])
     fast = os.environ.get("ATOMO_BENCH_FAST") == "1"
     if fast:
@@ -1072,31 +1279,91 @@ def _run_child(
     return None, f"rc={rc}: " + " | ".join(tail)
 
 
-def _probe_tpu() -> bool:
+def _probe_tpu() -> tuple[bool, dict]:
     """ONE cheap TPU-reachability probe before the ladder. When the axon
     relay is down, every TPU attempt burns BACKEND_TIMEOUT_S before dying;
     at RETRIES x 6 configs that is hours — round 4 lost its entire bench
     window to exactly this (BENCH_r04.json: rc=124, empty tail). One probe
     up front turns a dead relay into ~5 lost minutes + an honest CPU
-    ladder."""
+    ladder.
+
+    Returns (ok, diagnostics): the probe's rc and FULL captured stderr
+    tail travel into the JSON artifact, so a failed probe explains itself
+    (three rounds of zero-valid-TPU-rows had nothing but rc=124 to debug
+    from — the artifact now records WHY the chip was unreachable)."""
     code = (
         "import bench, sys; bench._honor_platform_env(); "
         "d = bench._backend_or_die(); "
         "sys.exit(0 if d and d[0].platform == 'tpu' else 3)"
     )
+    timeout_s = min(BACKEND_TIMEOUT_S + 60, max(30, _remaining() - 300))
     try:
-        rc = subprocess.run(
+        p = subprocess.run(
             [sys.executable, "-c", code],
             cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
             # clamped to the ladder budget: a wedged relay dial must not
             # eat the window the CPU fallback needs (r05's rc=124)
-            timeout=min(BACKEND_TIMEOUT_S + 60, max(30, _remaining() - 300)),
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-        ).returncode
-        return rc == 0
-    except subprocess.TimeoutExpired:
-        return False
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        diag = {
+            "ok": p.returncode == 0,
+            "rc": p.returncode,
+            # stderr carries the backend-init diagnostics (relay dial
+            # errors, plugin registration failures); keep a generous tail
+            "stderr": (p.stderr or "").strip()[-4000:],
+        }
+        return p.returncode == 0, diag
+    except subprocess.TimeoutExpired as e:
+        err = e.stderr or b""
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        return False, {
+            "ok": False,
+            "rc": None,
+            "stderr": (
+                f"probe timed out after {timeout_s:.0f}s; partial stderr: "
+                + err.strip()[-4000:]
+            ),
+        }
+
+
+# ------------------------------------------------------ partial artifact
+# Every completed ladder row is ALSO written to a JSON artifact file
+# ATOMICALLY (tmp + os.replace) as it lands, so a driver timeout (rc=124,
+# SIGKILL) mid-ladder leaves a parseable artifact with every finished row
+# plus the TPU probe diagnostics — the three-round zero-valid-TPU-rows
+# failure mode becomes debuggable and partial evidence survives. Disable
+# with ATOMO_BENCH_ARTIFACT="" (e.g. for pure-stdout consumers).
+_ARTIFACT: dict = {"rows": [], "tpu_probe": None, "complete": False}
+
+
+def _artifact_path() -> str:
+    return os.environ.get(
+        "ATOMO_BENCH_ARTIFACT", os.path.join("artifacts", "bench_partial.json")
+    )
+
+
+def _write_artifact() -> None:
+    path = _artifact_path()
+    if not path:
+        return
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(_ARTIFACT, f, indent=1)
+        os.replace(tmp, path)  # atomic on POSIX: readers never see a torn file
+    except OSError as exc:
+        print(f"bench artifact write failed: {exc}", file=sys.stderr)
+
+
+def _record_row(row: dict) -> None:
+    _ARTIFACT["rows"].append(row)
+    _write_artifact()
 
 
 def _bench_one(config: int, no_baseline: bool, try_tpu: bool = True) -> dict:
@@ -1108,6 +1375,31 @@ def _bench_one(config: int, no_baseline: bool, try_tpu: bool = True) -> dict:
     tail = ["--config", str(config)]
     if no_baseline:
         tail.append("--no-baseline")
+    if cfg.get("force_cpu_mesh"):
+        # config 8 (ring-vs-gather): a multi-device SEMANTICS/dispatch
+        # compare — always a forced 4-virtual-device CPU mesh (the local
+        # accelerator is one chip; platform is recorded in the row). One
+        # child, no TPU attempts, no degraded-fast-mode fallback.
+        flags = (os.environ.get("XLA_FLAGS", "")
+                 + " --xla_force_host_platform_device_count="
+                 + str(cfg.get("n_dev", 4))).strip()
+        # baseline is "none" by design for this row: build the child args
+        # explicitly rather than conditioning on the tail's contents
+        parsed, err = _run_child(
+            ["--config", str(config), "--no-baseline"],
+            {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags},
+            timeout_s=int(min(CHILD_TIMEOUT_S, max(45, _remaining() - 10))),
+        )
+        if parsed is not None:
+            return parsed
+        return dict(
+            metric=cfg["metric"], value=None, unit="ms/step",
+            vs_baseline=None, baseline="none", byte_reduction=None,
+            mfu=None, platform=None, device=None, chips_measured=0,
+            measurement_valid=False,
+            invalid_reason="ring compare child failed",
+            error=err,
+        )
     last_err = "unknown"
     # ATOMO_BENCH_RETRIES: an orchestrator that retries whole invocations
     # across relay windows (scripts/onchip_queue_r5b.sh) sets this to 1 so
@@ -1183,10 +1475,21 @@ def main() -> int:
     )
     if args.config is not None and args.all:
         ap.error("--config and --all are mutually exclusive")
+    _ARTIFACT.update(rows=[], complete=False, tpu_probe=None)  # fresh run
     if args.config is not None:
-        print(json.dumps(_bench_one(args.config, args.no_baseline)))
+        row = _bench_one(args.config, args.no_baseline)
+        _record_row(row)
+        _ARTIFACT["complete"] = True
+        _write_artifact()
+        print(json.dumps(row))
         return 0
-    try_tpu = os.environ.get("JAX_PLATFORMS", "") != "cpu" and _probe_tpu()
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        try_tpu = False
+        _ARTIFACT["tpu_probe"] = {"ok": False, "skipped": "JAX_PLATFORMS=cpu"}
+    else:
+        try_tpu, probe_diag = _probe_tpu()
+        _ARTIFACT["tpu_probe"] = probe_diag
+    _write_artifact()  # probe diagnostics land BEFORE any (slow) config
     # default: the whole BASELINE.md ladder (VERDICT r2 next-round #4) —
     # one row per config as it completes, then an aggregate headline line
     # (config 2's fields + all rows so far under "configs"). The HEADLINE
@@ -1197,12 +1500,15 @@ def main() -> int:
     rows = {}
     for c in [2] + [k for k in sorted(CONFIGS) if k != 2]:
         rows[c] = _bench_one(c, args.no_baseline, try_tpu=try_tpu)
+        _record_row(rows[c])  # atomic: partial results survive rc=124
         print(json.dumps(rows[c]), flush=True)
         if 2 in rows:
             headline = dict(rows[2])
             headline["configs"] = [rows[k] for k in sorted(rows)]
             headline["configs_complete"] = len(rows) == len(CONFIGS)
             print(json.dumps(headline), flush=True)
+    _ARTIFACT["complete"] = True
+    _write_artifact()
     return 0
 
 

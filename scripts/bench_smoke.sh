@@ -1,31 +1,36 @@
 #!/usr/bin/env bash
-# Bench smoke (<60 s): run ONE cheap ladder config — 7, the shipped-loop
-# superstep row (lenet, synthetic data, no side-compares) — on the CPU
-# backend in fast mode, and validate the JSON contract the driver parses
-# (metric/value/unit/measurement_valid/platform on the LAST line).
+# Bench smoke (~3 min): prove the bench entrypoint still emits parseable
+# evidence without burning the full-ladder window. Three checks:
+#
+#   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
+#      the driver's last-line JSON contract, PLUS the partial-artifact
+#      file the row must also land in (PR-3 evidence hardening).
+#   2. config 8 (ring-vs-gather dispatch micro-compare, forced 4-device
+#      CPU mesh) — per-phase encode/exchange/decode timings present and
+#      the aggregation-operator bit-parity contract holds in-row.
+#   3. the kill contract: SIGKILL a full-ladder run mid-flight; the JSON
+#      artifact must still parse with whatever rows completed (rc=124
+#      resilience — the three-round zero-valid-TPU-rows failure mode).
 #
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
-# the bench entrypoint still emits parseable rows without burning the
-# full-ladder window. A failure here means the driver's end-of-round
-# bench pass would have produced nothing useful.
-# Usage: scripts/bench_smoke.sh   (from the repo root or anywhere)
+# the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
 cd "$(dirname "$0")/.." || exit 2
 set -o pipefail
-# JAX_PLATFORMS=cpu makes the first child attempt a real CPU measurement
-# (valid row); the internal deadline stays above the 120 s attempt floor
-# so that attempt actually runs — the OUTER timeout is the <60 s cap.
-out=$(timeout -k 5 55 env JAX_PLATFORMS=cpu ATOMO_BENCH_FAST=1 \
+art=$(mktemp -d)
+trap 'rm -rf "$art"' EXIT
+
+# --- 1: config 7, JSON + artifact contract -------------------------------
+out=$(timeout -k 5 90 env JAX_PLATFORMS=cpu ATOMO_BENCH_FAST=1 \
       ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=240 \
+      ATOMO_BENCH_ARTIFACT="$art/c7.json" \
       python bench.py --config 7 --no-baseline 2>/dev/null)
 rc=$?
 if [ $rc -ne 0 ]; then
-  echo "bench_smoke FAIL: bench.py exited rc=$rc (timeout or crash)"
+  echo "bench_smoke FAIL: config 7 exited rc=$rc (timeout or crash)"
   exit 1
 fi
-tmp=$(mktemp)
-trap 'rm -f "$tmp"' EXIT
-printf '%s\n' "$out" > "$tmp"
-python - "$tmp" <<'EOF'
+printf '%s\n' "$out" > "$art/c7.out"
+python - "$art/c7.out" "$art/c7.json" <<'EOF'
 import json, sys
 
 lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
@@ -37,9 +42,68 @@ missing = [k for k in
 assert not missing, f"bench_smoke FAIL: missing keys {missing}: {row}"
 assert row["unit"] == "ms/step", row
 assert row["metric"] == "train_loop_superstep_step_time", row
+doc = json.load(open(sys.argv[2]))  # the atomic partial artifact
+assert doc["complete"] is True and len(doc["rows"]) == 1, doc
+assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/3]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
-      f"amortization={row.get('dispatch_amortization')}]")
+      f"amortization={row.get('dispatch_amortization')}] + artifact")
+EOF
+[ $? -ne 0 ] && exit 1
+
+# --- 2: config 8, ring-vs-gather micro-compare ---------------------------
+out=$(timeout -k 5 150 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=240 \
+      ATOMO_BENCH_ARTIFACT="$art/c8.json" \
+      python bench.py --config 8 --no-baseline 2>/dev/null)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: config 8 exited rc=$rc (timeout or crash)"
+  exit 1
+fi
+printf '%s\n' "$out" > "$art/c8.out"
+python - "$art/c8.out" <<'EOF'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+assert lines, "bench_smoke FAIL: config 8 emitted no JSON"
+row = json.loads(lines[-1])
+assert row["metric"] == "ring_vs_gather_dispatch", row
+assert row["measurement_valid"], row.get("invalid_reason")
+for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
+          "ring_exchange_decode_ms", "gather_ms_per_step"):
+    assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
+assert row["aggregation_bit_parity"] is True, row
+print(f"bench_smoke OK[2/3]: ring {row['value']} vs gather "
+      f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
+      f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
+      f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
+EOF
+[ $? -ne 0 ] && exit 1
+
+# --- 3: kill mid-ladder, artifact still parses ---------------------------
+env JAX_PLATFORMS=cpu ATOMO_BENCH_FAST=1 ATOMO_BENCH_RETRIES=1 \
+    ATOMO_BENCH_DEADLINE_S=600 ATOMO_BENCH_ARTIFACT="$art/killed.json" \
+    python bench.py --all --no-baseline >/dev/null 2>&1 &
+pid=$!
+# wait for the FIRST atomic write (probe record) before killing — a fixed
+# sleep races bench startup on a loaded host and fails spuriously
+for _ in $(seq 1 60); do
+  [ -f "$art/killed.json" ] && break
+  sleep 1
+done
+sleep 2  # let it get a little further into the ladder before the kill
+kill -9 "$pid" 2>/dev/null
+wait "$pid" 2>/dev/null
+python - "$art/killed.json" <<'EOF'
+import json, sys
+
+doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
+assert doc["complete"] is False
+assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
+assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
+print(f"bench_smoke OK[3/3]: killed ladder left a parseable artifact "
+      f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF

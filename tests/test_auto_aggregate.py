@@ -59,6 +59,29 @@ def test_wire_bytes_decide_with_a_codec_and_ici_carries_the_advisory():
     assert mode_eth == "gather" and "NOTE" not in why_eth
 
 
+def test_buffer_outgrowing_dense_picks_ring():
+    """PR-3: within the compression-wins region, once the gathered buffer
+    N*P would exceed the dense gradient D (N >= byte reduction, here
+    ~71.8), auto upgrades gather to the ring stream — same payloads, no
+    O(N) buffer, decode overlapped — and says so with the byte numbers."""
+    mode, why = choose_aggregate(
+        has_codec=True, ways=100, fabric_bw=FABRICS["ici"], **R18
+    )
+    assert mode == "ring"
+    assert "ppermute" in why and "buffer" in why
+    # below the reduction the buffer is small: plain gather, unchanged
+    mode, _ = choose_aggregate(
+        has_codec=True, ways=64, fabric_bw=FABRICS["ici"], **R18
+    )
+    assert mode == "gather"
+    # callers without the ring step (lm layouts) opt out
+    mode, why = choose_aggregate(
+        has_codec=True, ways=100, fabric_bw=FABRICS["ici"], allow_ring=False,
+        **R18,
+    )
+    assert mode == "gather"
+
+
 def test_past_twice_reduction_ways_is_psum():
     """Compression stops paying at N >= 2x byte reduction (gather traffic
     P*(N-1) crosses the saturating dense all-reduce 2D(N-1)/N): at 200

@@ -9,9 +9,11 @@ import math
 from atomo_tpu.utils.comm_model import (
     crossover_bandwidth,
     crossover_report,
+    gather_buffer_bytes,
     max_beneficial_ways,
     ring_allgather_wire_bytes,
     ring_allreduce_wire_bytes,
+    ring_stream_wire_bytes,
 )
 
 D = 44.7e6  # dense ResNet-18 gradient bytes
@@ -23,6 +25,29 @@ def test_wire_byte_formulas():
     assert ring_allreduce_wire_bytes(D, 2) == D
     assert abs(ring_allreduce_wire_bytes(D, 1 << 20) - 2 * D) < 1e-3 * D
     assert ring_allgather_wire_bytes(P, 8) == P * 7
+
+
+def test_ring_stream_wire_and_buffer_accounting():
+    """PR-3 Msg(MB) honesty: ring mode's wire = the N-1 ppermute payload
+    hops (exactly the ring all_gather's hop traffic) PLUS the dense/N
+    segment all_gather it pays for exact cross-chip determinism; the win
+    it buys is the O(N·payload) gathered buffer never existing."""
+    n = 8
+    assert ring_stream_wire_bytes(P, D, n) == (
+        ring_allgather_wire_bytes(P, n) + D * (n - 1) / n
+    )
+    # ring ALWAYS moves more wire than gather — the accounting must never
+    # pretend otherwise (the model's stated reason ring is a memory/
+    # overlap tool, not a bytes tool)
+    for ways in (2, 8, 64, 256):
+        assert ring_stream_wire_bytes(P, D, ways) > ring_allgather_wire_bytes(
+            P, ways
+        )
+    # the buffer ring deletes grows linearly with N; dense-gradient-sized
+    # at exactly N = byte reduction
+    assert gather_buffer_bytes(P, 8) == 8 * P
+    n_eq = D / P
+    assert abs(gather_buffer_bytes(P, n_eq) - D) < 1e-6 * D
 
 
 def test_max_beneficial_ways_is_twice_reduction():

@@ -341,6 +341,12 @@ def _dist_setup(mode):
         mesh = make_mesh(2)
         kw = dict(codec=None, aggregate="psum")
         axes = "dp"
+    elif mode == "ring":
+        # PR-3: the ring-streamed exchange must ride the superstep scan
+        # with the same partition invariance as every other mode
+        mesh = make_mesh(2)
+        kw = dict(codec=QsgdCodec(bits=4, bucket_size=128), aggregate="ring")
+        axes = "dp"
     else:  # gather / zero1: the compressed-wire flagship
         mesh = make_mesh(2)
         kw = dict(codec=QsgdCodec(bits=4, bucket_size=128), aggregate="gather")
@@ -367,7 +373,7 @@ def _dist_run_blocks(step_fn, state, key, batches, sizes, mesh, axes):
     return state, flat
 
 
-@pytest.mark.parametrize("mode", ["gather", "psum", "hierarchical", "zero1"])
+@pytest.mark.parametrize("mode", ["gather", "ring", "psum", "hierarchical", "zero1"])
 def test_distributed_superstep_partition_invariant(mode):
     """(a) distributed: K fused SPMD steps == K sequential dispatches of
     the same fused program, bitwise, for every aggregate mode (compressed
