@@ -94,6 +94,20 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                         "comm-cost model and prints why "
                         "(utils/comm_model.choose_aggregate, "
                         "artifacts/COMM_CROSSOVER.md)")
+    t.add_argument("--overlap", type=str, default="off",
+                   choices=["off", "delayed"],
+                   help="delayed = stale-by-one overlapped aggregation: at "
+                        "step t each chip computes and encodes grads_t "
+                        "while the optimizer applies the step-(t-1) "
+                        "decoded mean, so the gather/ring exchange and the "
+                        "decode run underneath fwd/bwd+update and leave "
+                        "the critical path (needs a compressing --code and "
+                        "--aggregate gather|ring on a multi-device mesh). "
+                        "Step 0 applies a zero (skipped) update; the guard "
+                        "health flag travels with the delayed payload; "
+                        "checkpoints carry the in-flight payload so resume "
+                        "is exact. off (default) = the blocking program, "
+                        "byte-for-byte as before")
     t.add_argument("--ring-bucket-size", type=int, default=65536, metavar="N",
                    help="ring aggregation: elements per packed rotation "
                         "bucket (parallel.common.pack_tree_buckets) — every "
@@ -449,6 +463,31 @@ def cmd_train(args: argparse.Namespace) -> int:
         )
         superstep = 1
     n_dev = args.n_devices or len(jax.devices())
+    if args.overlap == "delayed":
+        # the delayed mode's requirements are all knowable from argv +
+        # device count: fail fast with the reason, never at trace time
+        if args.code.lower() in DENSE_CODES:
+            raise SystemExit(
+                "--overlap delayed needs a compressing --code (the mode "
+                "overlaps the encoded exchange+decode; dense training has "
+                "no delayed form)"
+            )
+        if n_dev <= 1:
+            raise SystemExit(
+                "--overlap delayed needs a multi-device mesh: single-device "
+                "training has no exchange to take off the critical path"
+            )
+        if args.aggregate in ("psum", "hierarchical"):
+            raise SystemExit(
+                f"--overlap delayed does not compose with --aggregate "
+                f"{args.aggregate} (only the compressed gather/ring "
+                "exchanges have a delayed form)"
+            )
+        if args.phase_metrics:
+            raise SystemExit(
+                "--phase-metrics times blocking phase programs and cannot "
+                "describe the overlapped step; drop one of the flags"
+            )
     if n_dev > 1:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
         from atomo_tpu.training import stepwise_shrink
@@ -470,8 +509,18 @@ def cmd_train(args: argparse.Namespace) -> int:
                 )["params"]
 
             args.aggregate = _resolve_auto_aggregate(
-                args, codec, _init_params, n_dev
+                args, codec, _init_params, n_dev,
+                allow_hierarchical=args.overlap != "delayed",
             )
+            if args.overlap == "delayed" and args.aggregate not in (
+                "gather", "ring",
+            ):
+                raise SystemExit(
+                    "--overlap delayed: --aggregate auto resolved to "
+                    f"{args.aggregate!r} for this byte budget; pass "
+                    "--aggregate gather or ring explicitly to keep the "
+                    "overlapped schedule, or drop --overlap"
+                )
             if (
                 args.num_aggregate is not None
                 and codec is not None
@@ -530,6 +579,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             compute_dtype=jnp.bfloat16 if args.bf16 else None,
             superstep=superstep,
             ring_bucket_size=args.ring_bucket_size,
+            overlap=args.overlap,
         )
     else:
         from atomo_tpu.training import train_loop

@@ -117,9 +117,25 @@ def encode_tree(
     return jax.tree_util.tree_unflatten(treedef, payloads), stats
 
 
+def _shape_groups(leaves) -> dict:
+    """Group leaf indices by (shape, dtype) — the same bucketing key
+    ``encode_tree(bucketed=True)`` uses: same-shaped gradient leaves have
+    structurally identical payloads, so one vmapped decode serves them
+    all. Dict preserves insertion order, so grouping is deterministic."""
+    groups: dict = {}
+    for i, leaf in enumerate(leaves):
+        groups.setdefault((tuple(leaf.shape), str(leaf.dtype)), []).append(i)
+    return groups
+
+
+def _stack_payloads(p_list):
+    """Stack structurally-identical payloads along a new leading axis."""
+    return jax.tree_util.tree_map(lambda *a: jnp.stack(a), *p_list)
+
+
 def decode_mean_tree(
     codec: Codec, gathered: Any, grads_like: Any, n_replicas: int,
-    fused: bool = True,
+    fused: bool = True, bucketed: bool = True,
 ) -> Any:
     """Decode all_gather-ed payloads (leading axis = replica) and average.
 
@@ -137,34 +153,90 @@ def decode_mean_tree(
     axis and differs from the canonical mean in the last mantissa bits
     (~1e-6 relative, same class as XLA fusion drift — measured). Codecs
     without a fused kernel (qsgd/terngrad/dense) are identical either way.
+
+    ``bucketed=True`` (default) groups the leaves that take the
+    vmap-decode path by (shape, dtype) — the encode_tree(bucketed=True)
+    mirror: a deep ResNet has dozens of identically-shaped conv kernels,
+    and one doubly-vmapped decode+mean per group keeps the device busy
+    where a chain of per-leaf calls would serialize. Bit-identical to the
+    per-leaf path (vmap of the same decode arithmetic — a batching
+    transform, not a reassociation; pinned per codec in
+    tests/test_codecs.py), so the ring/gather parity contracts are
+    untouched. Leaves served by a fused ``decode_mean`` kernel are not
+    grouped (each is already one matmul).
     """
     leaves, treedef = jax.tree_util.tree_flatten(grads_like)
     p_leaves = treedef.flatten_up_to(gathered)
-    out = []
-    for p, g in zip(p_leaves, leaves):
+    out: list = [None] * len(leaves)
+    pending: list = []  # indices taking the vmap-decode + mean path
+    for i, (p, g) in enumerate(zip(p_leaves, leaves)):
         fused_fn = getattr(codec, "decode_mean", None) if fused else None
         if fused_fn is not None:
             decoded = fused_fn(p, tuple(g.shape), g.dtype, n_replicas)
             if decoded is not None:
-                out.append(decoded)
+                out[i] = decoded
                 continue
-        decoded = jax.vmap(
-            lambda q: codec.decode(q, tuple(g.shape), g.dtype)
-        )(p)
-        out.append(jnp.mean(decoded, axis=0))
+        pending.append(i)
+
+    def vmap_mean(p, shape, dtype):
+        decoded = jax.vmap(lambda q: codec.decode(q, shape, dtype))(p)
+        return jnp.mean(decoded, axis=0)
+
+    if bucketed and pending:
+        groups = _shape_groups([leaves[i] for i in pending])
+        for (shape, _), local in groups.items():
+            idxs = [pending[j] for j in local]
+            g0 = leaves[idxs[0]]
+            if len(idxs) == 1:
+                out[idxs[0]] = vmap_mean(
+                    p_leaves[idxs[0]], tuple(g0.shape), g0.dtype
+                )
+                continue
+            stacked = _stack_payloads([p_leaves[i] for i in idxs])
+            batch = jax.vmap(
+                lambda q: vmap_mean(q, tuple(g0.shape), g0.dtype)
+            )(stacked)
+            for j, i in enumerate(idxs):
+                out[i] = batch[j]
+    else:
+        for i in pending:
+            g = leaves[i]
+            out[i] = vmap_mean(p_leaves[i], tuple(g.shape), g.dtype)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
-def decode_tree(codec: Codec, payloads: Any, grads_like: Any) -> Any:
+def decode_tree(
+    codec: Codec, payloads: Any, grads_like: Any, bucketed: bool = True
+) -> Any:
     """Decode a pytree of payloads back into a gradient pytree.
 
     ``grads_like`` supplies the treedef; payloads produced by ``encode_tree``
-    are unflattened against it.
+    are unflattened against it. ``bucketed=True`` (default) decodes
+    same-(shape, dtype) leaf groups with ONE vmapped call — the exact
+    mirror of ``encode_tree(bucketed=True)``'s shape bucketing, and
+    bit-identical to the per-leaf loop (tested per codec); pass
+    ``bucketed=False`` for the reference per-leaf path.
     """
     leaves, treedef = jax.tree_util.tree_flatten(grads_like)
     p_leaves = treedef.flatten_up_to(payloads)
-    decoded = [
-        codec.decode(p, tuple(g.shape), g.dtype)
-        for p, g in zip(p_leaves, leaves)
-    ]
-    return jax.tree_util.tree_unflatten(treedef, decoded)
+    if not bucketed:
+        decoded = [
+            codec.decode(p, tuple(g.shape), g.dtype)
+            for p, g in zip(p_leaves, leaves)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, decoded)
+    out: list = [None] * len(leaves)
+    for (shape, _), idxs in _shape_groups(leaves).items():
+        g0 = leaves[idxs[0]]
+        if len(idxs) == 1:
+            out[idxs[0]] = codec.decode(
+                p_leaves[idxs[0]], tuple(g0.shape), g0.dtype
+            )
+            continue
+        stacked = _stack_payloads([p_leaves[i] for i in idxs])
+        batch = jax.vmap(
+            lambda q: codec.decode(q, tuple(g0.shape), g0.dtype)
+        )(stacked)
+        for j, i in enumerate(idxs):
+            out[i] = batch[j]
+    return jax.tree_util.tree_unflatten(treedef, out)

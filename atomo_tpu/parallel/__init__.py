@@ -12,7 +12,11 @@ from atomo_tpu.parallel.launch import (  # noqa: F401
     initialize,
 )
 from atomo_tpu.parallel.replicated import (  # noqa: F401
+    DelayedState,
+    OverlapCarry,
     distributed_train_loop,
+    init_delayed_state,
+    make_delayed_oracle_steps,
     make_distributed_eval_step,
     make_distributed_train_step,
     make_phase_train_steps,

@@ -45,6 +45,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Any, Optional
 
+import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
@@ -77,12 +78,135 @@ from atomo_tpu.training.trainer import (
 from atomo_tpu.utils.metrics import accuracy
 
 
+@flax.struct.dataclass
+class OverlapCarry:
+    """The in-flight aggregation of ``--overlap delayed`` (stale-by-one).
+
+    ``payload``: every chip's ENCODED gradient from the previous step, kept
+    with a leading per-chip axis (global shape ``(n_dev, ...)`` sharded over
+    the dp axis) so it round-trips program boundaries — between superstep
+    dispatches, and through checkpoints (resume restores the in-flight
+    payload, which is what makes kill->restart->resume bit-exact).
+
+    The carry holds the *encoded* payload, not the decoded mean, on
+    purpose: the consuming step's exchange+decode chain then reads ONLY
+    step-start values and is dataflow-independent of that step's
+    forward/backward, which is the property that lets the scheduler run
+    the collective chain and the decode underneath fwd/bwd+update. A
+    decoded-mean carry would force the exchange to run at the *producing*
+    step, serialized behind its own backward pass — no overlap.
+
+    ``ok``: the producing step's per-chip guard health flags ((n_dev,)
+    float32; all-ones when the guard is off). They travel WITH the payload
+    so a NaN source poisons the step that *consumes* it — the consuming
+    step masks, rescales by n/kept, and skips only at zero survivors.
+
+    ``valid``: () float32, 0.0 until the first payload is in flight. Step
+    0 consumes nothing: it applies a zero (skipped) update — params, opt
+    state and BN stats all hold — and ``metrics["skipped"]`` is 1.
+    """
+
+    payload: Any
+    ok: jax.Array
+    valid: jax.Array
+
+
+@flax.struct.dataclass
+class DelayedState:
+    """``TrainState`` + :class:`OverlapCarry` — what a ``--overlap
+    delayed`` step consumes and returns (and what its checkpoints hold).
+    Exposes ``step``/``params``/``batch_stats`` so loop code (eval,
+    logging, profiling) reads it exactly like a TrainState."""
+
+    train: TrainState
+    carry: OverlapCarry
+
+    @property
+    def step(self):
+        return self.train.step
+
+    @property
+    def params(self):
+        return self.train.params
+
+    @property
+    def batch_stats(self):
+        return self.train.batch_stats
+
+
+def _zero_carry_host(codec, params, n_dev: int) -> OverlapCarry:
+    """Host-side all-zero carry (the step-0 'nothing in flight' value and
+    the resume template). Zero payloads decode to zero for every codec
+    (the _mask_gathered invariant), but the consuming step never reads
+    them: ``valid=0`` gates a full skip. ``ok`` starts at ones so the
+    step-0 metrics report dropped=0 (the payload is absent, not
+    anomalous)."""
+    shapes = jax.eval_shape(
+        lambda p: encode_tree(codec, jax.random.PRNGKey(0), p)[0], params
+    )
+    payload = jax.tree_util.tree_map(
+        lambda s: jnp.zeros((n_dev,) + tuple(s.shape), s.dtype), shapes
+    )
+    return OverlapCarry(
+        payload=payload,
+        ok=jnp.ones((n_dev,), jnp.float32),
+        valid=jnp.float32(0.0),
+    )
+
+
+def init_delayed_state(
+    mesh: Mesh, state: TrainState, codec, *, axis: str = "dp"
+) -> DelayedState:
+    """Wrap a (replicated or ZeRO-1) TrainState into the fresh
+    :class:`DelayedState` a ``--overlap delayed`` step consumes: zero
+    payload sharded over ``axis``, all-healthy flags, ``valid=0``."""
+    n_dev = mesh.shape[axis]
+    carry = _zero_carry_host(codec, jax.device_get(state.params), n_dev)
+    sh = NamedSharding(mesh, P(axis))
+    return DelayedState(
+        train=state,
+        carry=OverlapCarry(
+            payload=jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sh), carry.payload
+            ),
+            ok=jax.device_put(carry.ok, sh),
+            valid=jax.device_put(carry.valid, NamedSharding(mesh, P())),
+        ),
+    )
+
+
 def _zero1_chunk(flat_size: int, n_dev: int) -> int:
     """Per-chip slice length of the flat ZeRO-1 buffers. ONE definition:
     the train step's dynamic slices and zero1_state's allocations must
     agree exactly or every momentum slice silently misaligns with its
     parameter slice."""
     return -(-flat_size // n_dev)
+
+
+def _zero1_sliced_update(
+    optimizer, params, opt_state, mean_grads, my, n_slices, gather_axes
+):
+    """ZeRO-1 sliced optimizer update — ONE definition shared by the
+    blocking and delayed steps: ravel params/grads flat, update only this
+    chip's 1/n_slices chunk of the padded vectors, and reassemble the
+    replicated params with a tiled all_gather over ``gather_axes`` (a
+    single axis name, or the (outer, inner) tuple in hierarchical mode —
+    the caller passes ``my`` as the matching flat chip id). Returns
+    (new_params, new_opt_state-slice)."""
+    from jax.flatten_util import ravel_pytree
+
+    flat_p, unravel = ravel_pytree(params)
+    flat_g, _ = ravel_pytree(mean_grads)
+    chunk = _zero1_chunk(flat_p.size, n_slices)
+    pad = chunk * n_slices - flat_p.size
+    p_pad = jnp.pad(flat_p, (0, pad))
+    g_pad = jnp.pad(flat_g, (0, pad))
+    p_sl = jax.lax.dynamic_slice(p_pad, (my * chunk,), (chunk,))
+    g_sl = jax.lax.dynamic_slice(g_pad, (my * chunk,), (chunk,))
+    updates, new_opt = optimizer.update(g_sl, opt_state, p_sl)
+    new_sl = optax.apply_updates(p_sl, updates)
+    new_flat = jax.lax.all_gather(new_sl, gather_axes, tiled=True)
+    return unravel(new_flat[: flat_p.size]), new_opt
 
 
 def _mask_gathered(gathered, okg):
@@ -279,8 +403,50 @@ def make_distributed_train_step(
     superstep: int = 1,
     ring_bucket_size: int = 65536,
     unfused_decode: bool = False,
+    overlap: str = "off",
+    _oracle_parts: bool = False,
 ):
     """Build the jitted SPMD train step over ``mesh``.
+
+    ``overlap="delayed"`` (requires a codec with ``aggregate`` 'gather' or
+    'ring') builds the stale-by-one overlapped step instead: at step t each
+    chip computes grads_t on the CURRENT params and encodes them, while the
+    optimizer applies the step-(t-1) decoded mean whose encoded payload
+    rode in on the :class:`OverlapCarry` — so the gather/ring exchange and
+    the decode chain read only step-start values, are dataflow-independent
+    of this step's forward/backward, and XLA's latency-hiding scheduler can
+    run them underneath fwd/bwd+update (comm+decode leave the critical path
+    for any N; utils.comm_model.overlap_report quantifies the hidden vs
+    exposed ms). The returned callable takes and returns a
+    :class:`DelayedState` (build the first one with
+    :func:`init_delayed_state`); everything else about the signature is
+    unchanged. Semantics, nailed down:
+
+      * step 0 applies a zero (skipped) update — params, opt state and BN
+        stats hold, ``metrics["skipped"]`` is 1 (``OverlapCarry.valid``);
+      * the guard health flag travels WITH the delayed payload: a NaN
+        source poisons the step that *consumes* it (masked + rescaled
+        there; zero survivors skip that step), while loss/precision
+        metrics and BN stats always follow THIS step's forward health;
+      * BN stats from step t's forward are applied at step t, gated on the
+        consumed update applying (and, under the guard, on >= 1 healthy
+        forward this step);
+      * ``num_aggregate`` subsets are selected by the PRODUCING step's
+        counter (``state.step - 1`` at consumption), so the rotation
+        pattern matches what blocking mode would have used at encode time;
+      * composes with superstep (the carry rides the scan), ZeRO-1, chaos
+        and resume (checkpoints hold the in-flight payload). ``overlap=
+        "off"`` (default) is byte-for-byte the blocking program.
+
+    Program families and bit-exactness (the PR-2/PR-3 discipline): the
+    ``superstep=1`` delayed program matches the two-program eager oracle
+    (:func:`make_delayed_oracle_steps`) bit-for-bit — the oracle's produce
+    and apply are the SAME closures, separately jitted, with an
+    ``optimization_barrier`` pinning the consume chain's inputs in both.
+    The scan form (superstep>1) is bit-identical for any block partition
+    WITHIN the scan family; scan-vs-standalone differs by XLA's
+    last-mantissa-bit fusion drift, exactly as documented for blocking
+    superstep execution.
 
     ``aggregate="ring"`` is the streaming form of ``gather``: the same
     fixed-shape encoded payloads move, but instead of one all_gather into
@@ -417,11 +583,30 @@ def make_distributed_train_step(
         )
     if codec is None and aggregate in ("gather", "ring"):
         aggregate = "psum"  # dense gather/ring would be strictly worse
+    if overlap not in ("off", "delayed"):
+        raise ValueError(
+            f"unknown overlap mode {overlap!r}; expected 'off' or 'delayed'"
+        )
+    if overlap == "delayed" and (
+        codec is None or aggregate not in ("gather", "ring")
+    ):
+        raise ValueError(
+            "overlap='delayed' needs a compressing codec with "
+            "aggregate='gather' or 'ring' — the mode takes the encoded "
+            "exchange+decode off the critical path, and psum/hierarchical "
+            "have no delayed form"
+        )
+    if _oracle_parts and overlap != "delayed":
+        raise ValueError("_oracle_parts only applies to overlap='delayed'")
 
     batch_axes = (axis, inner_axis) if hierarchical else axis
     metric_axes = batch_axes
 
-    def spmd_step(state: TrainState, key, images, labels):
+    def compute_grads(state: TrainState, key, images, labels):
+        """Forward/backward (+ grad_accum + chaos) on the CURRENT params —
+        the produce side shared verbatim by the blocking step and the
+        delayed-overlap step, so extracting it cannot move a single op of
+        the ``overlap='off'`` program."""
         my = jax.lax.axis_index(axis)
         if hierarchical:
             # every chip is a distinct data shard: fold dropout/augment
@@ -501,6 +686,12 @@ def make_distributed_train_step(
 
         if chaos is not None:
             grads = chaos.inject_grads(grads, state.step + 1, replica=my)
+        return my, k_codec, grads, loss, prec1, prec5, new_stats
+
+    def spmd_step(state: TrainState, key, images, labels):
+        my, k_codec, grads, loss, prec1, prec5, new_stats = compute_grads(
+            state, key, images, labels
+        )
 
         ok = kept = None  # guard-mode: local health flag / surviving count
         n_contrib = k_agg or n_dev  # contributions in the average
@@ -556,7 +747,11 @@ def make_distributed_train_step(
             )
             if aggregate == "gather":
                 # factors on the wire: all_gather fixed-shape payloads,
-                # decode all replicas identically, mean.
+                # decode all replicas identically, mean. PAIRED WITH
+                # delayed_apply's consume section (overlap='delayed'):
+                # a change to the mask/sel/decode-mean/rescale arithmetic
+                # here must be mirrored there (see its docstring for why
+                # the two are not one helper).
                 with named_phase("exchange"):
                     gathered = jax.lax.all_gather(payloads, axis)  # leading axis n_dev
                 okg = (
@@ -633,23 +828,13 @@ def make_distributed_train_step(
             # In hierarchical mode the slices span BOTH data axes (`my` is
             # already the full outer*n_inner+inner chip id, and the tuple
             # all_gather concatenates outer-major — matching that id).
-            from jax.flatten_util import ravel_pytree
-
             n_slices = (
                 n_dev * mesh.shape[inner_axis] if hierarchical else n_dev
             )
-            flat_p, unravel = ravel_pytree(state.params)
-            flat_g, _ = ravel_pytree(mean_grads)
-            chunk = _zero1_chunk(flat_p.size, n_slices)
-            pad = chunk * n_slices - flat_p.size
-            p_pad = jnp.pad(flat_p, (0, pad))
-            g_pad = jnp.pad(flat_g, (0, pad))
-            p_sl = jax.lax.dynamic_slice(p_pad, (my * chunk,), (chunk,))
-            g_sl = jax.lax.dynamic_slice(g_pad, (my * chunk,), (chunk,))
-            updates, new_opt = optimizer.update(g_sl, state.opt_state, p_sl)
-            new_sl = optax.apply_updates(p_sl, updates)
-            new_flat = jax.lax.all_gather(new_sl, batch_axes, tiled=True)
-            new_params = unravel(new_flat[: flat_p.size])
+            new_params, new_opt = _zero1_sliced_update(
+                optimizer, state.params, state.opt_state, mean_grads, my,
+                n_slices, batch_axes,
+            )
         if guard is None:
             # keep BN stats consistent across replicas (deviation note
             # above); hierarchical mode averages over BOTH data axes
@@ -701,6 +886,251 @@ def make_distributed_train_step(
             step=P(), params=P(), batch_stats=P(), opt_state=zero1_specs
         )
     )
+    if overlap == "delayed":
+        n_contrib_d = k_agg or n_dev
+
+        def delayed_produce(state: TrainState, key, images, labels):
+            """fwd/bwd + screen + encode on the CURRENT params — the
+            payload produced here is consumed one step later. Loss and
+            precision describe THIS step's forward (healthy-only means
+            under the guard), so the logged series stays aligned with the
+            data stream, not with the staleness."""
+            my, k_codec, grads, loss, prec1, prec5, new_stats = compute_grads(
+                state, key, images, labels
+            )
+            ok_t = (
+                grad_ok(grads, guard.max_grad_norm)
+                if guard is not None
+                else None
+            )
+            with named_phase("encode"):
+                payloads, stats = encode_tree(codec, k_codec, grads)
+            if guard is not None:
+                kept_chips = jax.lax.psum(ok_t.astype(jnp.float32), axis)
+                pm = {
+                    "loss": _healthy_mean(loss, ok_t, kept_chips, axis),
+                    "prec1": _healthy_mean(prec1, ok_t, kept_chips, axis),
+                    "prec5": _healthy_mean(prec5, ok_t, kept_chips, axis),
+                }
+            else:
+                pm = {
+                    "loss": jax.lax.pmean(loss, axis),
+                    "prec1": jax.lax.pmean(prec1, axis),
+                    "prec5": jax.lax.pmean(prec5, axis),
+                }
+            pm["msg_bytes"] = jnp.asarray(stats.payload_bytes, jnp.float32)
+            pm["dense_bytes"] = jnp.asarray(tree_nbytes(grads), jnp.float32)
+            payload_x = jax.tree_util.tree_map(lambda a: a[None], payloads)
+            ok_x = (
+                ok_t.astype(jnp.float32)
+                if guard is not None
+                else jnp.float32(1.0)
+            ).reshape(1)
+            stats_x = jax.tree_util.tree_map(lambda a: a[None], new_stats)
+            return payload_x, ok_x, stats_x, pm
+
+        def delayed_apply(
+            state: TrainState, prev_payload, prev_ok, valid, stats_x, ok_now_x
+        ):
+            """Consume the carried payload: exchange -> decode-mean ->
+            optimizer update, all computed from STEP-START values only.
+            The ``optimization_barrier`` pins that boundary: the whole
+            chain is dataflow-independent of this step's forward/backward
+            (the overlap), and the barrier keeps XLA from fusing it into
+            the produce chain — which is also what makes the separately-
+            jitted oracle's apply program compile to the same arithmetic
+            (bit-for-bit, tested).
+
+            PAIRED WITH spmd_step's gather/ring consume section: the
+            exchange -> mask -> decode-mean -> rescale arithmetic here
+            mirrors the blocking branch op for op and the two must be
+            kept in sync by hand. They are deliberately NOT extracted
+            into one helper: the blocking program is frozen byte-for-byte
+            (the PR-4 `--overlap off` acceptance contract), and re-
+            threading its inline guard/sel/okg flow through a shared
+            closure would reorder trace-time equations — only the
+            self-contained ZeRO-1 update block was safe to share
+            (_zero1_sliced_update)."""
+            my = jax.lax.axis_index(axis)
+            params, opt_state, prev_payload, prev_ok, valid = (
+                jax.lax.optimization_barrier(
+                    (state.params, state.opt_state, prev_payload, prev_ok,
+                     valid)
+                )
+            )
+            prev_ok_s = prev_ok[0]
+            # the subset rotation follows the PRODUCING step's counter
+            # (this payload was encoded at state.step - 1), matching the
+            # pattern blocking mode would have used at encode time
+            sel = (
+                ((state.step - 1) + jnp.arange(k_agg)) % n_dev
+                if k_agg
+                else None
+            )
+            kept = None
+            if aggregate == "gather":
+                with named_phase("delayed_exchange"):
+                    gathered = jax.lax.all_gather(prev_payload, axis)
+                okg = (
+                    jax.lax.all_gather(prev_ok_s, axis)
+                    if guard is not None
+                    else None
+                )
+                if sel is not None:
+                    gathered = jax.tree.map(
+                        lambda a: jnp.take(a, sel, axis=0), gathered
+                    )
+                    if okg is not None:
+                        okg = jnp.take(okg, sel, axis=0)
+                with named_phase("delayed_decode_mean"):
+                    if guard is not None:
+                        kept = jnp.sum(okg)
+                        mean_grads = rescale_by_survivors(
+                            decode_mean_tree(
+                                codec, _mask_gathered(gathered, okg), params,
+                                n_contrib_d, fused=not unfused_decode,
+                            ),
+                            n_contrib_d,
+                            kept,
+                        )
+                    else:
+                        mean_grads = decode_mean_tree(
+                            codec, gathered, params, n_contrib_d,
+                            fused=not unfused_decode,
+                        )
+            else:  # ring
+                with named_phase("delayed_ring_exchange_decode"):
+                    mean_grads, ok_stage = _ring_stream_mean(
+                        codec, prev_payload, params,
+                        axis=axis, n_dev=n_dev, my=my,
+                        ok=prev_ok_s if guard is not None else None,
+                        sel=sel, n_contrib=n_contrib_d,
+                        bucket_size=ring_bucket_size,
+                    )
+                if guard is not None:
+                    kept = jnp.sum(ok_stage)
+                    mean_grads = rescale_by_survivors(
+                        mean_grads, n_contrib_d, kept
+                    )
+            if zero1_specs is None:
+                updates, new_opt = optimizer.update(
+                    mean_grads, opt_state, params
+                )
+                new_params = optax.apply_updates(params, updates)
+            else:
+                new_params, new_opt = _zero1_sliced_update(
+                    optimizer, params, opt_state, mean_grads, my, n_dev, axis
+                )
+            consume_ok = valid > 0  # step 0: nothing in flight -> skip
+            if guard is not None:
+                consume_ok = jnp.logical_and(consume_ok, kept > 0)
+            new_params = select_state(consume_ok, new_params, params)
+            new_opt = select_state(consume_ok, new_opt, opt_state)
+            # BN stats come from THIS step's forward; they apply when the
+            # consumed update applies (and, under the guard, only if this
+            # forward had at least one healthy chip — a step whose every
+            # forward NaN-ed must not poison the running stats even though
+            # its params update came from a healthy earlier payload)
+            new_stats = jax.tree_util.tree_map(
+                lambda a: jnp.squeeze(a, 0), stats_x
+            )
+            if guard is not None:
+                ok_now = ok_now_x[0] > 0
+                kept_chips = jax.lax.psum(ok_now_x[0], axis)
+                new_stats = jax.tree_util.tree_map(
+                    lambda s: _healthy_mean(s, ok_now, kept_chips, axis),
+                    new_stats,
+                )
+                stats_ok = jnp.logical_and(consume_ok, kept_chips > 0)
+            else:
+                new_stats = jax.lax.pmean(new_stats, axis)
+                stats_ok = consume_ok
+            new_stats = select_state(stats_ok, new_stats, state.batch_stats)
+            am = {
+                "skipped": 1.0 - consume_ok.astype(jnp.float32),
+                "dropped": (
+                    n_contrib_d - kept
+                    if guard is not None
+                    else jnp.float32(0.0)
+                ),
+            }
+            new_train = TrainState(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=new_stats,
+                opt_state=new_opt,
+            )
+            return new_train, am
+
+        if _oracle_parts:
+            # the two-program eager oracle: the SAME closures, separately
+            # jitted — what tests/bench drive host-side to prove the fused
+            # program's trajectory bit-exact
+            def apply_prog(state, payload_x, ok_x, valid, stats_x, ok_now_x):
+                prev = jax.tree_util.tree_map(
+                    lambda a: jnp.squeeze(a, 0), payload_x
+                )
+                return delayed_apply(
+                    state, prev, ok_x, valid, stats_x, ok_now_x
+                )
+
+            produce_j = jax.jit(jax.shard_map(
+                delayed_produce, mesh=mesh,
+                in_specs=(state_spec, P(), P(axis), P(axis)),
+                out_specs=(P(axis), P(axis), P(axis), P()),
+                check_vma=False,
+            ))
+            apply_j = jax.jit(jax.shard_map(
+                apply_prog, mesh=mesh,
+                in_specs=(state_spec, P(axis), P(axis), P(), P(axis),
+                          P(axis)),
+                out_specs=(state_spec, P()),
+                check_vma=False,
+            ))
+            return {"produce": produce_j, "apply": apply_j}
+
+        def spmd_delayed(d: DelayedState, key, images, labels):
+            payload_x, ok_x, stats_x, pm = delayed_produce(
+                d.train, key, images, labels
+            )
+            prev_payload = jax.tree_util.tree_map(
+                lambda a: jnp.squeeze(a, 0), d.carry.payload
+            )
+            new_train, am = delayed_apply(
+                d.train, prev_payload, d.carry.ok, d.carry.valid, stats_x,
+                ok_x,
+            )
+            new_d = DelayedState(
+                train=new_train,
+                carry=OverlapCarry(
+                    payload=payload_x, ok=ok_x, valid=jnp.float32(1.0)
+                ),
+            )
+            return new_d, {**pm, **am}
+
+        d_spec = DelayedState(
+            train=state_spec,
+            carry=OverlapCarry(payload=P(axis), ok=P(axis), valid=P()),
+        )
+        if superstep > 1:
+            def spmd_fn_d(d: DelayedState, key, images, labels):
+                def body(c, xs):
+                    return spmd_delayed(c, key, xs[0], xs[1])
+
+                return jax.lax.scan(body, d, (images, labels))
+
+            data_spec_d = P(None, axis)
+        else:
+            spmd_fn_d = spmd_delayed
+            data_spec_d = P(axis)
+        sharded_d = jax.shard_map(
+            spmd_fn_d,
+            mesh=mesh,
+            in_specs=(d_spec, P(), data_spec_d, data_spec_d),
+            out_specs=(d_spec, P()),
+            check_vma=False,
+        )
+        return jax.jit(sharded_d, donate_argnums=(0,))
     if superstep > 1:
         # fused block variant: scan the per-step SPMD body INSIDE the
         # shard_map, so the K steps (collectives included) compile into
@@ -728,6 +1158,51 @@ def make_distributed_train_step(
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(0,))
+
+
+def make_delayed_oracle_steps(
+    model,
+    optimizer,
+    mesh: Mesh,
+    codec,
+    *,
+    axis: str = "dp",
+    aggregate: str = "gather",
+    augment: bool = False,
+    num_aggregate: int = 0,
+    compute_dtype=None,
+    zero1_specs=None,
+    grad_accum: int = 1,
+    guard=None,
+    chaos=None,
+    ring_bucket_size: int = 65536,
+    unfused_decode: bool = False,
+):
+    """The two-program EAGER oracle for ``overlap='delayed'``.
+
+    Returns ``{"produce": ..., "apply": ...}``: ``produce(state, key,
+    images, labels) -> (payload_x, ok_x, stats_x, metrics)`` runs
+    fwd/bwd + screen + encode; ``apply(state, payload_x, ok_x, valid,
+    stats_x, ok_now_x) -> (state, metrics)`` runs exchange + decode-mean +
+    update on a payload produced EARLIER. Driving them host-side —
+    ``apply`` consuming step t-1's payload while ``produce`` emits step
+    t's — is the delayed schedule with every phase its own dispatch, and
+    it reproduces the fused ``superstep=1`` delayed program bit-for-bit
+    (tests/test_overlap.py): both sides are built from the same closures,
+    and the ``optimization_barrier`` inside the apply chain pins the same
+    compilation boundary in both programs. Drive ``apply`` first with
+    ``valid=0`` and a zero payload for the step-0 skip
+    (:func:`_zero_carry_host` shapes it), then alternate.
+    """
+    return make_distributed_train_step(
+        model, optimizer, mesh, codec,
+        axis=axis, aggregate=aggregate, augment=augment,
+        num_aggregate=num_aggregate, compute_dtype=compute_dtype,
+        zero1_specs=zero1_specs, grad_accum=grad_accum, guard=guard,
+        chaos=chaos, ring_bucket_size=ring_bucket_size,
+        unfused_decode=unfused_decode, overlap="delayed",
+        _oracle_parts=True,
+    )
 
 
 def make_phase_train_steps(
@@ -899,6 +1374,7 @@ def distributed_train_loop(
     keep_ckpts: int = 0,
     superstep: int = 1,
     ring_bucket_size: int = 65536,
+    overlap: str = "off",
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -929,12 +1405,44 @@ def distributed_train_loop(
     cadence for log/eval/checkpoint/watchdog/chaos). Incompatible with
     ``phase_metrics`` (whose whole point is host-visible phase
     boundaries). ``profile_dir`` profiles the second block instead of
-    ``profile_steps`` individual steps."""
+    ``profile_steps`` individual steps.
+
+    ``overlap="delayed"`` runs the stale-by-one overlapped step (see
+    make_distributed_train_step): the loop threads a :class:`DelayedState`
+    whose checkpoints INCLUDE the in-flight encoded payload, so
+    kill->restart->resume reproduces the uninterrupted delayed trajectory
+    bit-exactly (within a superstep program family). Returns the final
+    DelayedState (``.params``/``.batch_stats``/``.step`` read through).
+    Resuming a ``--zero1`` delayed run is not supported (the sharded
+    optimizer template cannot be rebuilt around the carried payload);
+    everything else — superstep, guard, chaos, ring/gather — composes."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import heartbeat_watchdog, resolve_chaos
     from atomo_tpu.training.trainer import create_state
     from atomo_tpu.utils.metrics import StepMetrics, Timer
 
+    if overlap not in ("off", "delayed"):
+        raise ValueError(
+            f"unknown overlap mode {overlap!r}; expected 'off' or 'delayed'"
+        )
+    if overlap == "delayed":
+        if codec is None or aggregate not in ("gather", "ring"):
+            raise ValueError(
+                "--overlap delayed needs a compressing codec with "
+                "--aggregate gather or ring (psum/hierarchical have no "
+                "delayed form)"
+            )
+        if phase_metrics:
+            raise ValueError(
+                "--phase-metrics times blocking phase programs and cannot "
+                "describe the overlapped step; drop one of the flags"
+            )
+        if zero1 and resume:
+            raise ValueError(
+                "--overlap delayed cannot resume a --zero1 run (the "
+                "sharded optimizer template cannot carry the overlap "
+                "payload); drop --resume or --zero1"
+            )
     chaos = resolve_chaos(chaos)
     sample_images, _ = next(iter(train_iter.epoch()))
     state = create_state(
@@ -942,6 +1450,7 @@ def distributed_train_loop(
     )
     start_step = 0
     zero1_specs = None
+    delayed_carry_host = None  # restored in-flight payload (delayed resume)
     want_resume = resume and train_dir and latest_step(train_dir) is not None
     if zero1:
         z_axes = (
@@ -1013,7 +1522,44 @@ def distributed_train_loop(
             )
         state = z_state
     else:
-        if want_resume:
+        if want_resume and overlap == "delayed":
+            # delayed checkpoints hold TrainState + the in-flight payload:
+            # restore BOTH so the resumed trajectory is the uninterrupted
+            # one bit-for-bit (the carry is what step start_step+1 consumes)
+            template = DelayedState(
+                train=jax.device_get(state),
+                carry=_zero_carry_host(
+                    codec, jax.device_get(state.params), mesh.shape["dp"]
+                ),
+            )
+            try:
+                restored = load_checkpoint(train_dir, template)
+                state = restored.train
+                delayed_carry_host = restored.carry
+                start_step = int(state.step)
+                log_fn(f"Resumed from {train_dir} at step {start_step}")
+            except FileNotFoundError as exc:
+                log_fn(f"Resume requested but {exc}; starting fresh")
+            except (KeyError, ValueError) as exc:
+                # checkpoint predates the overlap carry (a blocking-mode
+                # file): restore the train state alone; the first resumed
+                # step re-skips (valid=0), so the trajectory honestly
+                # differs from an uninterrupted delayed run by one held
+                # update — said out loud, never silently
+                import warnings
+
+                warnings.warn(
+                    "--overlap delayed resume: checkpoint has no overlap "
+                    f"carry ({exc}); restoring the train state only — the "
+                    "first resumed step applies a zero (skipped) update"
+                )
+                state = load_checkpoint(train_dir, create_state(
+                    model, optimizer, jax.random.PRNGKey(seed),
+                    jnp.asarray(sample_images),
+                ))
+                start_step = int(state.step)
+                log_fn(f"Resumed from {train_dir} at step {start_step}")
+        elif want_resume:
             try:
                 state = load_checkpoint(train_dir, state)
                 start_step = int(state.step)
@@ -1022,7 +1568,52 @@ def distributed_train_loop(
                 # every candidate failed integrity checks: start fresh
                 # rather than dying inside an elastic-restart loop
                 log_fn(f"Resume requested but {exc}; starting fresh")
+            except (KeyError, ValueError) as exc:
+                # the checkpoint was written by --overlap delayed (a
+                # DelayedState {train, carry} dict): restore its nested
+                # train state and DISCARD the in-flight payload — the
+                # blocking trajectory legitimately ignores it, but say so
+                # instead of dying on flax's opaque key-mismatch error
+                import warnings
+
+                from flax import serialization
+
+                from atomo_tpu.training.checkpoint import _read_state_dict
+
+                d = _read_state_dict(train_dir, None)
+                if "train" not in d:
+                    raise  # genuinely foreign layout: surface the original
+                warnings.warn(
+                    "resume: checkpoint was written by --overlap delayed "
+                    f"({exc}); restoring its train state and discarding "
+                    "the in-flight payload — pass --overlap delayed to "
+                    "resume the overlapped run exactly"
+                )
+                state = serialization.from_state_dict(state, d["train"])
+                start_step = int(state.step)
+                log_fn(f"Resumed from {train_dir} at step {start_step}")
         state = replicate_state(mesh, state)
+    if overlap == "delayed":
+        if delayed_carry_host is not None:
+            sh = NamedSharding(mesh, P("dp"))
+            state = DelayedState(
+                train=state,
+                carry=OverlapCarry(
+                    payload=jax.tree_util.tree_map(
+                        lambda a: jax.device_put(jnp.asarray(a), sh),
+                        delayed_carry_host.payload,
+                    ),
+                    ok=jax.device_put(
+                        jnp.asarray(delayed_carry_host.ok), sh
+                    ),
+                    valid=jax.device_put(
+                        jnp.asarray(delayed_carry_host.valid),
+                        NamedSharding(mesh, P()),
+                    ),
+                ),
+            )
+        else:
+            state = init_delayed_state(mesh, state, codec)
     if superstep < 1:
         raise ValueError(f"superstep must be >= 1, got {superstep}")
     if phase_metrics:
@@ -1071,6 +1662,7 @@ def distributed_train_loop(
             zero1_specs=zero1_specs, grad_accum=grad_accum,
             inner_axis=inner_axis, guard=guard, chaos=chaos,
             superstep=superstep, ring_bucket_size=ring_bucket_size,
+            overlap=overlap,
         )
     batch_axes = ("dp", inner_axis) if aggregate == "hierarchical" else "dp"
     eval_fn = (
@@ -1116,19 +1708,11 @@ def _make_phased_step_fn(model, optimizer, mesh, codec, *, augment,
     (state, metrics, phase_seconds) callable with host-side phase timing."""
     import time as _time
 
+    from atomo_tpu.utils.tracing import fence_tree as _fence
+
     fns = make_phase_train_steps(model, optimizer, mesh, codec, augment=augment,
                                  compute_dtype=compute_dtype)
     dense_bytes_cache = {}
-
-    def _fence(tree):
-        """Device->host scalar fetch on one leaf: the only fence that works
-        on every backend — jax.block_until_ready returns WITHOUT waiting on
-        tunneled backends (the axon finding behind VERDICT r2 finding 2),
-        which would turn every phase second below into a dispatch artifact.
-        One program runs at a time per device, so fencing any output of the
-        phase program fences the whole phase."""
-        leaf = jax.tree_util.tree_leaves(tree)[0]
-        float(jnp.sum(leaf).astype(jnp.float32))
 
     def step_fn(state, key, si, sl):
         from atomo_tpu.utils.tracing import annotate

@@ -236,6 +236,70 @@ def gather_buffer_bytes(payload_bytes: float, ways: int) -> float:
     return float(payload_bytes) * ways
 
 
+def overlap_hidden_comm_s(comm_s: float, compute_s: float) -> float:
+    """Seconds of the exchange+decode chain that ``--overlap delayed``
+    hides underneath fwd/bwd+update: overlap hides min(comm, compute) —
+    the chain runs concurrently with compute and only its excess over the
+    compute it hides under remains exposed."""
+    return min(max(float(comm_s), 0.0), max(float(compute_s), 0.0))
+
+
+def overlap_exposed_comm_s(comm_s: float, compute_s: float) -> float:
+    """Seconds of the exchange+decode chain still ON the critical path
+    under ``--overlap delayed``: max(0, comm - compute). Zero whenever the
+    comm chain fits under the compute it overlaps — the regime where the
+    delayed step time equals the compute-only step time for any N."""
+    return max(0.0, float(comm_s) - float(compute_s))
+
+
+def overlap_report(
+    *,
+    dense_bytes: float,
+    payload_bytes: float,
+    ways: int,
+    fabric_bw: float,
+    compute_s: float,
+    decode_s: float = 0.0,
+    aggregate: str = "gather",
+) -> dict:
+    """Model what ``--overlap delayed`` buys at N ``ways`` over a fabric.
+
+    The comm chain the mode takes off the critical path is the payload
+    exchange (gather's all_gather wire, or ring's rotation + segment
+    all_gather) plus the decode-mean (``decode_s``, a measured per-step
+    number — pass 0 to model wire only). Blocking step = compute + chain;
+    delayed step = compute + exposed(chain), where overlap hides
+    min(chain, compute) — BOTH numbers are reported, per the honesty rule
+    that a hidden cost is still a cost (it returns the moment compute
+    shrinks below it). Encode is NOT in the chain: it consumes this
+    step's gradient, so it stays on the critical path in either mode.
+    """
+    if aggregate == "ring":
+        wire = ring_stream_wire_bytes(payload_bytes, dense_bytes, ways)
+    else:
+        wire = ring_allgather_wire_bytes(payload_bytes, ways)
+    comm_s = wire / float(fabric_bw) + max(float(decode_s), 0.0)
+    hidden = overlap_hidden_comm_s(comm_s, compute_s)
+    exposed = overlap_exposed_comm_s(comm_s, compute_s)
+    return {
+        "aggregate": aggregate,
+        "ways": ways,
+        "wire_mb_per_chip": round(wire / 1e6, 3),
+        "comm_chain_ms": round(comm_s * 1e3, 3),
+        "compute_ms": round(float(compute_s) * 1e3, 3),
+        "hidden_ms": round(hidden * 1e3, 3),
+        "exposed_ms": round(exposed * 1e3, 3),
+        "blocking_step_ms": round((compute_s + comm_s) * 1e3, 3),
+        "delayed_step_ms": round((compute_s + exposed) * 1e3, 3),
+        "assumptions": (
+            "delayed overlaps exchange+decode with fwd/bwd+update; hides "
+            "min(comm, compute), exposes the excess; encode stays on the "
+            "critical path (it consumes this step's gradient) — see "
+            "atomo_tpu/utils/comm_model.py"
+        ),
+    }
+
+
 def max_beneficial_ways(dense_bytes: float, payload_bytes: float) -> float:
     """N above which the all_gather moves MORE bytes than dense all-reduce
     (gather traffic grows ~linearly in N; all-reduce saturates at 2D)."""

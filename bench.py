@@ -27,7 +27,9 @@ is emitted alongside: false (with `invalid_reason`) whenever the sync
 scalar is non-finite or a computed MFU falls outside (0, 1).
 
 By default the WHOLE ladder runs (the five BASELINE.md configs plus the LM
-config 6 and the shipped-loop superstep config 7): one JSON row per config
+config 6, the shipped-loop superstep config 7, and the forced-CPU-mesh
+semantics compares: ring-vs-gather config 8 and overlap-vs-blocking
+config 9): one JSON row per config
 as it completes, then ONE final aggregate line — the headline config-2 row
 with a "configs" list embedding every row (VERDICT r2 next-round #4; the
 driver parses the last line). The parent enforces a global wall-clock
@@ -125,6 +127,16 @@ CONFIGS = {
     # claim. Baseline "none".
     8: dict(metric="ring_vs_gather_dispatch", kind="ringcmp",
             network="lenet", batch=32, n_dev=4, ways=4, force_cpu_mesh=True),
+    # Config 9 (PR-4 overlap tentpole): --overlap delayed vs blocking on
+    # the forced 4-device CPU mesh. Fenced full-step times for both modes
+    # per codec, per-phase compute/encode/exchange/decode programs so the
+    # exchange+decode chain that delayed takes off the critical path is
+    # visible with numbers (comm_model.overlap_* turns them into
+    # hidden/exposed ms), and the two-program eager-oracle bit parity
+    # asserted in-row. Like config 8 this is a semantics + schedule
+    # micro-compare, not a chip-speed claim. Baseline "none".
+    9: dict(metric="overlap_vs_blocking", kind="overlapcmp",
+            network="lenet", batch=16, n_dev=4, ways=4, force_cpu_mesh=True),
 }
 
 # Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
@@ -150,6 +162,38 @@ class _FastModeSkip(Exception):
     """Raised inside optional side-measurements to skip them in fast mode
     (caught by the surrounding 'reported as absent, never fabricated'
     handler)."""
+
+
+def _env_int(name: str, default: int) -> int:
+    """``int(os.environ[name])`` with a logged fallback: a typo in the
+    orchestrator's env (ADVICE r5 #3) must degrade to the default and
+    still produce a bench row, never crash the ladder."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        print(
+            f"bench: ignoring {name}={raw!r} (not an int); using {default}",
+            file=sys.stderr, flush=True,
+        )
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    """Float twin of :func:`_env_int` (same fallback-not-crash contract)."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        print(
+            f"bench: ignoring {name}={raw!r} (not a number); using {default}",
+            file=sys.stderr, flush=True,
+        )
+        return default
 
 
 def _mark_invalid(row: dict, reason: str) -> None:
@@ -490,11 +534,9 @@ def measure_ring_compare(cfg: dict) -> dict:
     # silently change the normal protocol)
     reps = 10
     if os.environ.get("ATOMO_BENCH_FAST") == "1":
-        reps = int(os.environ.get("ATOMO_BENCH_STEPS", reps))
+        reps = _env_int("ATOMO_BENCH_STEPS", reps)
 
-    def fence(tree):
-        leaf = jax.tree_util.tree_leaves(tree)[0]
-        return float(jnp.sum(leaf).astype(jnp.float32))
+    from atomo_tpu.utils.tracing import fence_tree as fence
 
     def timed_calls(fn, *args):
         out = fn(*args)
@@ -617,6 +659,275 @@ def measure_ring_compare(cfg: dict) -> dict:
     return out
 
 
+def measure_overlap_compare(cfg: dict) -> dict:
+    """Config-9: ``--overlap delayed`` vs blocking on a multi-device mesh.
+
+    Per codec: the fenced full-step time of the blocking (gather) step and
+    the delayed step, best-of-REPS dispatch loops. Plus the per-phase
+    compute / encode / exchange / decode programs (the same split config 8
+    times) so the exchange+decode chain the delayed schedule takes off the
+    critical path is visible with numbers — comm_model.overlap_* turns
+    them into the hidden/exposed ms the row reports. The two-program eager
+    oracle is driven in-row for 3 steps and its bit parity with the fused
+    delayed program asserted (tests/test_overlap.py is the full oracle;
+    this is the per-round evidence). Semantics + schedule micro-compare on
+    the forced CPU mesh — not a chip-speed claim."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from atomo_tpu.codecs import QsgdCodec, SvdCodec, decode_mean_tree, encode_tree
+    from atomo_tpu.models import get_model
+    from atomo_tpu.parallel import (
+        init_delayed_state,
+        make_delayed_oracle_steps,
+        make_distributed_train_step,
+        make_mesh,
+        replicate_state,
+        shard_batch,
+    )
+    from atomo_tpu.parallel.replicated import _zero_carry_host
+    from atomo_tpu.training import create_state, make_optimizer
+    from atomo_tpu.utils.comm_model import (
+        overlap_exposed_comm_s,
+        overlap_hidden_comm_s,
+    )
+    from atomo_tpu.utils.tracing import fence_tree as fence
+
+    fast = os.environ.get("ATOMO_BENCH_FAST") == "1"
+    dev = jax.devices()[0]
+    n_dev = min(int(cfg.get("n_dev", 4)), len(jax.devices()))
+    base = dict(
+        metric=cfg["metric"], unit="ms/step", value=None,
+        byte_reduction=None, mfu=None, flops_per_step=None,
+        peak_tflops=None, platform=dev.platform, device=dev.device_kind,
+        ways=n_dev, chips_measured=n_dev,
+        timing="dispatch-loop-scalar-fenced",
+        config=dict(kind="overlapcmp", network=cfg["network"],
+                    batch=cfg["batch"], n_dev=n_dev),
+        note=("semantics + schedule micro-compare of --overlap delayed vs "
+              f"blocking on a {n_dev}-device {dev.platform} mesh; not a "
+              "chip-speed row"),
+    )
+    if n_dev < 2:
+        base.update(measurement_valid=False,
+                    invalid_reason="single device: no exchange to overlap")
+        return base
+
+    mesh = make_mesh(n_dev)
+    model = get_model(cfg["network"], 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (cfg["batch"], 28, 28, 1), jnp.float32)
+    labels = jax.random.randint(rng, (cfg["batch"],), 0, 10)
+    state0 = create_state(model, opt, rng, images)
+    host0 = jax.device_get(state0)
+    key = jax.random.PRNGKey(1)
+    si, sl = shard_batch(mesh, images, labels)
+    reps = 20
+    if fast:
+        reps = _env_int("ATOMO_BENCH_STEPS", reps)
+    best_of = 1 if fast else 3
+    # qsgd 8-bit at this batch is the measured operating point where the
+    # exchange+decode chain is a visible slice of the step; svd rank 2 is
+    # the factor-payload family ("at least one compressed codec" evidence
+    # wants two shots). Fast mode keeps only the first.
+    codecs = {"qsgd8": QsgdCodec(bits=8, bucket_size=512)}
+    if not fast:
+        codecs["svd2"] = SvdCodec(rank=2)
+
+    def fresh_train():
+        return replicate_state(
+            mesh, jax.tree_util.tree_map(jnp.asarray, host0)
+        )
+
+    out = dict(base, measurement_valid=True, invalid_reason=None)
+    try:
+        per_codec = {}
+        delayed_steps = {}  # reused by the oracle section (jit caches by
+        # function identity — rebuilding the same program re-traces it)
+        for name, codec in codecs.items():
+            blocking = make_distributed_train_step(
+                model, opt, mesh, codec, aggregate="gather"
+            )
+            delayed = make_distributed_train_step(
+                model, opt, mesh, codec, aggregate="gather", overlap="delayed"
+            )
+            delayed_steps[name] = delayed
+
+            def time_fn(step, mk_state):
+                st = mk_state()
+                m = None
+                for _ in range(3):
+                    st, m = step(st, key, si, sl)
+                s = fence(m["loss"])
+                if not math.isfinite(s):
+                    raise RuntimeError(f"{name} warmup loss not finite")
+                best = float("inf")
+                for _ in range(best_of):
+                    t0 = time.perf_counter()
+                    for _ in range(reps):
+                        st, m = step(st, key, si, sl)
+                    s = fence(m["loss"])
+                    best = min(best, (time.perf_counter() - t0) / reps)
+                    if not math.isfinite(s):
+                        raise RuntimeError(f"{name} fence scalar not finite")
+                return best
+
+            t_block = time_fn(blocking, fresh_train)
+            t_delay = time_fn(
+                delayed,
+                lambda: init_delayed_state(mesh, fresh_train(), codec),
+            )
+            per_codec[name] = {
+                "blocking_ms_per_step": round(t_block * 1e3, 3),
+                "delayed_ms_per_step": round(t_delay * 1e3, 3),
+                "overlap_speedup": round(t_block / t_delay, 4),
+                "overlap_win": bool(t_delay < t_block),
+            }
+        out["codecs"] = per_codec
+        wins = [n for n, r in per_codec.items() if r["overlap_win"]]
+        out["overlap_win_codecs"] = wins
+        # headline value: the delayed step of the winning codec (first
+        # codec when none wins — the row then says so instead of hiding it)
+        head = wins[0] if wins else next(iter(per_codec))
+        out["value"] = per_codec[head]["delayed_ms_per_step"]
+        out["blocking_ms_per_step"] = per_codec[head]["blocking_ms_per_step"]
+        out["headline_codec"] = head
+        if not wins:
+            _mark_invalid(
+                out,
+                "delayed step not strictly below blocking for any codec "
+                "on this run (contended host or overlap-free backend)",
+            )
+
+        # --- per-phase evidence (qsgd8): the chain delayed hides is
+        # exchange+decode; encode consumes THIS step's gradient and stays
+        codec = codecs["qsgd8"]
+        grads = jax.tree_util.tree_map(
+            lambda a: jax.random.normal(
+                jax.random.PRNGKey(7), a.shape, jnp.float32
+            ),
+            host0.params,
+        )
+
+        def sm(fn, in_specs, out_specs):
+            return jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            ))
+
+        def timed_calls(fn, *args):
+            o = fn(*args)
+            s = fence(o)
+            best = float("inf")
+            for _ in range(best_of):
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    o = fn(*args)
+                s = fence(o)
+                best = min(best, (time.perf_counter() - t0) / reps)
+            if not math.isfinite(s):
+                raise RuntimeError("phase fence scalar not finite")
+            return best, o
+
+        from atomo_tpu.training.trainer import cross_entropy_loss
+
+        def comp(params, stats, im, lb):
+            def loss_fn(p):
+                variables = {"params": p}
+                if jax.tree_util.tree_leaves(stats):
+                    variables["batch_stats"] = stats
+                out_ = model.apply(
+                    variables, im, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(0)},
+                    mutable=["batch_stats"]
+                    if jax.tree_util.tree_leaves(stats) else [],
+                )
+                return cross_entropy_loss(out_[0], lb)
+
+            g = jax.grad(loss_fn)(params)
+            return jax.tree_util.tree_map(lambda a: a[None], g)
+
+        comp_fn = sm(comp, (P(), P(), P("dp"), P("dp")), P("dp"))
+        dt_comp, _ = timed_calls(comp_fn, host0.params, host0.batch_stats,
+                                 si, sl)
+
+        def enc(g):
+            my = jax.lax.axis_index("dp")
+            p, _ = encode_tree(codec, jax.random.fold_in(key, my), g)
+            return jax.tree_util.tree_map(lambda a: a[None], p)
+
+        enc_fn = sm(enc, (P(),), P("dp"))
+        dt_enc, payloads_x = timed_calls(enc_fn, grads)
+
+        def gx(px):
+            local = jax.tree_util.tree_map(lambda a: a[0], px)
+            return jax.lax.all_gather(local, "dp")
+
+        gx_fn = sm(gx, (P("dp"),), P())
+        dt_gx, gathered = timed_calls(gx_fn, payloads_x)
+
+        dec_fn = sm(
+            lambda gth: decode_mean_tree(codec, gth, grads, n_dev),
+            (P(),), P(),
+        )
+        dt_dec, _ = timed_calls(dec_fn, gathered)
+
+        chain_s = dt_gx + dt_dec
+        out["phases"] = {
+            "compute_ms": round(dt_comp * 1e3, 3),
+            "encode_ms": round(dt_enc * 1e3, 3),
+            "exchange_ms": round(dt_gx * 1e3, 3),
+            "decode_ms": round(dt_dec * 1e3, 3),
+            "offloadable_chain_ms": round(chain_s * 1e3, 3),
+            "hidden_ms": round(
+                overlap_hidden_comm_s(chain_s, dt_comp) * 1e3, 3
+            ),
+            "exposed_ms": round(
+                overlap_exposed_comm_s(chain_s, dt_comp) * 1e3, 3
+            ),
+            "note": ("delayed takes exchange+decode off the critical path "
+                     "(hides min(chain, compute)); encode consumes this "
+                     "step's gradient and stays on it"),
+        }
+
+        # --- two-program eager-oracle bit parity over 3 steps (qsgd8)
+        delayed = delayed_steps["qsgd8"]  # the warm program from the loop
+        oracle = make_delayed_oracle_steps(
+            model, opt, mesh, codec, aggregate="gather"
+        )
+        d = init_delayed_state(mesh, fresh_train(), codec)
+        st = fresh_train()
+        carry = _zero_carry_host(codec, host0.params, n_dev)
+        px, okx, valid = carry.payload, carry.ok, carry.valid
+        parity = True
+        for _ in range(3):
+            d, _m = delayed(d, key, si, sl)
+            npx, nok, stats_x, _pm = oracle["produce"](st, key, si, sl)
+            st, _am = oracle["apply"](st, px, okx, valid, stats_x, nok)
+            px, okx, valid = npx, nok, jnp.float32(1.0)
+            parity &= all(
+                np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(
+                    jax.tree_util.tree_leaves(jax.device_get(d.train.params)),
+                    jax.tree_util.tree_leaves(jax.device_get(st.params)),
+                )
+            )
+        out["overlap_oracle_bit_parity"] = bool(parity)
+        if not parity:
+            _mark_invalid(
+                out,
+                "delayed fused program is NOT bit-identical to the "
+                "two-program eager oracle (the PR-4 contract)",
+            )
+    except Exception as exc:  # noqa: BLE001 — a failed compare is a failed row
+        _mark_invalid(out, f"overlap compare failed: {str(exc)[:200]}")
+    return out
+
+
 def measure_ours(cfg: dict) -> dict:
     import jax
     import jax.numpy as jnp
@@ -631,6 +942,8 @@ def measure_ours(cfg: dict) -> dict:
         return measure_loop(cfg)
     if cfg.get("kind") == "ringcmp":
         return measure_ring_compare(cfg)
+    if cfg.get("kind") == "overlapcmp":
+        return measure_overlap_compare(cfg)
 
     model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
@@ -1173,9 +1486,9 @@ def child_main(args) -> int:
         # box's one CPU core inside the child timeout — trade precision for
         # existence. The step/warmup/reps overrides are honored ONLY here so
         # a stray env var cannot silently change the normal TPU protocol.
-        STEPS = int(os.environ.get("ATOMO_BENCH_STEPS", STEPS))
-        WARMUP = int(os.environ.get("ATOMO_BENCH_WARMUP", WARMUP))
-        REPS = int(os.environ.get("ATOMO_BENCH_REPS", REPS))
+        STEPS = _env_int("ATOMO_BENCH_STEPS", STEPS)
+        WARMUP = _env_int("ATOMO_BENCH_WARMUP", WARMUP)
+        REPS = _env_int("ATOMO_BENCH_REPS", REPS)
         # side-compares are TPU evidence; in CPU-fallback mode they only
         # multiply the time to a already-degraded number (each is at least
         # one extra multi-minute 1-core compile)
@@ -1186,9 +1499,9 @@ def child_main(args) -> int:
         # inside the child timeout on the 1-core host (measured: config 2
         # blew its 40-min cap); honored only in fast mode, recorded in
         # degraded_protocol so the row can never pass as the real recipe
-        fb = os.environ.get("ATOMO_BENCH_BATCH")
-        if fb and "batch" in cfg:
-            cfg["batch"] = min(int(fb), cfg["batch"])
+        fb = _env_int("ATOMO_BENCH_BATCH", 0)
+        if fb > 0 and "batch" in cfg:
+            cfg["batch"] = min(fb, cfg["batch"])
     out = measure_ours(cfg)
     if fast:
         # the metric NAME is kept stable for consumers, so mark explicitly
@@ -1404,7 +1717,7 @@ def _bench_one(config: int, no_baseline: bool, try_tpu: bool = True) -> dict:
     # ATOMO_BENCH_RETRIES: an orchestrator that retries whole invocations
     # across relay windows (scripts/onchip_queue_r5b.sh) sets this to 1 so
     # a dead relay costs one dial, not RETRIES of them
-    retries = int(os.environ.get("ATOMO_BENCH_RETRIES", RETRIES))
+    retries = _env_int("ATOMO_BENCH_RETRIES", RETRIES)
     for attempt in range(retries if try_tpu else 0):
         if attempt:
             time.sleep(15 * attempt)  # axon tunnel contention backoff
@@ -1470,9 +1783,7 @@ def main() -> int:
     if args.child:
         return child_main(args)
     global _DEADLINE
-    _DEADLINE = time.monotonic() + float(
-        os.environ.get("ATOMO_BENCH_DEADLINE_S", "840")
-    )
+    _DEADLINE = time.monotonic() + _env_float("ATOMO_BENCH_DEADLINE_S", 840.0)
     if args.config is not None and args.all:
         ap.error("--config and --all are mutually exclusive")
     _ARTIFACT.update(rows=[], complete=False, tpu_probe=None)  # fresh run

@@ -29,6 +29,12 @@ R3_NOTE = ("r3 = round-3 post-recovery refresh; configs 4/5 quoted there "
 
 
 def newest_valid_tpu_row(path: str):
+    """Newest parseable full TPU row — MIRRORS the queue validator
+    (scripts/onchip_queue_r5b.sh v_jsonl_any_tpu): platform tpu, valid,
+    NOT a partial/intermediate row, and a numeric ``value`` so the table
+    formatter can never TypeError on a None (ADVICE r5 #2 — the two
+    checkers drifting is how a row passes the queue and then crashes the
+    assembler)."""
     last = None
     for line in open(path):
         line = line.strip()
@@ -38,7 +44,14 @@ def newest_valid_tpu_row(path: str):
             row = json.loads(line)
         except Exception:
             continue
-        if row.get("platform") == "tpu" and row.get("measurement_valid", True):
+        value = row.get("value")
+        if (
+            row.get("platform") == "tpu"
+            and row.get("measurement_valid", True)
+            and not row.get("partial")
+            and isinstance(value, (int, float))
+            and not isinstance(value, bool)
+        ):
             last = row
     return last
 
@@ -74,14 +87,19 @@ def main() -> int:
     ]
     for cfg in sorted(rows):
         r = rows[cfg]
-        v = r.get("value")
+        v = r.get("value")  # numeric: newest_valid_tpu_row guarantees it
         base = R3.get(r.get("metric"))
         delta = f"{base / v:.2f}x" if (base and v) else "—"
         mfu = r.get("mfu")
+        mfu_s = (
+            f"{mfu:.1%}"
+            if isinstance(mfu, (int, float)) and not isinstance(mfu, bool)
+            else "—"
+        )
         lines.append(
             f"| {cfg} | {r.get('metric')} | {v:.2f} | {delta} | "
             f"{r.get('byte_reduction') or '—'} | "
-            f"{f'{mfu:.1%}' if mfu else '—'} | {r.get('device')} |"
+            f"{mfu_s} | {r.get('device')} |"
         )
     if missing:
         lines += ["", f"Missing TPU evidence for configs: {missing} "

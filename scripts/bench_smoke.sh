@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# Bench smoke (~3 min): prove the bench entrypoint still emits parseable
-# evidence without burning the full-ladder window. Three checks:
+# Bench smoke (~6 min): prove the bench entrypoint still emits parseable
+# evidence without burning the full-ladder window. Four checks:
 #
 #   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
 #      the driver's last-line JSON contract, PLUS the partial-artifact
@@ -8,7 +8,13 @@
 #   2. config 8 (ring-vs-gather dispatch micro-compare, forced 4-device
 #      CPU mesh) — per-phase encode/exchange/decode timings present and
 #      the aggregation-operator bit-parity contract holds in-row.
-#   3. the kill contract: SIGKILL a full-ladder run mid-flight; the JSON
+#   3. config 9 (overlap-vs-blocking, forced 4-device CPU mesh) — both
+#      modes' fenced step times present per codec, the per-phase
+#      compute/encode/exchange/decode + hidden/exposed fields present,
+#      and the two-program eager-oracle bit parity holds in-row (the
+#      speedup itself is timing and may lose to a contended host; the
+#      row says so honestly and the smoke does not gate on it).
+#   4. the kill contract: SIGKILL a full-ladder run mid-flight; the JSON
 #      artifact must still parse with whatever rows completed (rc=124
 #      resilience — the three-round zero-valid-TPU-rows failure mode).
 #
@@ -47,7 +53,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/3]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/4]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -76,14 +82,54 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/3]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/4]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
 EOF
 [ $? -ne 0 ] && exit 1
 
-# --- 3: kill mid-ladder, artifact still parses ---------------------------
+# --- 3: config 9, overlap-vs-blocking contract ---------------------------
+out=$(timeout -k 5 360 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=4 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=340 \
+      ATOMO_BENCH_ARTIFACT="$art/c9.json" \
+      python bench.py --config 9 --no-baseline 2>/dev/null)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: config 9 exited rc=$rc (timeout or crash)"
+  exit 1
+fi
+printf '%s\n' "$out" > "$art/c9.out"
+python - "$art/c9.out" <<'EOF'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+assert lines, "bench_smoke FAIL: config 9 emitted no JSON"
+row = json.loads(lines[-1])
+assert row["metric"] == "overlap_vs_blocking", row
+# the oracle contract is semantics, not timing: it must hold even on a
+# contended host (a failed assert here is a real regression)
+assert row.get("overlap_oracle_bit_parity") is True, row
+cods = row.get("codecs") or {}
+assert "qsgd8" in cods, row
+for k in ("blocking_ms_per_step", "delayed_ms_per_step", "overlap_speedup"):
+    assert isinstance(cods["qsgd8"].get(k), (int, float)), (k, row)
+ph = row.get("phases") or {}
+for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
+          "hidden_ms", "exposed_ms"):
+    assert isinstance(ph.get(k), (int, float)), (k, row)
+win = row.get("overlap_win_codecs")
+print(f"bench_smoke OK[3/4]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+      f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
+      f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
+      f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
+      f"gx={ph['exchange_ms']} dec={ph['decode_ms']} "
+      f"hidden={ph['hidden_ms']} exposed={ph['exposed_ms']} ms; "
+      f"oracle_bit_parity=True")
+EOF
+[ $? -ne 0 ] && exit 1
+
+# --- 4: kill mid-ladder, artifact still parses ---------------------------
 env JAX_PLATFORMS=cpu ATOMO_BENCH_FAST=1 ATOMO_BENCH_RETRIES=1 \
     ATOMO_BENCH_DEADLINE_S=600 ATOMO_BENCH_ARTIFACT="$art/killed.json" \
     python bench.py --all --no-baseline >/dev/null 2>&1 &
@@ -104,6 +150,6 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[3/3]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/4]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF

@@ -1,0 +1,74 @@
+"""Worker for the --overlap delayed kill->restart->resume drill.
+
+Launched (never imported) by tests/test_overlap.py: a 2-virtual-device
+distributed delayed-overlap job (LeNet, synthetic MNIST, QSGD, guard on)
+with periodic checkpoints and whatever chaos the ATOMO_CHAOS env injects.
+The parent compares the final parameter hash across an uninterrupted
+oracle run, a chaos-killed run, and its --resume restart — proving the
+restart restores the IN-FLIGHT payload from the checkpoint and recovers
+the oracle's exact delayed trajectory (all legs use superstep > 1, so
+every program is in the scan family and the comparison is bitwise).
+
+Env: ATOMO_OVL_DIR (train_dir), ATOMO_OVL_RESUME=1, ATOMO_OVL_STEPS
+(default 8), ATOMO_OVL_SUPERSTEP (default 2), ATOMO_CHAOS (fault plan).
+"""
+
+import hashlib
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from atomo_tpu.codecs import QsgdCodec  # noqa: E402
+from atomo_tpu.data import SPECS, BatchIterator, synthetic_dataset  # noqa: E402
+from atomo_tpu.models import get_model  # noqa: E402
+from atomo_tpu.parallel import distributed_train_loop, make_mesh  # noqa: E402
+from atomo_tpu.training import GuardConfig, make_optimizer  # noqa: E402
+
+
+def main() -> None:
+    train_dir = os.environ["ATOMO_OVL_DIR"]
+    resume = os.environ.get("ATOMO_OVL_RESUME") == "1"
+    max_steps = int(os.environ.get("ATOMO_OVL_STEPS", "8"))
+    superstep = int(os.environ.get("ATOMO_OVL_SUPERSTEP", "2"))
+    mesh = make_mesh(2)
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.05, momentum=0.9)  # momentum: the
+    # restart must restore the optimizer state, not just params
+    ds = synthetic_dataset(SPECS["mnist"], True, size=128)
+    it = BatchIterator(ds, 16, seed=0)
+    state = distributed_train_loop(
+        model,
+        opt,
+        mesh,
+        it,
+        codec=QsgdCodec(bits=4, bucket_size=128),
+        aggregate="gather",
+        overlap="delayed",
+        max_steps=max_steps,
+        train_dir=train_dir,
+        save_freq=2,
+        resume=resume,
+        log_every=1,
+        eval_freq=0,
+        seed=0,
+        guard=GuardConfig(),
+        log_fn=lambda s: print(s, flush=True),
+        superstep=superstep,
+    )
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(state.params)):
+        h.update(np.asarray(leaf).tobytes())
+    print("OVLFINAL " + h.hexdigest(), flush=True)
+
+
+if __name__ == "__main__":
+    main()

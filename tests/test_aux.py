@@ -201,3 +201,16 @@ def test_initialize_retries_transient_failure(monkeypatch):
     initialize(backoff=0.01)
     assert len(calls) == 2
     assert calls[1]["coordinator_address"] == "10.0.0.1:1234"
+
+
+def test_fence_tree_returns_finite_scalar_and_fences():
+    """PR-4: the shared device->host fence used by every phase timer —
+    returns the fetched float (finiteness is the caller's validity
+    check) and works on pytrees and bare arrays alike."""
+    from atomo_tpu.utils.tracing import fence_tree
+
+    v = fence_tree({"a": jax.numpy.arange(4.0), "b": jax.numpy.ones((2, 2))})
+    assert v == 6.0
+    assert fence_tree(jax.numpy.full((3,), float("nan"))) != fence_tree(
+        jax.numpy.zeros((3,))
+    )  # NaN propagates out where validity checks can see it

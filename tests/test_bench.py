@@ -28,7 +28,7 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")  # skip the real TPU probe
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     assert bench.main() == 0
-    assert order == [2, 1, 3, 4, 5, 6, 7, 8]
+    assert order == [2, 1, 3, 4, 5, 6, 7, 8, 9]
 
     lines = [
         json.loads(ln)
@@ -40,7 +40,7 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     assert aggs and all(a["metric"] == "m2" for a in aggs)
     assert aggs[-1]["configs_complete"] is True
     assert [c["metric"] for c in aggs[-1]["configs"]] == [
-        "m1", "m2", "m3", "m4", "m5", "m6", "m7", "m8"
+        "m1", "m2", "m3", "m4", "m5", "m6", "m7", "m8", "m9"
     ]
     # an aggregate exists right after the FIRST config completes
     assert "configs" in lines[1]
@@ -176,7 +176,7 @@ def test_artifact_rows_written_atomically_as_they_complete(
     assert doc["complete"] is True
     assert doc["tpu_probe"] == {"ok": False, "skipped": "JAX_PLATFORMS=cpu"}
     assert [r["metric"] for r in doc["rows"]] == [
-        "m2", "m1", "m3", "m4", "m5", "m6", "m7", "m8"
+        "m2", "m1", "m3", "m4", "m5", "m6", "m7", "m8", "m9"
     ]
     # atomicity: no torn temp file left behind
     assert not list(tmp_path.glob("*.tmp.*"))
@@ -233,6 +233,59 @@ def test_ring_vs_gather_config_forces_cpu_mesh(monkeypatch):
     assert "--xla_force_host_platform_device_count=4" in seen[0]["XLA_FLAGS"]
 
 
+def test_overlap_config_forces_cpu_mesh(monkeypatch):
+    """Config 9 (overlap_vs_blocking) rides the same forced-CPU-mesh path
+    as config 8: ONE child, no TPU attempts, no fast-mode fallback."""
+    seen = []
+
+    def fake_run_child(tail, env, timeout_s=None):
+        seen.append((tail, env))
+        return {"metric": "overlap_vs_blocking", "value": 5.0,
+                "measurement_valid": True, "platform": "cpu"}, ""
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench, "_DEADLINE", bench.time.monotonic() + 900.0)
+    row = bench._bench_one(9, no_baseline=True)
+    assert row["measurement_valid"] is True
+    assert len(seen) == 1
+    assert seen[0][1]["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=4" in seen[0][1]["XLA_FLAGS"]
+
+
+def test_env_parse_falls_back_on_garbage(monkeypatch, capsys):
+    """ADVICE r5 #3: a typo'd orchestrator env (ATOMO_BENCH_RETRIES=oops)
+    must degrade to the default with a logged warning, not crash the
+    ladder before any row is produced."""
+    monkeypatch.setenv("ATOMO_BENCH_RETRIES", "oops")
+    assert bench._env_int("ATOMO_BENCH_RETRIES", 3) == 3
+    monkeypatch.setenv("ATOMO_BENCH_BATCH", "8.5")  # int parse, float given
+    assert bench._env_int("ATOMO_BENCH_BATCH", 0) == 0
+    monkeypatch.setenv("ATOMO_BENCH_DEADLINE_S", "soon")
+    assert bench._env_float("ATOMO_BENCH_DEADLINE_S", 840.0) == 840.0
+    err = capsys.readouterr().err
+    assert "ATOMO_BENCH_RETRIES" in err and "ignoring" in err
+    # valid values still parse
+    monkeypatch.setenv("ATOMO_BENCH_RETRIES", "1")
+    assert bench._env_int("ATOMO_BENCH_RETRIES", 3) == 1
+    # and the retry path consumes the fallback without raising
+    monkeypatch.setenv("ATOMO_BENCH_RETRIES", "not-a-number")
+    calls = {"n": 0}
+
+    def fake_run_child(tail, env, timeout_s=None):
+        calls["n"] += 1
+        if env.get("JAX_PLATFORMS") == "cpu":
+            return {"metric": "m", "value": 1.0, "measurement_valid": True,
+                    "platform": "cpu"}, ""
+        return None, "rc=17: wedged"
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_DEADLINE", bench.time.monotonic() + 900.0)
+    row = bench._bench_one(1, no_baseline=True)
+    assert row["metric"] == "m"  # a row, not a crash
+    assert calls["n"] == bench.RETRIES + 1  # default retries used
+
+
 def test_assembler_newest_valid_tpu_row(tmp_path):
     """The on-chip assembler (and the queue validator that mirrors it) must
     pick the NEWEST valid TPU row, skip lines truncated by killed runs, and
@@ -257,6 +310,14 @@ def test_assembler_newest_valid_tpu_row(tmp_path):
         '{"trunca\n'  # killed mid-write
         '{"platform": "tpu", "measurement_valid": true, "value": 8.5}\n'
         '{"platform": "cpu", "measurement_valid": false, "value": 999}\n'
+        # ADVICE r5 #2: these must NOT supersede the 8.5 row — a partial
+        # intermediate row, a null value (would TypeError the table
+        # formatter), and a bool value are all invalid by the validator
+        # the assembler now mirrors
+        '{"platform": "tpu", "measurement_valid": true, "value": 7.0, '
+        '"partial": true}\n'
+        '{"platform": "tpu", "measurement_valid": true, "value": null}\n'
+        '{"platform": "tpu", "measurement_valid": true, "value": true}\n'
     )
     row = mod.newest_valid_tpu_row(str(f))
     assert row is not None and row["value"] == 8.5
@@ -264,3 +325,11 @@ def test_assembler_newest_valid_tpu_row(tmp_path):
     g = tmp_path / "bench_c3.jsonl"
     g.write_text('{"platform": "cpu", "measurement_valid": false}\n')
     assert mod.newest_valid_tpu_row(str(g)) is None
+    # an all-garbage file (only partial / null-value TPU rows) yields None
+    h = tmp_path / "bench_c4.jsonl"
+    h.write_text(
+        '{"platform": "tpu", "measurement_valid": true, "value": null}\n'
+        '{"platform": "tpu", "measurement_valid": true, "partial": true, '
+        '"value": 3.0}\n'
+    )
+    assert mod.newest_valid_tpu_row(str(h)) is None

@@ -260,3 +260,20 @@ def test_lm_eval_freq_prints_validation(layout, extra, capsys):
         assert float(m.group(1)) == float(m.group(1))  # finite
         # C must be the per-chip budget: ceil(1.25 * (8/4)*8 / 4) = 5
         assert int(m.group(2)) == 5
+
+
+def test_overlap_flag_surface():
+    """PR-4: the --overlap flag parses with its two modes and defaults to
+    off (the byte-for-byte blocking program)."""
+    parser = build_parser()
+    sub = next(
+        a for a in parser._actions if hasattr(a, "choices") and a.choices
+    )
+    train = sub.choices["train"]
+    act = next(a for a in train._actions if "--overlap" in a.option_strings)
+    assert act.default == "off"
+    assert sorted(act.choices) == ["delayed", "off"]
+    args = train.parse_args(["--overlap", "delayed"])
+    assert args.overlap == "delayed"
+    with pytest.raises(SystemExit):
+        train.parse_args(["--overlap", "eager"])

@@ -608,3 +608,68 @@ def test_qsgd_codec_adversarial_shapes_roundtrip(shape):
     out = codec.decode(p, g.shape, g.dtype)
     assert out.shape == shape and out.dtype == jnp.float32
     assert bool(jnp.all(jnp.isfinite(out)))
+
+
+# ------------------------------ bucketed (vmapped) decode grouping (PR-4)
+
+
+_BUCKET_CODECS = {
+    "qsgd": QsgdCodec(bits=2, bucket_size=128),
+    "terngrad": QsgdCodec(bits=1, bucket_size=128, scheme="terngrad",
+                          name="terngrad"),
+    "svd": SvdCodec(rank=2),
+    "svd_budget": SvdCodec(rank=2, sample="bernoulli_budget"),
+    "svd_bf16wire": SvdCodec(rank=2, wire_dtype="bfloat16"),
+    "dense": DenseCodec(),
+}
+
+# a tree with REPEATED shapes (the grouping case) plus singletons
+_BUCKET_TREE = {
+    "a1": jax.random.normal(jax.random.PRNGKey(1), (17, 9)),
+    "a2": jax.random.normal(jax.random.PRNGKey(2), (17, 9)),
+    "a3": jax.random.normal(jax.random.PRNGKey(3), (17, 9)),
+    "b": jax.random.normal(jax.random.PRNGKey(4), (33,)),
+    "c1": jax.random.normal(jax.random.PRNGKey(5), (5, 5, 1, 4)),
+    "c2": jax.random.normal(jax.random.PRNGKey(6), (5, 5, 1, 4)),
+}
+
+
+def _trees_bitwise(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b))
+    )
+
+
+@pytest.mark.parametrize("name", sorted(_BUCKET_CODECS))
+def test_decode_tree_bucketed_bit_identical(name):
+    """The shape-bucketed vmapped decode (mirror of encode_tree's
+    bucketing) is a batching transform, not a reassociation: bit-identical
+    to the per-leaf loop for every codec."""
+    codec = _BUCKET_CODECS[name]
+    payloads, _ = encode_tree(codec, jax.random.PRNGKey(0), _BUCKET_TREE)
+    fast = decode_tree(codec, payloads, _BUCKET_TREE, bucketed=True)
+    ref = decode_tree(codec, payloads, _BUCKET_TREE, bucketed=False)
+    assert _trees_bitwise(fast, ref), name
+
+
+@pytest.mark.parametrize("name", sorted(_BUCKET_CODECS))
+def test_decode_mean_tree_bucketed_bit_identical(name):
+    """Same contract for the gathered decode-mean, in BOTH decode orders:
+    the canonical unfused path (the ring parity oracle) and the default
+    fused path (where the SVD fused kernel serves its leaves per-leaf and
+    only the vmap fallback groups)."""
+    from atomo_tpu.codecs import decode_mean_tree
+
+    codec = _BUCKET_CODECS[name]
+    payloads, _ = encode_tree(codec, jax.random.PRNGKey(0), _BUCKET_TREE)
+    gathered = jax.tree_util.tree_map(
+        lambda a: jnp.stack([a, a, a]), payloads
+    )
+    for fused in (False, True):
+        fast = decode_mean_tree(codec, gathered, _BUCKET_TREE, 3,
+                                fused=fused, bucketed=True)
+        ref = decode_mean_tree(codec, gathered, _BUCKET_TREE, 3,
+                               fused=fused, bucketed=False)
+        assert _trees_bitwise(fast, ref), (name, fused)

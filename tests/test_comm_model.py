@@ -96,3 +96,67 @@ def test_crossover_report_shape_and_consistency():
     # printed story of artifacts/COMM_CROSSOVER.md)
     w8 = rep["ways"][0]["implied"]
     assert w8["ici_45GBps"]["speedup"] < 1.0 < w8["eth10G_1.25GBps"]["speedup"]
+
+
+def test_overlap_hidden_exposed_algebra():
+    """PR-4: overlap hides min(comm, compute) and exposes the excess —
+    the two must always sum back to the full comm chain, and clamp at 0."""
+    from atomo_tpu.utils.comm_model import (
+        overlap_exposed_comm_s,
+        overlap_hidden_comm_s,
+    )
+
+    for comm, comp in ((0.004, 0.010), (0.010, 0.004), (0.0, 0.01),
+                       (0.01, 0.0)):
+        hidden = overlap_hidden_comm_s(comm, comp)
+        exposed = overlap_exposed_comm_s(comm, comp)
+        assert hidden == min(comm, comp)
+        assert abs(hidden + exposed - comm) < 1e-12
+        assert hidden >= 0 and exposed >= 0
+
+
+def test_overlap_report_models_both_modes():
+    """The delayed step is compute + exposed, the blocking step is
+    compute + chain; hidden + exposed == chain; ring mode charges ring's
+    honest wire. All JSON-safe."""
+    import json
+
+    from atomo_tpu.utils.comm_model import (
+        overlap_report,
+        ring_allgather_wire_bytes,
+        ring_stream_wire_bytes,
+    )
+
+    rep = overlap_report(
+        dense_bytes=D, payload_bytes=P, ways=8, fabric_bw=1.25e9,
+        compute_s=6.5e-3, decode_s=1.0e-3,
+    )
+    assert rep["wire_mb_per_chip"] == round(
+        ring_allgather_wire_bytes(P, 8) / 1e6, 3
+    )
+    assert abs(
+        rep["hidden_ms"] + rep["exposed_ms"] - rep["comm_chain_ms"]
+    ) < 1e-6
+    assert abs(
+        rep["blocking_step_ms"]
+        - (rep["compute_ms"] + rep["comm_chain_ms"])
+    ) < 1e-6
+    assert abs(
+        rep["delayed_step_ms"] - (rep["compute_ms"] + rep["exposed_ms"])
+    ) < 1e-6
+    # a comm chain that fits under compute leaves ZERO exposed: the
+    # delayed step time equals the compute-only step
+    small = overlap_report(
+        dense_bytes=D, payload_bytes=P, ways=8, fabric_bw=45e9,
+        compute_s=6.5e-3,
+    )
+    assert small["exposed_ms"] == 0.0
+    assert small["delayed_step_ms"] == small["compute_ms"]
+    ring = overlap_report(
+        dense_bytes=D, payload_bytes=P, ways=8, fabric_bw=1.25e9,
+        compute_s=6.5e-3, aggregate="ring",
+    )
+    assert ring["wire_mb_per_chip"] == round(
+        ring_stream_wire_bytes(P, D, 8) / 1e6, 3
+    )
+    json.dumps(rep, allow_nan=False)
