@@ -1,6 +1,6 @@
 """`python -m atomo_tpu <flags>` — the reference's `python distributed_nn.py
 <flags>` invocation shape (src/run_pytorch.sh:1)."""
 
-from atomo_tpu.cli import main
+from atomo_tpu.cli import cli_entry
 
-raise SystemExit(main())
+raise SystemExit(cli_entry())

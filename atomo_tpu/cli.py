@@ -176,8 +176,50 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                         "(0 = keep all)")
     t.add_argument("--chaos", type=str, default="", metavar="SPEC",
                    help="fault-injection spec for drills, e.g. "
-                        "'nan@3,kill@6,truncate@4' (see utils/chaos.py); "
-                        "defaults to the ATOMO_CHAOS env var")
+                        "'nan@3,kill@6,truncate@4,spike@5:3,crashloop@2' "
+                        "(see utils/chaos.py); defaults to the ATOMO_CHAOS "
+                        "env var")
+    t.add_argument("--on-diverge", type=str, default="off",
+                   choices=["off", "skip", "rewarm", "densify"],
+                   help="arm the divergence doctor: a windowed robust "
+                        "z-score over the per-step loss series (plus guard "
+                        "skip-rate and grad-norm trend counters) detects "
+                        "divergence the per-step screen cannot see; on "
+                        "alarm the run rolls back to the newest HEALTHY "
+                        "checkpoint, replays the data stream, and applies "
+                        "this remedy: skip = replay unchanged (transient-"
+                        "fault model), rewarm = LR re-warmup ramp over the "
+                        "detector window, densify = temporary dense "
+                        "(uncompressed) aggregation for the window — valid "
+                        "because every codec is an unbiased estimator of "
+                        "the same mean. off (default) = detector disarmed")
+    t.add_argument("--diverge-window", type=int, default=16, metavar="W",
+                   help="divergence-detector window: EMA span, healthy-"
+                        "tag clearance, and remedy duration (steps)")
+    t.add_argument("--diverge-zmax", type=float, default=6.0, metavar="Z",
+                   help="robust z-score threshold for the loss series")
+    t.add_argument("--diverge-patience", type=int, default=3, metavar="N",
+                   help="consecutive above-threshold steps before the "
+                        "alarm fires (one bad batch is noise; a sustained "
+                        "excursion is divergence)")
+    t.add_argument("--diverge-min-history", type=int, default=8,
+                   metavar="N",
+                   help="warmup steps before z/skip/trend alarms arm")
+    t.add_argument("--max-rollbacks", type=int, default=2, metavar="N",
+                   help="in-process rollback budget; exhaustion exits with "
+                        "the rollback-requested code (23) so a supervisor "
+                        "can prune to the last healthy checkpoint and "
+                        "restart")
+    t.add_argument("--max-restarts", type=int, default=0, metavar="N",
+                   help="supervise this run: re-exec the same command "
+                        "under a crash-loop budget of N restarts with "
+                        "jittered exponential backoff, resuming from the "
+                        "last checkpoint; decisions land in "
+                        "train_dir/incidents.jsonl (0 = unsupervised)")
+    t.add_argument("--restart-backoff", type=float, default=1.0,
+                   metavar="SEC",
+                   help="supervisor backoff base seconds (decorrelated "
+                        "jitter, capped at 30x)")
     t.add_argument("--superstep", type=int, default=0, metavar="K",
                    help="fuse K optimizer steps into ONE device dispatch "
                         "(lax.scan) with device-resident (K, batch, ...) "
@@ -388,11 +430,176 @@ def _resolve_auto_aggregate(
     return mode
 
 
+def _diverged_exit(exc: Exception) -> int:
+    """Map a DivergenceError (in-process rollback budget spent) to the
+    rollback-requested exit code the run-level supervisor triages."""
+    from atomo_tpu.training.resilience import ROLLBACK_EXIT_CODE
+
+    print(
+        f"Divergence doctor gave up: {exc}; diverged checkpoint tail "
+        f"pruned to the last healthy step, exiting rc={ROLLBACK_EXIT_CODE} "
+        "(rollback-requested — a supervisor restarts from there, and an "
+        "unsupervised --resume lands there too)",
+        flush=True,
+    )
+    return ROLLBACK_EXIT_CODE
+
+
+def _argv_preflight(args: argparse.Namespace) -> None:
+    """Deterministic config conflicts knowable from argv alone, checked
+    BEFORE the supervisor re-exec (and before the jax backend initializes
+    — the supervisor parent never calls jax.devices(), so it cannot dial
+    a TPU tunnel): a typo'd flag must fail fast with its reason, not burn
+    the restart budget as a chain of "crash" incidents. Conflicts that
+    need the resolved device count or the built codec are (re-)checked in
+    the run itself."""
+    if args.superstep < 0:
+        raise SystemExit(
+            f"--superstep {args.superstep}: must be >= 1 (or 0 for the "
+            "per-backend auto default)"
+        )
+    if args.overlap == "delayed":
+        if args.code.lower() in DENSE_CODES:
+            raise SystemExit(
+                "--overlap delayed needs a compressing --code (the mode "
+                "overlaps the encoded exchange+decode; dense training has "
+                "no delayed form)"
+            )
+        if args.n_devices == 1:
+            raise SystemExit(
+                "--overlap delayed needs a multi-device mesh: single-device "
+                "training has no exchange to take off the critical path"
+            )
+        if args.aggregate in ("psum", "hierarchical"):
+            raise SystemExit(
+                f"--overlap delayed does not compose with --aggregate "
+                f"{args.aggregate} (only the compressed gather/ring "
+                "exchanges have a delayed form)"
+            )
+        if args.phase_metrics:
+            raise SystemExit(
+                "--phase-metrics times blocking phase programs and cannot "
+                "describe the overlapped step; drop one of the flags"
+            )
+        if args.zero1 and args.max_restarts > 0 and args.train_dir:
+            raise SystemExit(
+                "--max-restarts with --zero1 --overlap delayed cannot work: "
+                "supervised restarts resume from checkpoints, and a "
+                "--zero1 run cannot resume the delayed in-flight payload "
+                "(the sharded optimizer template cannot carry it) — every "
+                "restart would fail instantly and burn the budget; drop "
+                "one of the three"
+            )
+    import os
+
+    chaos_specs = [args.chaos] if args.chaos else []
+    if not args.chaos and os.environ.get("ATOMO_CHAOS"):
+        # the flagless path: supervised children inherit the env, so a
+        # typo'd env spec would burn the budget exactly like a typo'd flag
+        chaos_specs.append(os.environ["ATOMO_CHAOS"])
+    for spec in chaos_specs:
+        from atomo_tpu.utils.chaos import ChaosConfig
+
+        try:
+            ChaosConfig.from_spec(spec)
+        except ValueError as exc:
+            # deterministic from argv/env: a typo'd fault spec must not
+            # re-exec jax-booting children through the whole restart budget
+            raise SystemExit(str(exc))
+    if args.on_diverge != "off":
+        from atomo_tpu.training.resilience import (
+            DetectorConfig,
+            diverge_conflict,
+        )
+
+        try:
+            # pure-python knob validation (window >= 2, patience >= 1, ...):
+            # degenerate detector knobs are argv-knowable and must fail here,
+            # not as a ValueError in every re-exec'd jax-booted child
+            DetectorConfig(
+                window=args.diverge_window,
+                zmax=args.diverge_zmax,
+                patience=args.diverge_patience,
+                min_history=args.diverge_min_history,
+            )
+        except ValueError as exc:
+            raise SystemExit(str(exc))
+
+        # mirror the in-run check's n_dev>1 gating as far as argv allows:
+        # multi-device features are claimed only for an explicit mesh
+        # (>= 2). --n-devices 0 (= all visible) is ambiguous without
+        # booting jax — on a 1-device host an aggressive claim would
+        # falsely reject configs the run accepts — so it defers to the
+        # in-run check, which is cheap now that deterministic in-run
+        # rejects exit CONFIG_EXIT_CODE and a supervisor gives up at once
+        multi = args.n_devices >= 2
+        reason = diverge_conflict(
+            args.on_diverge,
+            train_dir=args.train_dir,
+            codec=None if args.code.lower() in DENSE_CODES else args.code,
+            aggregate=args.aggregate if multi else None,
+            overlap=args.overlap,
+            zero1=args.zero1 and multi,
+            phase_metrics=args.phase_metrics,
+            num_aggregate=args.num_aggregate if multi else None,
+            keep_ckpts=args.keep_ckpts,
+            # the loops save every `save_freq or eval_freq` steps — check
+            # the cadence they will actually run with
+            save_freq=args.save_freq or args.eval_freq,
+            window=args.diverge_window,
+        )
+        if reason:
+            raise SystemExit(reason)
+
+
 def cmd_train(args: argparse.Namespace) -> int:
+    import os
+
     import jax
     import jax.numpy as jnp
 
     from atomo_tpu.parallel import launch
+    from atomo_tpu.training.resilience import (
+        SUPERVISED_ENV,
+        DivergenceError,
+        run_supervised,
+    )
+
+    _argv_preflight(args)
+
+    if args.max_restarts > 0 and os.environ.get(SUPERVISED_ENV) != "1":
+        # run-level supervision: re-exec this exact command as a child
+        # under the crash-loop budget; the child sees SUPERVISED_ENV and
+        # trains directly. Restarts get --resume appended.
+        argv = getattr(args, "_argv", None)
+        if argv is None:
+            warnings.warn(
+                "--max-restarts needs the CLI entrypoint's argv to re-exec "
+                "itself; running unsupervised (call atomo_tpu.cli.main, or "
+                "use scripts/supervise.py around your own command)"
+            )
+        else:
+            if not args.train_dir:
+                # legitimate (fresh restarts are the only supervised mode
+                # for zero1+delayed) but easy to hit by accident
+                warnings.warn(
+                    "--max-restarts with --train-dir '': checkpointing is "
+                    "off, so every restart retrains from step 0 and no "
+                    "incidents.jsonl is written"
+                )
+            return run_supervised(
+                [sys.executable, "-m", "atomo_tpu.cli"] + list(argv),
+                max_restarts=args.max_restarts,
+                backoff_base=args.restart_backoff,
+                backoff_max=args.restart_backoff * 30,
+                train_dir=args.train_dir,
+                # no checkpoint dir -> nothing to resume: appending
+                # --resume would deterministically kill every restart of
+                # the zero1+delayed fresh-restart mode (the loop rejects
+                # resuming the payload-less template) — mirror
+                # scripts/supervise.py's guard
+                resume_flag="--resume" if args.train_dir else None,
+            )
 
     _warn_dead_flags(args)
     if args.bf16:
@@ -444,12 +651,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         chaos = ChaosInjector(ChaosConfig.from_spec(args.chaos))
     # (no --chaos: the train loops read ATOMO_CHAOS from the env)
 
-    superstep = args.superstep
-    if superstep < 0:
-        raise SystemExit(
-            f"--superstep {superstep}: must be >= 1 (or 0 for the "
-            "per-backend auto default)"
-        )
+    superstep = args.superstep  # < 0 already rejected by _argv_preflight
     if superstep == 0:
         # backend default: dispatch overhead is what superstepping buys
         # back — material on tunneled TPU backends (~ms per dispatch),
@@ -463,31 +665,48 @@ def cmd_train(args: argparse.Namespace) -> int:
         )
         superstep = 1
     n_dev = args.n_devices or len(jax.devices())
-    if args.overlap == "delayed":
-        # the delayed mode's requirements are all knowable from argv +
-        # device count: fail fast with the reason, never at trace time
-        if args.code.lower() in DENSE_CODES:
-            raise SystemExit(
-                "--overlap delayed needs a compressing --code (the mode "
-                "overlaps the encoded exchange+decode; dense training has "
-                "no delayed form)"
-            )
-        if n_dev <= 1:
-            raise SystemExit(
-                "--overlap delayed needs a multi-device mesh: single-device "
-                "training has no exchange to take off the critical path"
-            )
-        if args.aggregate in ("psum", "hierarchical"):
-            raise SystemExit(
-                f"--overlap delayed does not compose with --aggregate "
-                f"{args.aggregate} (only the compressed gather/ring "
-                "exchanges have a delayed form)"
-            )
-        if args.phase_metrics:
-            raise SystemExit(
-                "--phase-metrics times blocking phase programs and cannot "
-                "describe the overlapped step; drop one of the flags"
-            )
+    diverge = None
+    if args.on_diverge != "off":
+        from atomo_tpu.training.resilience import (
+            DetectorConfig,
+            DivergeConfig,
+            diverge_conflict,
+        )
+
+        # multi-device-only features are "off" for the single-device loop
+        reason = diverge_conflict(
+            args.on_diverge,
+            train_dir=args.train_dir,
+            codec=codec,
+            aggregate=args.aggregate if n_dev > 1 else None,
+            overlap=args.overlap,
+            zero1=args.zero1 and n_dev > 1,
+            phase_metrics=args.phase_metrics,
+            num_aggregate=args.num_aggregate if n_dev > 1 else None,
+            keep_ckpts=args.keep_ckpts,
+            save_freq=save_freq,
+            window=args.diverge_window,
+        )
+        if reason:
+            raise SystemExit(reason)
+        diverge = DivergeConfig(
+            remedy=args.on_diverge,
+            detector=DetectorConfig(
+                window=args.diverge_window,
+                zmax=args.diverge_zmax,
+                patience=args.diverge_patience,
+                min_history=args.diverge_min_history,
+            ),
+            max_rollbacks=args.max_rollbacks,
+        )
+    if args.overlap == "delayed" and n_dev <= 1:
+        # the argv-knowable delayed-mode conflicts were rejected by
+        # _argv_preflight; this one needs the resolved device count
+        # (--n-devices 0 = all visible)
+        raise SystemExit(
+            "--overlap delayed needs a multi-device mesh: single-device "
+            "training has no exchange to take off the critical path"
+        )
     if n_dev > 1:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
         from atomo_tpu.training import stepwise_shrink
@@ -563,24 +782,28 @@ def cmd_train(args: argparse.Namespace) -> int:
                     f"{n_dev}-device mesh; aggregating all replicas"
                 )
                 k_agg = 0
-        distributed_train_loop(
-            model, optimizer, mesh, train_iter, test_iter,
-            codec=codec, aggregate=args.aggregate, augment=augment,
-            num_aggregate=k_agg, zero1=args.zero1,
-            grad_accum=args.grad_accum, inner_axis=inner_axis,
-            max_steps=max_steps, eval_freq=args.eval_freq, seed=args.seed,
-            train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
-            compress_ckpt=args.compress, log_every=args.log_interval,
-            health_timeout=args.health_timeout,
-            guard=guard, chaos=chaos, keep_ckpts=args.keep_ckpts,
-            phase_metrics=args.phase_metrics,
-            lr_fn=stepwise_shrink(args.lr, args.lr_shrinkage, args.shrinkage_freq),
-            profile_dir=args.profile_dir or None,
-            compute_dtype=jnp.bfloat16 if args.bf16 else None,
-            superstep=superstep,
-            ring_bucket_size=args.ring_bucket_size,
-            overlap=args.overlap,
-        )
+        try:
+            distributed_train_loop(
+                model, optimizer, mesh, train_iter, test_iter,
+                codec=codec, aggregate=args.aggregate, augment=augment,
+                num_aggregate=k_agg, zero1=args.zero1,
+                grad_accum=args.grad_accum, inner_axis=inner_axis,
+                max_steps=max_steps, eval_freq=args.eval_freq, seed=args.seed,
+                train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
+                compress_ckpt=args.compress, log_every=args.log_interval,
+                health_timeout=args.health_timeout,
+                guard=guard, chaos=chaos, keep_ckpts=args.keep_ckpts,
+                phase_metrics=args.phase_metrics,
+                lr_fn=stepwise_shrink(args.lr, args.lr_shrinkage, args.shrinkage_freq),
+                profile_dir=args.profile_dir or None,
+                compute_dtype=jnp.bfloat16 if args.bf16 else None,
+                superstep=superstep,
+                ring_bucket_size=args.ring_bucket_size,
+                overlap=args.overlap,
+                diverge=diverge,
+            )
+        except DivergenceError as exc:
+            return _diverged_exit(exc)
     else:
         from atomo_tpu.training import train_loop
 
@@ -600,16 +823,20 @@ def cmd_train(args: argparse.Namespace) -> int:
                 "--grad-accum is only wired into the multi-device step; "
                 "single-device training ignores it"
             )
-        train_loop(
-            model, optimizer, train_iter, test_iter,
-            codec=codec, augment=augment, max_steps=max_steps,
-            eval_freq=args.eval_freq, seed=args.seed,
-            train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
-            compress_ckpt=args.compress, log_every=args.log_interval,
-            compute_dtype=jnp.bfloat16 if args.bf16 else None,
-            guard=guard, chaos=chaos, health_timeout=args.health_timeout,
-            keep_ckpts=args.keep_ckpts, superstep=superstep,
-        )
+        try:
+            train_loop(
+                model, optimizer, train_iter, test_iter,
+                codec=codec, augment=augment, max_steps=max_steps,
+                eval_freq=args.eval_freq, seed=args.seed,
+                train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
+                compress_ckpt=args.compress, log_every=args.log_interval,
+                compute_dtype=jnp.bfloat16 if args.bf16 else None,
+                guard=guard, chaos=chaos, health_timeout=args.health_timeout,
+                keep_ckpts=args.keep_ckpts, superstep=superstep,
+                diverge=diverge,
+            )
+        except DivergenceError as exc:
+            return _diverged_exit(exc)
     return 0
 
 
@@ -1177,8 +1404,28 @@ def main(argv=None) -> int:
         argv = ["train", "--help"]
     parser = build_parser()
     args = parser.parse_args(argv)
+    args._argv = argv  # the supervisor re-execs this exact command
     return args.fn(args)
 
 
+def cli_entry() -> int:
+    """Process entry (python -m atomo_tpu / atomo_tpu.cli): every
+    message-carrying SystemExit in this CLI is a deterministic config
+    reject (preflight and subcommand validation alike), so convert it to
+    CONFIG_EXIT_CODE here — a supervising parent, ours or the generic
+    scripts/supervise.py, then gives up at once instead of retrying an
+    identical failure. In-process callers of :func:`main` (tests) keep
+    the raising behavior with the message attached."""
+    try:
+        return main()
+    except SystemExit as exc:
+        if isinstance(exc.code, str):
+            from atomo_tpu.training.resilience import CONFIG_EXIT_CODE
+
+            print(exc.code, file=sys.stderr, flush=True)
+            return CONFIG_EXIT_CODE
+        raise
+
+
 if __name__ == "__main__":
-    raise SystemExit(main())
+    raise SystemExit(cli_entry())

@@ -106,6 +106,24 @@ class BatchIterator:
                     continue
                 yield self.images[sel], self.labels[sel]
 
+    def snapshot_rng(self):
+        """Capture the shuffle-RNG state. Take it immediately BEFORE the
+        first :meth:`forever` call and hand it to :meth:`restream` — the
+        in-process rollback-replay contract (see restream)."""
+        return self._rng.get_state()
+
+    def restream(self, rng_state, skip: int = 0):
+        """Fresh replay stream for an IN-PROCESS rollback: restore the
+        shuffle RNG to ``rng_state`` (the :meth:`snapshot_rng` taken when
+        the original stream was created) and skip ``skip`` batches.
+        ``forever`` draws epoch shuffles from the live RNG, so simply
+        calling it again mid-run would shuffle from an already-advanced
+        state and hand the rolled-back run a batch sequence no fresh
+        resume would ever see; restoring the snapshot makes the replay
+        bit-identical to a restarted process's ``forever(skip=...)``."""
+        self._rng.set_state(rng_state)
+        return self.forever(skip=skip)
+
 
 class BlockStream:
     """Stack consecutive batches of an endless stream into ``(K, batch,

@@ -42,6 +42,7 @@ pmean-ed so replicas stay exactly consistent.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Any, Optional
 
@@ -154,6 +155,26 @@ def _zero_carry_host(codec, params, n_dev: int) -> OverlapCarry:
     )
 
 
+def _place_carry(
+    mesh: Mesh, carry: OverlapCarry, *, axis: str = "dp"
+) -> OverlapCarry:
+    """Place a host-side :class:`OverlapCarry` onto the mesh: payload and
+    per-source ok flags sharded over ``axis``, the scalar valid
+    replicated. Fresh init, --resume, and rollback recovery all MUST
+    place the carry identically, or a restored trajectory drifts from an
+    uninterrupted one."""
+    sh = NamedSharding(mesh, P(axis))
+    return OverlapCarry(
+        payload=jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.asarray(a), sh), carry.payload
+        ),
+        ok=jax.device_put(jnp.asarray(carry.ok), sh),
+        valid=jax.device_put(
+            jnp.asarray(carry.valid), NamedSharding(mesh, P())
+        ),
+    )
+
+
 def init_delayed_state(
     mesh: Mesh, state: TrainState, codec, *, axis: str = "dp"
 ) -> DelayedState:
@@ -162,16 +183,8 @@ def init_delayed_state(
     payload sharded over ``axis``, all-healthy flags, ``valid=0``."""
     n_dev = mesh.shape[axis]
     carry = _zero_carry_host(codec, jax.device_get(state.params), n_dev)
-    sh = NamedSharding(mesh, P(axis))
     return DelayedState(
-        train=state,
-        carry=OverlapCarry(
-            payload=jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, sh), carry.payload
-            ),
-            ok=jax.device_put(carry.ok, sh),
-            valid=jax.device_put(carry.valid, NamedSharding(mesh, P())),
-        ),
+        train=state, carry=_place_carry(mesh, carry, axis=axis)
     )
 
 
@@ -404,9 +417,22 @@ def make_distributed_train_step(
     ring_bucket_size: int = 65536,
     unfused_decode: bool = False,
     overlap: str = "off",
+    remedy=None,
+    track_grad_norm: bool = False,
     _oracle_parts: bool = False,
 ):
     """Build the jitted SPMD train step over ``mesh``.
+
+    ``remedy`` (training.resilience.RemedyConfig) applies the divergence
+    doctor's rewarm ramp: the aggregated mean gradient is pre-scaled by
+    ``remedy_scale(remedy, step)`` — a function of the carried step
+    counter, so superstep partitions agree bitwise; scaling an unbiased
+    mean keeps it unbiased. ``track_grad_norm`` adds
+    ``metrics["grad_norm"]`` (mean of per-replica raw global-L2 norms —
+    healthy replicas only when the guard is armed, so a masked chip's
+    huge-but-finite norm cannot fire the detector on a contained fault)
+    for the detector's trend counter. Both default OFF and then add no
+    ops — the compiled programs are byte-identical to before.
 
     ``overlap="delayed"`` (requires a codec with ``aggregate`` 'gather' or
     'ring') builds the stale-by-one overlapped step instead: at step t each
@@ -688,10 +714,22 @@ def make_distributed_train_step(
             grads = chaos.inject_grads(grads, state.step + 1, replica=my)
         return my, k_codec, grads, loss, prec1, prec5, new_stats
 
+    def _local_grad_norm(grads):
+        """THIS replica's raw global-L2 (pre-screen, pre-codec). Reduced
+        to the cross-chip trend series the divergence detector folds at
+        metric-assembly time, where the guard verdict is known: a
+        guard-REJECTED replica's norm must not enter the detector's
+        gn_ref baseline (the detector_update invariant), so the guarded
+        path folds healthy chips only."""
+        from atomo_tpu.training.resilience import global_sq_norm
+
+        return jnp.sqrt(global_sq_norm(grads))
+
     def spmd_step(state: TrainState, key, images, labels):
         my, k_codec, grads, loss, prec1, prec5, new_stats = compute_grads(
             state, key, images, labels
         )
+        gnorm = _local_grad_norm(grads) if track_grad_norm else None
 
         ok = kept = None  # guard-mode: local health flag / surviving count
         n_contrib = k_agg or n_dev  # contributions in the average
@@ -817,6 +855,10 @@ def make_distributed_train_step(
             else:
                 raise ValueError(f"unknown aggregate mode {aggregate!r}")
 
+        if remedy is not None:
+            from atomo_tpu.training.resilience import apply_remedy
+
+            mean_grads = apply_remedy(remedy, state.step, mean_grads)
         if zero1_specs is None:
             # replicated optimizer update == the PS-side momentum SGD step
             updates, new_opt = optimizer.update(
@@ -871,6 +913,17 @@ def make_distributed_train_step(
                 "skipped": 1.0 - ok_step.astype(jnp.float32),
                 "dropped": n_contrib - kept,
             }
+        if gnorm is not None:
+            if guard is None:
+                metrics["grad_norm"] = jax.lax.pmean(gnorm, metric_axes)
+            else:
+                # healthy-only, like loss/prec above: a masked replica's
+                # huge-but-finite norm would otherwise dominate the series
+                # and fire grad_norm_trend on a fault rung 1 already
+                # contained
+                metrics["grad_norm"] = _healthy_mean(
+                    gnorm, ok, kept_chips, metric_axes
+                )
         new_state = TrainState(
             step=state.step + 1,
             params=new_params,
@@ -898,6 +951,7 @@ def make_distributed_train_step(
             my, k_codec, grads, loss, prec1, prec5, new_stats = compute_grads(
                 state, key, images, labels
             )
+            gnorm = _local_grad_norm(grads) if track_grad_norm else None
             ok_t = (
                 grad_ok(grads, guard.max_grad_norm)
                 if guard is not None
@@ -920,6 +974,23 @@ def make_distributed_train_step(
                 }
             pm["msg_bytes"] = jnp.asarray(stats.payload_bytes, jnp.float32)
             pm["dense_bytes"] = jnp.asarray(tree_nbytes(grads), jnp.float32)
+            if guard is not None and track_grad_norm:
+                # the doctor's gate must follow THIS forward, not the
+                # consumed payload: metrics["skipped"] describes step t-1's
+                # payload, so on a step whose every forward NaN-ed it would
+                # report 0 while _healthy_mean collapses the loss to 0.0 —
+                # an invalid sample the detector would fold as clean
+                pm["sample_skipped"] = 1.0 - (kept_chips > 0).astype(
+                    jnp.float32
+                )
+            if gnorm is not None:
+                # healthy-only under the guard, mirroring spmd_step: the
+                # detector series must exclude guard-rejected replicas
+                pm["grad_norm"] = (
+                    _healthy_mean(gnorm, ok_t, kept_chips, axis)
+                    if guard is not None
+                    else jax.lax.pmean(gnorm, axis)
+                )
             payload_x = jax.tree_util.tree_map(lambda a: a[None], payloads)
             ok_x = (
                 ok_t.astype(jnp.float32)
@@ -1012,6 +1083,12 @@ def make_distributed_train_step(
                     mean_grads = rescale_by_survivors(
                         mean_grads, n_contrib_d, kept
                     )
+            if remedy is not None:
+                from atomo_tpu.training.resilience import apply_remedy
+
+                # the update applied HERE is the remedy's subject, so the
+                # ramp follows this (consuming) step's counter
+                mean_grads = apply_remedy(remedy, state.step, mean_grads)
             if zero1_specs is None:
                 updates, new_opt = optimizer.update(
                     mean_grads, opt_state, params
@@ -1375,6 +1452,7 @@ def distributed_train_loop(
     superstep: int = 1,
     ring_bucket_size: int = 65536,
     overlap: str = "off",
+    diverge=None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -1415,11 +1493,28 @@ def distributed_train_loop(
     DelayedState (``.params``/``.batch_stats``/``.step`` read through).
     Resuming a ``--zero1`` delayed run is not supported (the sharded
     optimizer template cannot be rebuilt around the carried payload);
-    everything else — superstep, guard, chaos, ring/gather — composes."""
+    everything else — superstep, guard, chaos, ring/gather — composes.
+
+    ``diverge`` (training.resilience.DivergeConfig) arms the divergence
+    doctor exactly as in training.train_loop: windowed detection over the
+    per-step metric series, healthy-tagged checkpoints, rollback+remedy
+    with data-stream replay. A ``--overlap delayed`` rollback restores the
+    in-flight encoded payload too (delayed checkpoints carry it), so the
+    rolled-back trajectory is the same program family's uninterrupted
+    one. Not supported with ``--zero1`` (the sharded optimizer template
+    cannot be rebuilt mid-run) or ``--phase-metrics``."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
-    from atomo_tpu.training.resilience import heartbeat_watchdog, resolve_chaos
+    from atomo_tpu.training.resilience import (
+        SUPERVISED_ENV,
+        DivergenceDoctor,
+        RecoveryRig,
+        diverge_conflict,
+        heartbeat_watchdog,
+        resolve_chaos,
+    )
     from atomo_tpu.training.trainer import create_state
     from atomo_tpu.utils.metrics import StepMetrics, Timer
+    from atomo_tpu.utils.tracing import IncidentLog
 
     if overlap not in ("off", "delayed"):
         raise ValueError(
@@ -1443,7 +1538,25 @@ def distributed_train_loop(
                 "sharded optimizer template cannot carry the overlap "
                 "payload); drop --resume or --zero1"
             )
+    if diverge is not None:
+        reason = diverge_conflict(
+            diverge.remedy,
+            train_dir=train_dir,
+            codec=codec,
+            aggregate=aggregate,
+            overlap=overlap,
+            zero1=zero1,
+            phase_metrics=phase_metrics,
+            num_aggregate=num_aggregate,
+            keep_ckpts=keep_ckpts,
+            save_freq=save_freq,
+            window=diverge.detector.window,
+        )
+        if reason:
+            raise ValueError(reason)
     chaos = resolve_chaos(chaos)
+    if chaos is not None:
+        chaos.maybe_die_crashloop()  # crashloop@M: attempt-keyed death
     sample_images, _ = next(iter(train_iter.epoch()))
     state = create_state(
         model, optimizer, jax.random.PRNGKey(seed), jnp.asarray(sample_images)
@@ -1595,22 +1708,9 @@ def distributed_train_loop(
         state = replicate_state(mesh, state)
     if overlap == "delayed":
         if delayed_carry_host is not None:
-            sh = NamedSharding(mesh, P("dp"))
             state = DelayedState(
                 train=state,
-                carry=OverlapCarry(
-                    payload=jax.tree_util.tree_map(
-                        lambda a: jax.device_put(jnp.asarray(a), sh),
-                        delayed_carry_host.payload,
-                    ),
-                    ok=jax.device_put(
-                        jnp.asarray(delayed_carry_host.ok), sh
-                    ),
-                    valid=jax.device_put(
-                        jnp.asarray(delayed_carry_host.valid),
-                        NamedSharding(mesh, P()),
-                    ),
-                ),
+                carry=_place_carry(mesh, delayed_carry_host),
             )
         else:
             state = init_delayed_state(mesh, state, codec)
@@ -1655,15 +1755,28 @@ def distributed_train_loop(
             model, optimizer, mesh, codec, augment=augment,
             compute_dtype=compute_dtype,
         )
+        build_step = None
     else:
-        step_fn = make_distributed_train_step(
-            model, optimizer, mesh, codec, aggregate=aggregate, augment=augment,
-            num_aggregate=num_aggregate, compute_dtype=compute_dtype,
-            zero1_specs=zero1_specs, grad_accum=grad_accum,
-            inner_axis=inner_axis, guard=guard, chaos=chaos,
-            superstep=superstep, ring_bucket_size=ring_bucket_size,
-            overlap=overlap,
-        )
+
+        def build_step(generation=0, remedy_cfg=None, densify=False):
+            chaos_now = (
+                chaos.with_generation(generation)
+                if chaos is not None and generation
+                else chaos
+            )
+            return make_distributed_train_step(
+                model, optimizer, mesh,
+                None if densify else codec,
+                aggregate=aggregate, augment=augment,
+                num_aggregate=num_aggregate, compute_dtype=compute_dtype,
+                zero1_specs=zero1_specs, grad_accum=grad_accum,
+                inner_axis=inner_axis, guard=guard, chaos=chaos_now,
+                superstep=superstep, ring_bucket_size=ring_bucket_size,
+                overlap="off" if densify else overlap,
+                remedy=remedy_cfg, track_grad_norm=diverge is not None,
+            )
+
+        step_fn = build_step()
     batch_axes = ("dp", inner_axis) if aggregate == "hierarchical" else "dp"
     eval_fn = (
         make_distributed_eval_step(model, mesh, axis=batch_axes)
@@ -1674,9 +1787,55 @@ def distributed_train_loop(
     timer = Timer()
     # replay: skip the batches the interrupted run consumed so the resumed
     # data order matches the uninterrupted run's (index-only — one shuffle
-    # per skipped epoch, no data copies, nothing for the watchdog to see)
+    # per skipped epoch, no data copies, nothing for the watchdog to see).
+    # The RNG snapshot is the rollback engine's replay anchor; it MUST
+    # precede forever() (which advances the shuffle RNG) and is a
+    # doctor-only iterator requirement — disarmed loops keep the old
+    # iterator contract.
+    rng_snapshot = train_iter.snapshot_rng() if diverge is not None else None
     stream = train_iter.forever(skip=start_step)
     n_train = len(train_iter.dataset)
+    rig = None
+    incidents = None
+    if train_dir and (
+        diverge is not None or os.environ.get(SUPERVISED_ENV) == "1"
+    ):
+        incidents = IncidentLog.for_train_dir(train_dir)
+    if diverge is not None:
+
+        def _reload(target):
+            host = jax.device_get(create_state(
+                model, optimizer, jax.random.PRNGKey(seed),
+                jnp.asarray(sample_images),
+            ))
+            if overlap == "delayed":
+                tpl = DelayedState(
+                    train=host,
+                    carry=_zero_carry_host(
+                        codec, host.params, mesh.shape["dp"]
+                    ),
+                )
+                if target <= 0:
+                    restored = tpl  # from scratch: nothing in flight
+                else:
+                    restored = load_checkpoint(train_dir, tpl, step=target)
+                return DelayedState(
+                    train=replicate_state(mesh, restored.train),
+                    carry=_place_carry(mesh, restored.carry),
+                )
+            if target <= 0:
+                return replicate_state(mesh, host)
+            return replicate_state(
+                mesh, load_checkpoint(train_dir, host, step=target)
+            )
+
+        rig = RecoveryRig(
+            DivergenceDoctor(diverge, train_dir, incidents, log_fn),
+            diverge,
+            _reload,
+            lambda target: train_iter.restream(rng_snapshot, skip=target),
+            build_step,
+        )
     # superstep mode beats the watchdog once per BLOCK: scale the budget
     # by K so a per-step-tuned --health-timeout does not falsely fire
     with heartbeat_watchdog(
@@ -1690,6 +1849,7 @@ def distributed_train_loop(
                 log_every, log_fn, eval_freq, save_freq, train_dir,
                 compress_ckpt, monitor, profile_dir, batch_axes,
                 guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
+                rig=rig, incidents=incidents,
             )
         else:
             state = _distributed_steps(
@@ -1698,6 +1858,7 @@ def distributed_train_loop(
                 eval_freq, save_freq, train_dir, compress_ckpt, monitor, lr_fn,
                 profile_dir, profile_steps, batch_axes,
                 guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
+                rig=rig, incidents=incidents,
             )
     return state
 
@@ -1758,18 +1919,20 @@ def _distributed_steps(
     timer, n_train, start_step, max_steps, log_every, log_fn, eval_freq,
     save_freq, train_dir, compress_ckpt, monitor, lr_fn=None,
     profile_dir=None, profile_steps=3, batch_axes="dp",
-    guard=None, chaos=None, keep_ckpts=0,
+    guard=None, chaos=None, keep_ckpts=0, rig=None, incidents=None,
 ):
     from atomo_tpu.training.resilience import retrying_saver
     from atomo_tpu.utils.metrics import StepMetrics, master_line
     from atomo_tpu.utils.tracing import profile
 
-    save_fn = retrying_saver(log_fn)
+    save_fn = retrying_saver(log_fn, incidents)
     last_saved = start_step
     # trace steady-state steps only: step 1 is dominated by compilation
     prof_first = start_step + 2 if profile_dir else None
     prof_ctx = None
-    for step in range(start_step + 1, max_steps + 1):
+    step = start_step
+    while step < max_steps:
+        step += 1
         if chaos is not None:
             chaos.maybe_die(step)
             chaos.maybe_sleep(step)
@@ -1789,6 +1952,26 @@ def _distributed_steps(
         if monitor is not None:
             jax.block_until_ready(metrics["loss"])
             monitor.beat(step)
+        if rig is not None:
+            # one scalar fetch per step — the price of per-step rollback
+            # granularity (superstep mode amortizes it into the block's
+            # single fetch)
+            alarm_step, reason = rig.observe(step, metrics)
+            if reason is not None:
+                if prof_ctx is not None:
+                    # close the in-flight trace before the timeline jumps;
+                    # leaving it open would crash the replay's re-entry
+                    prof_ctx.__exit__(None, None, None)
+                    prof_ctx = None
+                prof_first = None  # don't double-trace the replayed window
+                state, stream, step_fn, chaos, step = rig.recover(
+                    alarm_step, reason, chaos
+                )
+                last_saved = min(last_saved, step)
+                continue
+            new_fn = rig.maybe_end_densify(step)
+            if new_fn is not None:
+                step_fn = new_fn
         # guard diagnostics share the log cadence: a per-step device->host
         # fetch would serialize async dispatch even on all-healthy steps
         if (
@@ -1841,6 +2024,8 @@ def _distributed_steps(
                 compress=compress_ckpt, keep=keep_ckpts,
             )
             last_saved = step
+            if rig is not None:
+                rig.note_save(step)
             if chaos is not None:
                 chaos.maybe_corrupt_checkpoint(path, step)
     # autosave the final state so a restart never replays the tail
@@ -1851,6 +2036,8 @@ def _distributed_steps(
             train_dir, jax.device_get(state), max_steps,
             compress=compress_ckpt, keep=keep_ckpts,
         )
+        if rig is not None:
+            rig.note_save(max_steps)
         if chaos is not None:  # ckpt faults target autosaves too
             chaos.maybe_corrupt_checkpoint(path, max_steps)
     if prof_ctx is not None:  # run shorter than the profiled window
@@ -1906,11 +2093,15 @@ def _distributed_superstep_steps(
     timer, n_train, start_step, max_steps, superstep, log_every, log_fn,
     eval_freq, save_freq, train_dir, compress_ckpt, monitor,
     profile_dir=None, batch_axes="dp", guard=None, chaos=None, keep_ckpts=0,
+    rig=None, incidents=None,
 ):
     """distributed_train_loop's fused block path: one SPMD dispatch per K
     steps, one metric fetch per block, next block's shard_superbatch
     transfer double-buffered behind the running block. Cadence semantics
-    match training.trainer._superstep_steps (boundary-snapped)."""
+    match training.trainer._superstep_steps (boundary-snapped), including
+    the divergence doctor's: the block's (K,) metric series feeds the
+    detector at the block's one fetch, and a rollback rebuilds the feed
+    from the replayed stream."""
     import numpy as np
 
     from atomo_tpu.data.pipeline import BlockStream, SuperstepFeed
@@ -1922,11 +2113,11 @@ def _distributed_superstep_steps(
     )
     from atomo_tpu.utils.tracing import profile
 
-    save_fn = retrying_saver(log_fn)
-    feed = SuperstepFeed(
-        BlockStream(stream),
-        lambda im, lb: shard_superbatch(mesh, im, lb, axis=batch_axes),
+    save_fn = retrying_saver(log_fn, incidents)
+    put_fn = lambda im, lb: shard_superbatch(  # noqa: E731
+        mesh, im, lb, axis=batch_axes
     )
+    feed = SuperstepFeed(BlockStream(stream), put_fn)
     s = start_step
     last_saved = start_step
     last_logged = start_step
@@ -1956,6 +2147,21 @@ def _distributed_superstep_steps(
             prof_ctx = None
         if monitor is not None:
             monitor.beat(s)
+        if rig is not None:
+            alarm_step, reason = rig.observe(b0 + 1, m)
+            if reason is not None:
+                state, stream, step_fn, chaos, s = rig.recover(
+                    alarm_step, reason, chaos
+                )
+                last_saved = min(last_saved, s)
+                last_logged = min(last_logged, s)
+                # drop the staged lookahead block: discarded timeline
+                feed = SuperstepFeed(BlockStream(stream), put_fn)
+                feed.start(min(superstep, max_steps - s))
+                continue
+            new_fn = rig.maybe_end_densify(s)
+            if new_fn is not None:
+                step_fn = new_fn
         if guard is not None and _crossed(log_every, b0, s):
             n_drop = float(np.sum(m.get("dropped", 0.0)))
             if n_drop > 0:
@@ -1982,6 +2188,8 @@ def _distributed_superstep_steps(
                 compress=compress_ckpt, keep=keep_ckpts,
             )
             last_saved = s
+            if rig is not None:
+                rig.note_save(s)
             # ckpt faults snap like kill/sleep: a fault aimed anywhere in
             # this block corrupts the boundary file
             _chaos_corrupt_range(chaos, path, b0, s)
@@ -1991,6 +2199,8 @@ def _distributed_superstep_steps(
             train_dir, jax.device_get(state), max_steps,
             compress=compress_ckpt, keep=keep_ckpts,
         )
+        if rig is not None:
+            rig.note_save(max_steps)
         _chaos_corrupt_range(chaos, path, last_saved, max_steps)
     return state
 

@@ -3,19 +3,32 @@ optimizers, checkpointing."""
 
 from atomo_tpu.training.checkpoint import (  # noqa: F401
     CorruptCheckpointError,
+    latest_healthy_step,
     latest_step,
     latest_valid_step,
     list_steps,
     load_checkpoint,
     load_params,
     load_sharded_checkpoint,
+    mark_healthy,
+    prune_after,
     save_checkpoint,
     verify_checkpoint,
 )
 from atomo_tpu.training.optim import make_optimizer, stepwise_shrink  # noqa: F401
 from atomo_tpu.training.resilience import (  # noqa: F401
+    ROLLBACK_EXIT_CODE,
+    DetectorConfig,
+    DetectorState,
+    DivergeConfig,
+    DivergenceDoctor,
+    DivergenceError,
     GuardConfig,
+    RemedyConfig,
+    detector_scan,
+    detector_update,
     grad_ok,
+    run_supervised,
     with_retries,
 )
 from atomo_tpu.training.trainer import (  # noqa: F401

@@ -25,6 +25,24 @@ tore its final write resumes from the last good state instead of dying on
 the bad file. ``save_checkpoint(..., keep=K)`` prunes all but the newest K
 steps after a successful atomic rename. Legacy headers (pre-CRC ``ATMO``/
 ``ATMZ``) still load; they simply have no CRC to check.
+
+Healthy tags (divergence-doctor tentpole): *valid* means the bytes are
+intact; *healthy* means the TRAJECTORY was still sane when the file was
+written — a run can diverge with perfectly finite gradients and keep
+writing valid checkpoints of garbage weights. The divergence detector
+grants the healthy tag (:func:`mark_healthy`, a ``model_step_N.healthy``
+sidecar) only after its observation window clears past the save step, and
+the rollback engine targets :func:`latest_healthy_step` — never a merely
+valid file. :func:`prune_after` discards the post-divergence timeline so a
+later ``--resume`` cannot land on a diverged checkpoint.
+
+Verification memoization: the rollback engine and supervisor scan the
+checkpoint directory repeatedly; full verification re-reads and re-parses
+every candidate blob. Verdicts are memoized by ``(path, mtime_ns, size,
+inode)`` — a rewritten or chaos-corrupted file (``os.replace``) changes
+its stat and drops the cached verdict, so repeated ``latest_valid_step`` /
+``latest_healthy_step`` scans cost one ``stat`` per candidate instead of a
+full read.
 """
 
 from __future__ import annotations
@@ -72,6 +90,106 @@ def list_steps(train_dir: str) -> list[int]:
 def latest_step(train_dir: str) -> Optional[int]:
     steps = list_steps(train_dir)
     return steps[-1] if steps else None
+
+
+# ---- verification memoization ------------------------------------------
+# path -> ((mtime_ns, size, inode), crc_ok, full_ok). full_ok is None when
+# only the cheap CRC probe has run for this stat; a full verify fills it
+# in. Any stat change invalidates; the inode guards against a same-size
+# rewrite landing inside one mtime tick on coarse-granularity filesystems
+# (NFS) — every save and chaos corruption goes through os.replace, which
+# always allocates a fresh inode.
+
+_verify_cache: dict[str, tuple[tuple[int, int, int], bool, Optional[bool]]] = {}
+
+
+def reset_verify_cache() -> None:
+    """Drop all memoized verification verdicts (test hook)."""
+    _verify_cache.clear()
+
+
+def _cache_key(path: str) -> Optional[tuple[int, int, int]]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        _verify_cache.pop(path, None)
+        return None
+    return st.st_mtime_ns, st.st_size, st.st_ino
+
+
+def _cache_get(path: str, *, full: bool) -> Optional[bool]:
+    key = _cache_key(path)
+    if key is None:
+        return False  # missing file: definitively invalid
+    hit = _verify_cache.get(path)
+    if hit is None or hit[0] != key:
+        return None
+    if full:
+        return hit[2]  # may be None: only the CRC probe ran
+    return hit[1]
+
+
+def _cache_put(path: str, *, crc_ok: bool, full_ok: Optional[bool]) -> None:
+    key = _cache_key(path)
+    if key is None:
+        return
+    prev = _verify_cache.get(path)
+    if full_ok is None and prev is not None and prev[0] == key:
+        full_ok = prev[2]  # keep a stronger verdict the probe can't give
+    _verify_cache[path] = (key, crc_ok, full_ok)
+
+
+# ---- healthy tags -------------------------------------------------------
+
+
+def healthy_marker_path(train_dir: str, step: int) -> str:
+    return checkpoint_path(train_dir, step) + ".healthy"
+
+
+def mark_healthy(train_dir: str, step: int) -> None:
+    """Grant the healthy tag to model_step_N (atomic sidecar write). Only
+    the divergence detector should call this — the tag asserts the
+    trajectory was still sane a full observation window PAST this step."""
+    path = healthy_marker_path(train_dir, step)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("healthy\n")
+    os.replace(tmp, path)
+
+
+def is_marked_healthy(train_dir: str, step: int) -> bool:
+    return os.path.exists(healthy_marker_path(train_dir, step))
+
+
+def latest_healthy_step(train_dir: str) -> Optional[int]:
+    """Newest step that is BOTH healthy-tagged and passes integrity
+    verification (a tagged file can still be torn by a later crash)."""
+    for s in reversed(list_steps(train_dir)):
+        if is_marked_healthy(train_dir, s) and verify_checkpoint(train_dir, s):
+            return s
+    return None
+
+
+def prune_after(train_dir: str, step: int) -> list[int]:
+    """Remove every model_step_N (and its healthy sidecar) with N > step —
+    the rollback engine's timeline cut: after rolling back to ``step``, the
+    diverged checkpoints above it must not be resume candidates. Returns
+    the steps removed (best-effort; missing files are skipped)."""
+    removed = []
+    for s in list_steps(train_dir):
+        if s <= step:
+            continue
+        for path in (
+            checkpoint_path(train_dir, s),
+            healthy_marker_path(train_dir, s),
+        ):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        _verify_cache.pop(checkpoint_path(train_dir, s), None)
+        removed.append(s)
+    return removed
 
 
 _warned_compress_fallback = False
@@ -133,22 +251,48 @@ def save_checkpoint(
         # probe costs one file read per retained candidate — proportional
         # to the write this save just did.
         retained = 0
+        anchor_kept = is_marked_healthy(train_dir, step)
         for s in sorted(
             (s for s in list_steps(train_dir) if s != step), reverse=True
         ):
             if retained < keep - 1 and _crc_ok(checkpoint_path(train_dir, s)):
                 retained += 1
+                anchor_kept = anchor_kept or is_marked_healthy(train_dir, s)
                 continue
-            try:
-                os.remove(checkpoint_path(train_dir, s))
-            except OSError:
-                pass  # already gone / perms: retention is best-effort
+            if (
+                not anchor_kept
+                and is_marked_healthy(train_dir, s)
+                and _crc_ok(checkpoint_path(train_dir, s))
+            ):
+                # the newest healthy-tagged checkpoint is the rollback
+                # anchor: deleting it would leave latest_healthy_step()
+                # empty and turn the doctor's next rollback into a
+                # from-scratch restart. It rides outside the keep budget
+                # until a newer save earns the tag and supersedes it.
+                anchor_kept = True
+                continue
+            # the healthy sidecar follows its checkpoint out: an orphaned
+            # tag would let a FUTURE file reusing the step number inherit
+            # a health verdict it never earned
+            for victim in (
+                checkpoint_path(train_dir, s),
+                healthy_marker_path(train_dir, s),
+            ):
+                try:
+                    os.remove(victim)
+                except OSError:
+                    pass  # already gone / perms: retention is best-effort
+            _verify_cache.pop(checkpoint_path(train_dir, s), None)
     return path
 
 
 def _crc_ok(path: str) -> bool:
     """Cheap integrity probe for retention: header + CRC only (no
-    decompress / msgpack parse). Legacy headers have no CRC and pass."""
+    decompress / msgpack parse). Legacy headers have no CRC and pass.
+    Memoized by (path, mtime, size)."""
+    cached = _cache_get(path, full=False)
+    if cached is not None:
+        return cached
     try:
         with open(path, "rb") as f:
             blob = f.read()
@@ -156,10 +300,13 @@ def _crc_ok(path: str) -> bool:
         return False
     magic = blob[:4]
     if magic in (_MAGIC_RAW, _MAGIC_LZ):
-        return len(blob) >= _HEADER_LEN and zlib.crc32(
+        ok = len(blob) >= _HEADER_LEN and zlib.crc32(
             blob[_HEADER_LEN:]
         ) == int.from_bytes(blob[4:_HEADER_LEN], "little")
-    return magic in (_MAGIC_RAW_V1, _MAGIC_LZ_V1)
+    else:
+        ok = magic in (_MAGIC_RAW_V1, _MAGIC_LZ_V1)
+    _cache_put(path, crc_ok=ok, full_ok=None if ok else False)
+    return ok
 
 
 def _read_blob(path: str) -> bytes:
@@ -211,12 +358,26 @@ def _restore_state_dict(path: str):
 
 
 def verify_checkpoint(train_dir: str, step: int) -> bool:
-    """True iff model_step_N exists and passes header/CRC/msgpack checks."""
+    """True iff model_step_N exists and passes header/CRC/msgpack checks.
+    Memoized by (path, mtime, size): the rollback engine's repeated scans
+    stat instead of re-reading every blob."""
+    path = checkpoint_path(train_dir, step)
+    cached = _cache_get(path, full=True)
+    if cached is not None:
+        return cached
     try:
-        _restore_state_dict(checkpoint_path(train_dir, step))
-        return True
-    except (CorruptCheckpointError, OSError):
+        _restore_state_dict(path)
+        ok = True
+    except CorruptCheckpointError:
+        ok = False
+    except OSError:
+        # transient read failure (the NFS-blip class with_retries exists
+        # for): report invalid NOW but do not memoize — the file's stat
+        # won't change when the blip clears, so a cached False would
+        # permanently disqualify a good checkpoint (_crc_ok matches)
         return False
+    _cache_put(path, crc_ok=ok, full_ok=ok)
+    return ok
 
 
 def _read_state_dict(train_dir: str, step: Optional[int]):

@@ -10,29 +10,83 @@ to one step at a smaller world size. The reference has no analogue: one
 worker shipping a NaN gradient NaNs the PS momentum buffer permanently
 (sync_replicas_master_nn.py:281-296 averages whatever arrives).
 
-Two layers:
+The escalation ladder (one level of autonomy per rung; each rung only sees
+what the rung below it let through):
 
-  * In-graph screening (:func:`grad_ok`, used by trainer.make_train_step and
-    parallel.replicated.make_distributed_train_step): finiteness plus an
-    optional global-L2-norm ceiling, computed on the raw per-replica
-    gradient BEFORE it is encoded/aggregated. Single host: an anomalous
-    step is skipped outright (params, opt state, BN stats all held).
-    Distributed: the anomalous replica's payload is masked out of the
-    gather/psum and the surviving mean is re-scaled; only a step with zero
-    survivors is skipped.
+  1. In-graph screening (:func:`grad_ok`, used by trainer.make_train_step
+     and parallel.replicated.make_distributed_train_step): finiteness plus
+     an optional global-L2-norm ceiling, computed on the raw per-replica
+     gradient BEFORE it is encoded/aggregated. Single host: an anomalous
+     step is skipped outright (params, opt state, BN stats all held).
+     Distributed: the anomalous replica's payload is masked out of the
+     gather/psum and the surviving mean is re-scaled; only a step with zero
+     survivors is skipped.
 
-  * Host-side bounded retries (:func:`with_retries`): checkpoint IO, the
-    data pipeline, and ``jax.distributed.initialize`` are fallible host ops
-    whose transient failures (NFS blips, coordinator races) should cost a
-    backoff, not the job.
+  2. Windowed divergence detection (:func:`detector_update` /
+     :class:`DivergenceDoctor`): the per-step screen sees one gradient at a
+     time — a run diverging with perfectly FINITE gradients (an
+     over-aggressive svd rank or qsgd level, the variance blow-up the
+     paper's Fig. 5 warns about) sails straight through ``grad_ok``. The
+     detector watches the per-step loss series (the same ``(K,)`` block
+     superstep execution already returns), a guard skip-rate EMA, and a
+     gradient-norm trend counter; a robust z-score sustained past
+     ``patience`` steps raises the alarm. The math is a pure sequential
+     fold over the per-step series, so its decisions are IDENTICAL for any
+     superstep block partition of the same run.
+
+  3. Rollback-and-replay (:meth:`DivergenceDoctor.plan_rollback` + the
+     train loops): checkpoints earn a ``healthy`` tag only after the
+     detector window clears past them (training.checkpoint.mark_healthy);
+     on alarm the loop reloads the newest healthy checkpoint (params, opt
+     state, BN stats, AND the in-flight ``--overlap delayed`` payload),
+     replays the data stream to the rollback step (the PR-1 resume-replay
+     machinery), and applies the configured remedy (``--on-diverge``):
+     ``skip`` re-runs the window unchanged (transient-fault model),
+     ``rewarm`` ramps the effective LR from ``rewarm_floor`` back to 1
+     over the detector window (:class:`RemedyConfig`), ``densify``
+     temporarily de-escalates to dense (uncompressed) aggregation — valid
+     because every codec is an unbiased estimator of the same mean.
+
+  4. Supervised restarts (:func:`run_supervised`): a crash-looping host
+     burns a bounded budget with decorrelated-jitter backoff instead of
+     the job; exit codes distinguish clean-exit / rollback-requested
+     (:data:`ROLLBACK_EXIT_CODE`, raised when the in-process rollback
+     budget is exhausted) / crash, and every decision lands in the
+     machine-readable incident log (utils.tracing.IncidentLog).
+
+  5. Host-side bounded retries (:func:`with_retries`): checkpoint IO, the
+     data pipeline, and ``jax.distributed.initialize`` are fallible host
+     ops whose transient failures (NFS blips, coordinator races) should
+     cost a backoff, not the job. Backoff delays carry decorrelated
+     jitter so a fleet-wide blip does not synchronize a retry storm.
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import math
+import os
+import random
 import time
 from typing import Callable, Optional, Sequence
+
+# re-export: the supervisor protocol constant lives in utils.tracing so
+# utils.chaos (crashloop's reader side) can share it without an import cycle
+from atomo_tpu.utils.tracing import ATTEMPT_ENV  # noqa: F401
+
+SUPERVISED_ENV = "ATOMO_SUPERVISED"  # set by run_supervised on children
+# the trainer's "roll me back from a clean checkpoint" exit: distinct from
+# crashes (1), the watchdog's 13, and chaos's 43 — the supervisor prunes
+# the diverged timeline back to the last healthy checkpoint before the
+# restart, so --resume cannot land on diverged weights
+ROLLBACK_EXIT_CODE = 23
+# deterministic config errors discovered only in-run (they need the
+# resolved device count / built codec): rc=2 — argparse's own usage-error
+# code — tells the supervisor the child will fail identically every time,
+# so it gives up at once instead of burning the restart budget on
+# jax-booting re-execs of the same reject
+CONFIG_EXIT_CODE = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,6 +99,633 @@ class GuardConfig:
     """
 
     max_grad_norm: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Windowed divergence detection (escalation rung 2)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectorConfig:
+    """Divergence-detector knobs.
+
+    window: EMA window (steps) for the loss baseline and skip-rate, the
+        number of alarm-free steps a checkpoint must outlive to earn its
+        healthy tag, AND the rewarm/densify remedy span — one time
+        constant for the whole ladder keeps the knobs coherent.
+    zmax: robust z-score threshold on the loss vs its EMA baseline.
+    patience: consecutive above-threshold steps before the alarm fires (a
+        single bad batch is noise; a sustained excursion is divergence).
+    min_history: steps of warmup before z/skip/trend alarms arm.
+    skip_max: alarm when the guard's skip-rate EMA exceeds this (a run
+        whose screen constantly fires is wedged, not unlucky).
+    grad_ratio: alarm when the gradient norm exceeds this multiple of its
+        own EMA for ``patience`` consecutive steps (the finite-explosion
+        trend ``grad_ok`` cannot see).
+    """
+
+    window: int = 16
+    zmax: float = 6.0
+    patience: int = 3
+    min_history: int = 8
+    skip_max: float = 0.5
+    grad_ratio: float = 10.0
+
+    def __post_init__(self):
+        # window == 1 makes alpha = 1, the EMA variance identically zero,
+        # and the z-score alarm silently unfireable; window <= 0 drives
+        # the EMAs outside their domains
+        if self.window < 2:
+            raise ValueError(
+                f"detector window must be >= 2, got {self.window} (a "
+                "1-step window has zero variance — the z-score alarm "
+                "could never fire)"
+            )
+        if self.patience < 1:
+            raise ValueError(
+                f"detector patience must be >= 1, got {self.patience}"
+            )
+        if self.min_history < 0:
+            raise ValueError(
+                f"detector min_history must be >= 0, got {self.min_history}"
+            )
+        if self.zmax <= 0:
+            raise ValueError(f"detector zmax must be > 0, got {self.zmax}")
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectorState:
+    """The detector's carry — a handful of scalars folded once per step."""
+
+    n: int = 0
+    mean: float = 0.0  # loss EMA baseline
+    var: float = 0.0  # loss EMA variance (frozen while hot — see update)
+    hot: int = 0  # consecutive steps with z > zmax
+    skip_ema: float = 0.0  # guard skip-rate EMA
+    gn_ref: float = 0.0  # gradient-norm EMA baseline
+    gn_hot: int = 0  # consecutive steps with norm > grad_ratio * gn_ref
+
+
+def detector_update(
+    cfg: DetectorConfig,
+    st: DetectorState,
+    loss: float,
+    skipped: float = 0.0,
+    grad_norm: Optional[float] = None,
+) -> tuple[DetectorState, Optional[str]]:
+    """One detector step: fold ``(loss, skipped[, grad_norm])`` into the
+    carry, return ``(new_state, alarm_reason | None)``.
+
+    A pure sequential fold — feeding a loss series step by step, or in
+    ``(K,)`` superstep blocks of ANY partition, produces identical states
+    and identical alarm decisions (tested). While the z-score is hot the
+    loss baseline is FROZEN: absorbing diverging losses into the EMA would
+    raise the mean until z drops back under ``zmax`` and the alarm never
+    fires. Guard-skipped steps update only the skip-rate (their loss
+    describes an update that was rejected, and their gradient norm is the
+    rejected outlier's — folding either into a baseline would desensitize
+    its alarm); a non-finite loss on an UN-skipped step alarms immediately
+    — the guard should have caught it, so the trajectory itself is
+    already poisoned.
+    """
+    loss = float(loss)
+    alpha = 2.0 / (cfg.window + 1.0)
+    armed = st.n >= cfg.min_history
+    skip = 1.0 if skipped and float(skipped) > 0 else 0.0
+    skip_ema = st.skip_ema + alpha * (skip - st.skip_ema)
+    mean, var, hot = st.mean, st.var, st.hot
+    gn_ref, gn_hot = st.gn_ref, st.gn_hot
+    alarm = None
+
+    if not math.isfinite(loss):
+        if skip < 0.5:
+            alarm = "nonfinite_loss"
+    elif skip < 0.5:
+        if st.n == 0 or (mean == 0.0 and var == 0.0 and st.hot == 0):
+            mean, var, hot = loss, 0.0, 0
+        else:
+            diff = loss - mean
+            sd = math.sqrt(var) if var > 0 else 0.0
+            z = diff / sd if sd > 0 else 0.0
+            if armed and sd > 0 and z > cfg.zmax:
+                hot += 1  # baseline frozen while hot
+            else:
+                hot = 0
+                mean += alpha * diff
+                var = (1.0 - alpha) * (var + alpha * diff * diff)
+
+    if alarm is None and hot >= cfg.patience:
+        alarm = "loss_zscore"
+    if alarm is None and armed and skip_ema > cfg.skip_max:
+        alarm = "skip_rate"
+
+    if grad_norm is not None:
+        g = float(grad_norm)
+        # skip-gated like the loss path: a guard-REJECTED gradient's norm
+        # (e.g. a screened explosion) must not enter the gn_ref baseline,
+        # or one rejected outlier desensitizes the trend alarm for good
+        if math.isfinite(g) and g > 0 and skip < 0.5:
+            if armed and gn_ref > 0 and g > cfg.grad_ratio * gn_ref:
+                gn_hot += 1  # baseline frozen while trending
+            else:
+                gn_hot = 0
+                gn_ref = g if gn_ref <= 0 else gn_ref + alpha * (g - gn_ref)
+    if alarm is None and gn_hot >= cfg.patience:
+        alarm = "grad_norm_trend"
+
+    return (
+        DetectorState(
+            n=st.n + 1,
+            mean=mean,
+            var=var,
+            hot=hot,
+            skip_ema=skip_ema,
+            gn_ref=gn_ref,
+            gn_hot=gn_hot,
+        ),
+        alarm,
+    )
+
+
+def detector_scan(
+    cfg: DetectorConfig,
+    st: DetectorState,
+    losses,
+    skipped=None,
+    grad_norms=None,
+    first_step: int = 1,
+) -> tuple[DetectorState, Optional[int], Optional[str]]:
+    """Fold a per-step series (a superstep block's ``(K,)`` metrics, or a
+    single step's scalars as length-1 sequences) through the detector.
+    Stops at the FIRST alarm — the caller rolls back from there, so later
+    entries of the block describe a timeline about to be discarded.
+    Returns ``(state, alarm_step | None, reason | None)``."""
+    losses = [float(x) for x in _as_seq(losses)]
+    skips = (
+        [0.0] * len(losses) if skipped is None
+        else [float(x) for x in _as_seq(skipped)]
+    )
+    gns = (
+        [None] * len(losses) if grad_norms is None
+        else [float(x) for x in _as_seq(grad_norms)]
+    )
+    for i, (loss, sk, gn) in enumerate(zip(losses, skips, gns)):
+        st, alarm = detector_update(cfg, st, loss, sk, gn)
+        if alarm is not None:
+            return st, first_step + i, alarm
+    return st, None, None
+
+
+def _as_seq(x):
+    import numpy as np
+
+    return np.asarray(x).reshape(-1)
+
+
+class DivergenceError(RuntimeError):
+    """The in-process rollback budget is exhausted: the run keeps
+    diverging after ``max_rollbacks`` rollback+remedy attempts. Callers
+    (the CLI) translate this into :data:`ROLLBACK_EXIT_CODE` so a
+    supervisor can prune to the last healthy checkpoint and restart —
+    or give up against ITS budget."""
+
+    def __init__(self, step: int, reason: str, rollbacks: int):
+        super().__init__(
+            f"divergence at step {step} ({reason}) after {rollbacks} "
+            "rollback(s); in-process budget exhausted"
+        )
+        self.step = step
+        self.reason = reason
+        self.rollbacks = rollbacks
+
+
+@dataclasses.dataclass(frozen=True)
+class RemedyConfig:
+    """The ``rewarm`` remedy, baked into the rebuilt step program: the
+    effective LR ramps from ``floor`` back to 1.0 over ``window`` steps
+    after ``start_step`` (implemented as an in-graph gradient pre-scale —
+    scaling an unbiased gradient estimate keeps it unbiased, and the ramp
+    is a function of the carried step counter, so superstep block
+    partitions see identical arithmetic)."""
+
+    start_step: int
+    window: int
+    floor: float = 0.1
+
+
+def remedy_scale(remedy: RemedyConfig, step):
+    """Traced ramp factor in [floor, 1] for the step counter ``step``."""
+    import jax.numpy as jnp
+
+    t = jnp.clip(
+        (jnp.asarray(step, jnp.float32) - jnp.float32(remedy.start_step))
+        / jnp.float32(max(remedy.window, 1)),
+        0.0,
+        1.0,
+    )
+    floor = jnp.float32(remedy.floor)
+    return floor + (jnp.float32(1.0) - floor) * t
+
+
+def apply_remedy(remedy: RemedyConfig, step, grads):
+    """Pre-scale the aggregated gradient tree by the rewarm ramp — ONE
+    definition shared by the single-host, blocking-distributed, and
+    delayed-overlap update paths, so which step counter drives the ramp is
+    decided exactly once per call site and the arithmetic cannot drift."""
+    import jax
+
+    scale = remedy_scale(remedy, step)
+    return jax.tree_util.tree_map(
+        lambda g: g * scale.astype(g.dtype), grads
+    )
+
+
+def global_sq_norm(grads):
+    """Traced f32 sum of squares over every leaf — the raw global-L2
+    signal (pre-screen, pre-codec) the divergence detector's grad-norm
+    trend counter folds. ONE definition for the single-host and
+    distributed ``track_grad_norm`` metrics so the two series cannot
+    disagree about the same gradient. (:func:`grad_ok` keeps its own
+    interleaved finiteness+norm leaf pass — it predates this helper and
+    its traced op ORDER is pinned by the frozen guarded-program
+    contracts; the arithmetic is the same.)"""
+    import jax
+    import jax.numpy as jnp
+
+    sq = jnp.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(grads):
+        lf = leaf.astype(jnp.float32)
+        sq += jnp.sum(lf * lf)
+    return sq
+
+
+@dataclasses.dataclass(frozen=True)
+class DivergeConfig:
+    """``--on-diverge`` settings: which remedy, the detector, and the
+    in-process rollback budget."""
+
+    remedy: str = "skip"  # skip | rewarm | densify
+    detector: DetectorConfig = dataclasses.field(
+        default_factory=DetectorConfig
+    )
+    max_rollbacks: int = 2
+    rewarm_floor: float = 0.1
+
+    def __post_init__(self):
+        if self.remedy not in ("skip", "rewarm", "densify"):
+            raise ValueError(
+                f"unknown --on-diverge remedy {self.remedy!r}; expected "
+                "skip | rewarm | densify"
+            )
+
+
+def diverge_conflict(
+    remedy,
+    *,
+    train_dir,
+    codec=None,
+    aggregate=None,
+    overlap=None,
+    zero1=False,
+    phase_metrics=False,
+    num_aggregate=None,
+    keep_ckpts=None,
+    save_freq=None,
+    window=None,
+):
+    """The ``--on-diverge`` compatibility matrix, stated once.
+
+    Returns the human-readable reason the combination cannot work, or
+    None when it can. Every surface that arms the doctor (the CLI and
+    both train loops) asks here and raises its own error type with the
+    returned message; a surface passes only the features it actually
+    has — omitted ones are treated as off.
+    """
+    if not train_dir:
+        return (
+            "diverge (--on-diverge) needs a train_dir: rollback "
+            "restores from checkpoints"
+        )
+    if save_freq is not None and not save_freq:
+        # save_freq None = the caller has no cadence concept (unit tests);
+        # 0 = checkpointing explicitly disabled — no save can ever earn a
+        # healthy tag, so every rollback would replay from step 0
+        return (
+            "--on-diverge needs a checkpoint cadence (--save-freq or "
+            "--eval-freq > 0): with saves disabled no checkpoint can earn "
+            "a healthy tag and every rollback would restart from scratch"
+        )
+    if keep_ckpts and save_freq and window and keep_ckpts * save_freq < window:
+        # a checkpoint earns the healthy tag only once the detector window
+        # clears past it (~window steps after the save), but keep-last-K
+        # retention deletes it keep_ckpts*save_freq steps after the save:
+        # with keep*freq < window NO checkpoint ever survives to be tagged,
+        # so the first alarm would roll back to step 0 and prune everything
+        return (
+            f"--on-diverge with --keep-ckpts {keep_ckpts} and --save-freq "
+            f"{save_freq} retains checkpoints for only "
+            f"{keep_ckpts * save_freq} steps — shorter than the "
+            f"--diverge-window of {window}, so none would live long enough "
+            "to earn the healthy tag a rollback needs; raise --keep-ckpts "
+            "(or drop it to keep all checkpoints)"
+        )
+    if zero1:
+        return (
+            "--on-diverge is not supported with --zero1 (the sharded "
+            "optimizer template cannot be rebuilt mid-run); drop one"
+        )
+    if phase_metrics:
+        return (
+            "--on-diverge needs the fused step's metric series; "
+            "--phase-metrics has no doctor wiring — drop one"
+        )
+    if remedy == "densify":
+        if codec is None:
+            return (
+                "--on-diverge densify needs a compressing --code — "
+                "dense training has nothing denser to de-escalate to"
+            )
+        if overlap == "delayed":
+            return (
+                "--on-diverge densify cannot compose with --overlap "
+                "delayed (the dense fallback has no delayed form); "
+                "use skip or rewarm"
+            )
+        if aggregate == "hierarchical":
+            return (
+                "--on-diverge densify cannot compose with --aggregate "
+                "hierarchical (the dense fallback aggregates with a flat "
+                "psum; hierarchical needs a codec); use skip or rewarm"
+            )
+        if num_aggregate:
+            return (
+                "--on-diverge densify cannot compose with "
+                "--num-aggregate (a dense psum cannot subset "
+                "replicas); use skip or rewarm"
+            )
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class RollbackPlan:
+    """What the loop must do about an alarm: reload ``target``, replay the
+    data stream to it, and rebuild the step program at ``generation``
+    (chaos disarmed) with the remedy applied."""
+
+    target: int
+    remedy: str
+    window: int
+    generation: int
+    reason: str
+    alarm_step: int
+
+
+class DivergenceDoctor:
+    """Host-side controller tying detection to recovery: folds the
+    per-step metric series through the detector, grants healthy tags to
+    checkpoints the window has cleared, and turns alarms into
+    :class:`RollbackPlan`s against the in-process budget.
+
+    The doctor is loop-agnostic — the four train loops (single-host and
+    distributed, per-step and superstep) share one instance's policy and
+    incident log; only the state reload/stream rebuild is loop-specific.
+    """
+
+    def __init__(
+        self,
+        cfg: DivergeConfig,
+        train_dir: Optional[str],
+        incidents=None,
+        log_fn=print,
+    ):
+        self.cfg = cfg
+        self.train_dir = train_dir
+        self.incidents = incidents
+        self.log_fn = log_fn
+        self.state = DetectorState()
+        self.pending: list[int] = []  # saved steps awaiting the healthy tag
+        self.rollbacks = 0
+        self.generation = 0
+
+    # -- observation ----------------------------------------------------
+
+    def note_save(self, step: int) -> None:
+        """A checkpoint landed at ``step``; it earns the healthy tag only
+        after the detector window clears past it without an alarm."""
+        if step not in self.pending:
+            self.pending.append(step)
+
+    def observe_block(
+        self, first_step: int, losses, skipped=None, grad_norms=None
+    ) -> tuple[Optional[int], Optional[str]]:
+        """Fold the per-step series for steps ``first_step..`` (a superstep
+        block or a single step) into the detector; confirm pending healthy
+        tags for checkpoints the window has cleared. Returns
+        ``(alarm_step, reason)`` or ``(None, None)``."""
+        losses = _as_seq(losses)
+        self._confirm_through(first_step - 1)
+        self.state, alarm_step, reason = detector_scan(
+            self.cfg.detector, self.state, losses, skipped, grad_norms,
+            first_step=first_step,
+        )
+        if reason is None:
+            self._confirm_through(first_step + len(losses) - 1)
+        else:
+            # the steps BEFORE the alarm were observed alarm-free, and the
+            # K=1 trajectory confirms them before its alarm call's scan —
+            # confirm through alarm_step-1 so a save whose window cleared
+            # pre-alarm stays a rollback target under ANY block partition
+            self._confirm_through(alarm_step - 1)
+        return alarm_step, reason
+
+    def _confirm_through(self, step: int) -> None:
+        """Grant healthy tags to pending saves whose window [save,
+        save+window] finished strictly before or at ``step`` alarm-free.
+        A pending save whose file retention already pruned is dropped
+        untagged — marking it would leave an orphaned sidecar that a
+        FUTURE checkpoint reusing the step number (a post-rollback
+        timeline) would inherit without earning."""
+        if not self.pending:
+            return
+        from atomo_tpu.training.checkpoint import (
+            checkpoint_path,
+            mark_healthy,
+        )
+
+        w = self.cfg.detector.window
+        still = []
+        for s in sorted(self.pending):
+            if s + w <= step:
+                if self.train_dir and os.path.exists(
+                    checkpoint_path(self.train_dir, s)
+                ):
+                    mark_healthy(self.train_dir, s)
+            else:
+                still.append(s)
+        self.pending = still
+
+    # -- recovery -------------------------------------------------------
+
+    def plan_rollback(self, alarm_step: int, reason: str) -> RollbackPlan:
+        """Turn an alarm into a rollback plan (or raise
+        :class:`DivergenceError` once the budget is spent). Prunes the
+        diverged timeline above the target so no resume path can land on
+        it, resets the detector, and bumps the chaos generation."""
+        from atomo_tpu.training.checkpoint import (
+            latest_healthy_step,
+            prune_after,
+        )
+
+        if self.rollbacks >= self.cfg.max_rollbacks:
+            pruned: list[int] = []
+            if self.train_dir:
+                # make the same cut a supervisor would on rc=23: without
+                # it an unsupervised run's later --resume lands on the
+                # diverged tail written during this final excursion
+                pruned = prune_after(
+                    self.train_dir, latest_healthy_step(self.train_dir) or 0
+                )
+            if self.incidents is not None:
+                self.incidents.append(
+                    "divergence",
+                    action="give_up",
+                    step=alarm_step,
+                    reason=reason,
+                    rollbacks=self.rollbacks,
+                    pruned=pruned,
+                )
+            raise DivergenceError(alarm_step, reason, self.rollbacks)
+        self.rollbacks += 1
+        target = None
+        removed: list[int] = []
+        if self.train_dir:
+            target = latest_healthy_step(self.train_dir)
+            removed = prune_after(self.train_dir, target or 0)
+        target = int(target) if target is not None else 0
+        self.generation += 1
+        self.state = DetectorState()
+        self.pending = [s for s in self.pending if s <= target]
+        plan = RollbackPlan(
+            target=target,
+            remedy=self.cfg.remedy,
+            window=self.cfg.detector.window,
+            generation=self.generation,
+            reason=reason,
+            alarm_step=alarm_step,
+        )
+        self.log_fn(
+            f"Doctor: divergence at step {alarm_step} ({reason}); rolling "
+            f"back to step {target} with remedy {plan.remedy!r} "
+            f"(rollback {self.rollbacks}/{self.cfg.max_rollbacks}"
+            + (f", pruned steps {removed}" if removed else "")
+            + ")"
+        )
+        if self.incidents is not None:
+            self.incidents.append(
+                "divergence",
+                action=f"rollback+{plan.remedy}",
+                step=alarm_step,
+                target=target,
+                reason=reason,
+                pruned=removed,
+                rollbacks=self.rollbacks,
+            )
+        return plan
+
+
+class RecoveryRig:
+    """The loop-facing half of the rollback engine: binds a
+    :class:`DivergenceDoctor` to one train loop's reload / replay /
+    step-rebuild closures, so the four loops (single-host and distributed,
+    per-step and superstep) share the recovery sequence verbatim.
+
+    ``reload_state(target)`` must return the loop's state restored from
+    the step-``target`` checkpoint (target 0 = fresh init — no healthy
+    checkpoint survived); ``restream(target)`` must return a data stream
+    replayed past ``target`` batches from the run-start RNG snapshot;
+    ``build_step(generation, remedy_cfg, densify)`` must return the loop's
+    step callable with chaos at ``generation``, the optional rewarm ramp,
+    and (densify) the codec swapped out for dense aggregation.
+    """
+
+    def __init__(self, doctor, diverge, reload_state, restream, build_step):
+        self.doctor = doctor
+        self.diverge = diverge
+        self._reload = reload_state
+        self._restream = restream
+        self._build = build_step
+        self.densify_until: Optional[int] = None
+
+    def observe(self, first_step, metrics):
+        """Feed a fetched metrics dict (per-step scalars or (K,) block
+        series) to the detector; returns (alarm_step, reason).
+
+        ``sample_skipped`` (delayed-overlap programs) wins over
+        ``skipped``: in that mode "skipped" describes the CONSUMED
+        step-(t-1) payload while the loss describes this step's forward,
+        so gating on it would be off by one — folding a forward whose
+        every chip the guard rejected (loss collapsed to 0.0) as a clean
+        sample."""
+        return self.doctor.observe_block(
+            first_step,
+            metrics["loss"],
+            metrics.get("sample_skipped", metrics.get("skipped")),
+            metrics.get("grad_norm"),
+        )
+
+    def note_save(self, step):
+        self.doctor.note_save(step)
+
+    def rollback(self, alarm_step, reason):
+        """Execute the doctor's plan; returns (plan, state, stream,
+        step_fn) for the loop to adopt. Raises DivergenceError when the
+        in-process budget is spent."""
+        plan = self.doctor.plan_rollback(alarm_step, reason)
+        remedy_cfg = (
+            RemedyConfig(
+                start_step=plan.target,
+                window=plan.window,
+                floor=self.diverge.rewarm_floor,
+            )
+            if plan.remedy == "rewarm"
+            else None
+        )
+        densify = plan.remedy == "densify"
+        self.densify_until = (
+            plan.target + plan.window if densify else None
+        )
+        state = self._reload(plan.target)
+        stream = self._restream(plan.target)
+        step_fn = self._build(plan.generation, remedy_cfg, densify)
+        return plan, state, stream, step_fn
+
+    def recover(self, alarm_step, reason, chaos):
+        """The whole recovery sequence the four loops share: execute the
+        rollback, advance the loop's OWN chaos injector to the plan's
+        generation (host-side faults — kill/slow/ckpt corruption — must
+        disarm with the step program, or they re-fire on the replayed
+        range), and fetch the restored step counter the loop's cadence
+        counters clamp to. Feed/profiler teardown stays at the call site —
+        it is the only part that differs per loop. Returns
+        ``(state, stream, step_fn, chaos, step)``; raises DivergenceError
+        when the in-process budget is spent."""
+        import jax
+
+        plan, state, stream, step_fn = self.rollback(alarm_step, reason)
+        if chaos is not None:
+            chaos = chaos.with_generation(plan.generation)
+        step = int(jax.device_get(state.step))
+        return state, stream, step_fn, chaos, step
+
+    def maybe_end_densify(self, step):
+        """After the densify window closes, rebuild the real-codec step
+        (snapped to the first step/block boundary past the window);
+        returns the new step_fn or None."""
+        if self.densify_until is not None and step >= self.densify_until:
+            self.densify_until = None
+            return self._build(self.doctor.generation, None, False)
+        return None
 
 
 def grad_ok(grads, max_grad_norm: float = 0.0):
@@ -122,10 +803,11 @@ def heartbeat_watchdog(health_timeout: float, on_failure=None):
             watchdog.stop()
 
 
-def retrying_saver(log_fn=print):
+def retrying_saver(log_fn=print, incidents=None):
     """save_checkpoint wrapped in the standard bounded backoff — the one
     saver both train loops (single-host and distributed) use, so retry
-    policy and logging cannot drift between them."""
+    policy and logging cannot drift between them. With ``incidents`` (an
+    IncidentLog), each retried save lands in the post-mortem record."""
     from atomo_tpu.training.checkpoint import save_checkpoint
 
     return with_retries(
@@ -133,6 +815,8 @@ def retrying_saver(log_fn=print):
         on_retry=lambda i, exc: log_fn(
             f"Checkpoint save failed (attempt {i}): {exc}; retrying"
         ),
+        incidents=incidents,
+        incident_cause="checkpoint_save",
     )
 
 
@@ -163,6 +847,19 @@ def rescale_by_survivors(tree, n_contrib, kept):
     )
 
 
+def decorrelated_delay(
+    prev: float, base: float, cap: float, rng: random.Random
+) -> tuple[float, float]:
+    """One decorrelated-jitter backoff step: ``delay = min(cap,
+    uniform(base, 3*prev))``. Returns ``(delay, next_prev)`` — the floor
+    at ``base`` keeps the envelope from collapsing. The ONE backoff
+    formula for both the retry path (:func:`with_retries`) and the
+    supervisor (:func:`run_supervised`); hosts tripping over the same
+    fleet-wide blip must not re-synchronize into a retry storm."""
+    delay = min(cap, rng.uniform(base, prev * 3))
+    return delay, max(delay, base)
+
+
 def with_retries(
     fn: Callable,
     *,
@@ -172,20 +869,33 @@ def with_retries(
     exceptions: Sequence[type] = (OSError,),
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
     sleep: Callable[[float], None] = time.sleep,
+    jitter: bool = True,
+    rng: Optional[random.Random] = None,
+    incidents=None,
+    incident_cause: str = "retry",
 ) -> Callable:
-    """Wrap a fallible host-side op with bounded exponential backoff.
+    """Wrap a fallible host-side op with bounded, jittered backoff.
 
     Returns a callable with ``fn``'s signature that retries on the listed
-    exception types, sleeping base_delay * 2**i (capped at max_delay)
-    between attempts, and re-raises the last failure once ``attempts`` are
+    exception types and re-raises the last failure once ``attempts`` are
     exhausted. Anything not in ``exceptions`` propagates immediately —
     retrying a programming error just hides it.
+
+    Backoff is DECORRELATED JITTER (delay_i = uniform(base, 3 * delay_{i-1})
+    capped at ``max_delay``): the old deterministic base * 2**i schedule
+    made every host that tripped over the same NFS blip retry at the same
+    instant, turning one transient into a synchronized retry storm.
+    ``jitter=False`` restores the deterministic schedule (tests); ``rng``
+    injects a seeded random.Random. With ``incidents`` (an IncidentLog),
+    each retry's cause is recorded under ``incident_cause``.
     """
     if attempts < 1:
         raise ValueError(f"attempts must be >= 1, got {attempts}")
     exc_types = tuple(exceptions)
+    rng = rng if rng is not None else random.Random()
 
     def wrapped(*args, **kwargs):
+        prev = base_delay
         for i in range(attempts):
             try:
                 return fn(*args, **kwargs)
@@ -194,6 +904,152 @@ def with_retries(
                     raise
                 if on_retry is not None:
                     on_retry(i + 1, exc)
-                sleep(min(base_delay * (2 ** i), max_delay))
+                if incidents is not None:
+                    incidents.append(
+                        incident_cause,
+                        action="retry",
+                        attempt=i + 1,
+                        op=getattr(fn, "__name__", str(fn)),
+                        error=f"{type(exc).__name__}: {exc}",
+                    )
+                if jitter:
+                    delay, prev = decorrelated_delay(
+                        prev, base_delay, max_delay, rng
+                    )
+                else:
+                    delay = min(base_delay * (2 ** i), max_delay)
+                sleep(delay)
 
     return wrapped
+
+
+# ---------------------------------------------------------------------------
+# Run-level supervision (escalation rung 4)
+# ---------------------------------------------------------------------------
+
+
+def run_supervised(
+    cmd: Sequence[str],
+    *,
+    max_restarts: int = 2,
+    backoff_base: float = 1.0,
+    backoff_max: float = 30.0,
+    train_dir: Optional[str] = None,
+    resume_flag: Optional[str] = "--resume",
+    log_fn=print,
+    rng: Optional[random.Random] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    env: Optional[dict] = None,
+) -> int:
+    """Supervise a train command with a crash-loop budget.
+
+    Runs ``cmd`` as a child process (with :data:`SUPERVISED_ENV` set so the
+    child never re-supervises itself, and :data:`ATTEMPT_ENV` carrying the
+    0-based run attempt for attempt-keyed chaos). Exit codes are triaged:
+
+      0                    clean exit — done.
+      ROLLBACK_EXIT_CODE   rollback requested (the child's in-process
+                           rollback budget is spent): the supervisor cuts
+                           the checkpoint timeline back to the newest
+                           HEALTHY step (prune_after) so the restart's
+                           ``--resume`` cannot land on diverged weights,
+                           then restarts against the budget.
+      CONFIG_EXIT_CODE     deterministic config error (argparse usage
+                           errors and the CLI's in-run rejects that need
+                           the resolved mesh/codec): give up immediately —
+                           every restart would die identically.
+      anything else        crash — restart against the budget.
+
+    Restarts append ``resume_flag`` to the command (once), wait a
+    decorrelated-jittered backoff (base ``backoff_base`` s, capped at
+    ``backoff_max`` s), and burn one unit of the ``max_restarts`` budget;
+    exhaustion returns the child's last exit code. Every decision is one
+    record in ``train_dir/incidents.jsonl``.
+    """
+    import subprocess
+
+    from atomo_tpu.utils.tracing import IncidentLog
+
+    incidents = (
+        IncidentLog.for_train_dir(train_dir) if train_dir else None
+    )
+    rng = rng if rng is not None else random.Random()
+    base_env = dict(os.environ if env is None else env)
+    attempt = 0
+    prev = max(backoff_base, 1e-3)
+    while True:
+        run_cmd = list(cmd)
+        if attempt > 0 and resume_flag and resume_flag not in run_cmd:
+            run_cmd.append(resume_flag)
+        child_env = {
+            **base_env, SUPERVISED_ENV: "1", ATTEMPT_ENV: str(attempt),
+        }
+        t0 = time.time()
+        rc = subprocess.call(run_cmd, env=child_env)
+        wall = round(time.time() - t0, 3)
+        if rc == 0:
+            if incidents is not None:
+                incidents.append(
+                    "clean_exit", action="done", attempt=attempt, run_s=wall
+                )
+            log_fn(f"Supervisor: clean exit (attempt {attempt})")
+            return 0
+        if rc == CONFIG_EXIT_CODE:
+            # deterministic: every restart would die on the same reject
+            if incidents is not None:
+                incidents.append(
+                    "config_error",
+                    action="give_up",
+                    attempt=attempt,
+                    rc=rc,
+                    run_s=wall,
+                )
+            log_fn(
+                f"Supervisor: attempt {attempt} exited rc={rc} (config "
+                "error — deterministic); not restarting"
+            )
+            return rc
+        cause = "rollback_requested" if rc == ROLLBACK_EXIT_CODE else "crash"
+        target = None
+        if rc == ROLLBACK_EXIT_CODE and train_dir:
+            from atomo_tpu.training.checkpoint import (
+                latest_healthy_step,
+                prune_after,
+            )
+
+            target = latest_healthy_step(train_dir) or 0
+            prune_after(train_dir, target)
+        if attempt >= max_restarts:
+            if incidents is not None:
+                incidents.append(
+                    "budget_exhausted",
+                    action="give_up",
+                    attempt=attempt,
+                    rc=rc,
+                    run_s=wall,
+                    max_restarts=max_restarts,
+                )
+            log_fn(
+                f"Supervisor: budget exhausted after attempt {attempt} "
+                f"(rc={rc}, {cause}); giving up"
+            )
+            return rc
+        delay, prev = decorrelated_delay(prev, backoff_base, backoff_max, rng)
+        delay = round(delay, 3)
+        if incidents is not None:
+            incidents.append(
+                cause,
+                action="restart",
+                attempt=attempt,
+                rc=rc,
+                target=target,
+                backoff_s=delay,
+                run_s=wall,
+            )
+        log_fn(
+            f"Supervisor: attempt {attempt} exited rc={rc} ({cause}); "
+            f"restarting in {delay:.2f}s "
+            f"({max_restarts - attempt} restart(s) left)"
+        )
+        sleep(delay)
+        attempt += 1

@@ -17,6 +17,7 @@ metrics.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Any, Optional
 
@@ -111,7 +112,8 @@ def snapshot_state(state) -> "TrainState":
 
 def make_train_step(model, optimizer, codec=None, augment: bool = False,
                     compute_dtype=None, guard=None, chaos=None,
-                    superstep: int = 1):
+                    superstep: int = 1, remedy=None,
+                    track_grad_norm: bool = False):
     """Build the jitted single-host train step.
 
     codec != None applies encode->decode to the gradient pytree in-graph
@@ -134,6 +136,17 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
 
     chaos (utils.chaos.ChaosInjector) bakes the configured gradient faults
     into the compiled step — test/validation hook, zero-cost when None.
+
+    remedy (resilience.RemedyConfig) applies the divergence doctor's
+    ``rewarm`` ramp: the post-codec gradient is pre-scaled by
+    ``remedy_scale(remedy, state.step)`` (an in-graph function of the
+    carried step counter, so superstep partitions agree bitwise). None
+    (default) adds no ops — the program is unchanged.
+
+    track_grad_norm adds ``metrics["grad_norm"]`` (global L2 of the raw
+    post-chaos gradient) for the divergence detector's trend counter; off
+    (default) leaves the metrics pytree — and therefore the compiled
+    program — exactly as before.
 
     superstep > 1 returns the FUSED variant: one jitted program that runs
     ``superstep`` full optimizer steps under a single ``lax.scan``
@@ -192,6 +205,13 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
 
         if chaos is not None:
             grads = chaos.inject_grads(grads, state.step + 1)
+        gnorm = None
+        if track_grad_norm:
+            from atomo_tpu.training.resilience import global_sq_norm
+
+            # raw (pre-screen, pre-codec) global L2: the detector's trend
+            # signal must see what the screen saw, not what survived it
+            gnorm = jnp.sqrt(global_sq_norm(grads))
         ok = None
         if guard is not None:
             ok = grad_ok(grads, guard.max_grad_norm)
@@ -205,6 +225,10 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
             grads = decode_tree(codec, payloads, grads)
             msg_bytes = stats.payload_bytes
 
+        if remedy is not None:
+            from atomo_tpu.training.resilience import apply_remedy
+
+            grads = apply_remedy(remedy, state.step, grads)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         skipped = jnp.float32(0.0)
@@ -221,6 +245,8 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
             "msg_bytes": jnp.asarray(msg_bytes, jnp.int32),
             "skipped": skipped,
         }
+        if gnorm is not None:
+            metrics["grad_norm"] = gnorm
         return (
             TrainState(
                 step=state.step + 1,
@@ -298,6 +324,7 @@ def train_loop(
     on_health_failure=None,
     keep_ckpts: int = 0,
     superstep: int = 1,
+    diverge=None,
 ) -> TrainState:
     """The reference train_and_validate loop (nn_ops.py:123-169), jitted,
     plus working checkpoint/resume (gap §5.4) and the fault-tolerance
@@ -325,15 +352,34 @@ def train_loop(
     carried step counter; the data stream is index-determined), including
     across kill→restart→resume at a step that is not a multiple of K —
     the resumed run simply starts a fresh block at checkpoint_step+1.
-    K=1 preserves the original per-step loop exactly."""
+    K=1 preserves the original per-step loop exactly.
+
+    ``diverge`` (resilience.DivergeConfig) arms the divergence doctor:
+    the per-step loss/skip/grad-norm series feeds a windowed detector
+    (one scalar fetch per step in the per-step loop — the price of
+    surveillance; the superstep loop's existing one-fetch-per-block
+    amortizes it away), checkpoints earn a ``healthy`` tag only after the
+    detector window clears past them, and an alarm rolls the run back to
+    the newest healthy checkpoint, replays the data stream, and applies
+    the configured remedy — with the chaos generation bumped so
+    step-targeted faults do not re-fire on the replay. Budget exhaustion
+    raises resilience.DivergenceError (the CLI maps it to
+    ROLLBACK_EXIT_CODE for the run-level supervisor)."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import (
+        SUPERVISED_ENV,
+        DivergenceDoctor,
+        RecoveryRig,
+        diverge_conflict,
         heartbeat_watchdog,
         resolve_chaos,
         retrying_saver,
     )
+    from atomo_tpu.utils.tracing import IncidentLog
 
     chaos = resolve_chaos(chaos)
+    if chaos is not None:
+        chaos.maybe_die_crashloop()  # crashloop@M: attempt-keyed death
     sample_images, _ = next(iter(train_iter.epoch()))
     state = create_state(
         model, optimizer, jax.random.PRNGKey(seed), jnp.asarray(sample_images)
@@ -348,17 +394,69 @@ def train_loop(
             # files exist but none passed integrity checks — a fresh start
             # beats dying when the operator asked for elastic restarts
             log_fn(f"Resume requested but {exc}; starting fresh")
-    step_fn = make_train_step(
-        model, optimizer, codec=codec, augment=augment,
-        compute_dtype=compute_dtype, guard=guard, chaos=chaos,
-        superstep=superstep,
-    )
-    save_fn = retrying_saver(log_fn)
+
+    rig = None
+    incidents = None
+    if train_dir and (
+        diverge is not None or os.environ.get(SUPERVISED_ENV) == "1"
+    ):
+        incidents = IncidentLog.for_train_dir(train_dir)
+    if diverge is not None:
+        reason = diverge_conflict(
+            diverge.remedy,
+            train_dir=train_dir,
+            codec=codec,
+            keep_ckpts=keep_ckpts,
+            save_freq=save_freq,
+            window=diverge.detector.window,
+        )
+        if reason:
+            raise ValueError(reason)
+
+    def build_step(generation=0, remedy_cfg=None, densify=False):
+        chaos_now = (
+            chaos.with_generation(generation)
+            if chaos is not None and generation
+            else chaos
+        )
+        return make_train_step(
+            model, optimizer,
+            codec=None if densify else codec,
+            augment=augment, compute_dtype=compute_dtype, guard=guard,
+            chaos=chaos_now, superstep=superstep, remedy=remedy_cfg,
+            track_grad_norm=diverge is not None,
+        )
+
+    step_fn = build_step()
+    save_fn = retrying_saver(log_fn, incidents)
     key = jax.random.PRNGKey(seed + 1)
     timer = Timer()
     # replay: skip the batches the interrupted run consumed so the resumed
-    # data order matches the uninterrupted run's (docstring); index-only
+    # data order matches the uninterrupted run's (docstring); index-only.
+    # The RNG snapshot is the rollback engine's replay anchor
+    # (pipeline.BatchIterator.restream) and MUST be taken before forever()
+    # advances the shuffle RNG; it is a doctor-only iterator requirement,
+    # so a disarmed loop keeps the old iterator contract.
+    rng_snapshot = train_iter.snapshot_rng() if diverge is not None else None
     stream = train_iter.forever(skip=start_step)
+    if diverge is not None:
+
+        def _reload(target):
+            tpl = create_state(
+                model, optimizer, jax.random.PRNGKey(seed),
+                jnp.asarray(sample_images),
+            )
+            if target <= 0:
+                return tpl  # no healthy checkpoint survived: from scratch
+            return load_checkpoint(train_dir, tpl, step=target)
+
+        rig = RecoveryRig(
+            DivergenceDoctor(diverge, train_dir, incidents, log_fn),
+            diverge,
+            _reload,
+            lambda target: train_iter.restream(rng_snapshot, skip=target),
+            build_step,
+        )
     n_train = len(train_iter.dataset)
     last_saved = start_step
     if superstep > 1:
@@ -373,10 +471,12 @@ def train_loop(
                 timer, n_train, start_step, max_steps, superstep, log_every,
                 log_fn, eval_freq, save_freq, train_dir, compress_ckpt,
                 save_fn, monitor, guard=guard, chaos=chaos,
-                keep_ckpts=keep_ckpts,
+                keep_ckpts=keep_ckpts, rig=rig,
             )
     with heartbeat_watchdog(health_timeout, on_health_failure) as monitor:
-        for step in range(start_step + 1, max_steps + 1):
+        step = start_step
+        while step < max_steps:
+            step += 1
             if chaos is not None:
                 chaos.maybe_die(step)
                 chaos.maybe_sleep(step)
@@ -385,6 +485,21 @@ def train_loop(
             if monitor is not None:
                 jax.block_until_ready(metrics["loss"])
                 monitor.beat(step)
+            if rig is not None:
+                # one scalar fetch per step: per-step surveillance is the
+                # price of per-step rollback granularity (the superstep
+                # loop amortizes it into the block's single fetch)
+                alarm_step, reason = rig.observe(step, metrics)
+                if reason is not None:
+                    # raises DivergenceError when the budget is spent
+                    state, stream, step_fn, chaos, step = rig.recover(
+                        alarm_step, reason, chaos
+                    )
+                    last_saved = min(last_saved, step)
+                    continue
+                new_fn = rig.maybe_end_densify(step)
+                if new_fn is not None:
+                    step_fn = new_fn
             # guard diagnostics share the log cadence: fetching the skip
             # flag every step would block host dispatch on every step's
             # result even when nothing is ever dropped
@@ -424,6 +539,8 @@ def train_loop(
                     keep=keep_ckpts,
                 )
                 last_saved = step
+                if rig is not None:
+                    rig.note_save(step)
                 if chaos is not None:
                     chaos.maybe_corrupt_checkpoint(path, step)
         # autosave the final state so a restart never replays the tail
@@ -434,6 +551,8 @@ def train_loop(
                 train_dir, state, max_steps, compress=compress_ckpt,
                 keep=keep_ckpts,
             )
+            if rig is not None:
+                rig.note_save(max_steps)
             if chaos is not None:  # ckpt faults target autosaves too
                 chaos.maybe_corrupt_checkpoint(path, max_steps)
     return state
@@ -483,20 +602,24 @@ def _superstep_steps(
     state, step_fn, model, stream, train_iter, test_iter, key, timer,
     n_train, start_step, max_steps, superstep, log_every, log_fn,
     eval_freq, save_freq, train_dir, compress_ckpt, save_fn, monitor,
-    guard=None, chaos=None, keep_ckpts=0,
+    guard=None, chaos=None, keep_ckpts=0, rig=None,
 ):
     """train_loop's fused block path: one dispatch per K steps, one metric
     fetch per block (the fetch is also the fence the watchdog beats on),
-    next block double-buffered onto the device behind the current one."""
+    next block double-buffered onto the device behind the current one.
+    ``rig`` (resilience.RecoveryRig) adds divergence rollback: the block's
+    per-step (K,) metric series feeds the detector at the block's one
+    fetch, and a rollback rebuilds the feed from the replayed stream —
+    the resumed run starts a fresh block at target+1, which the scan
+    family's partition invariance makes bit-identical to never having
+    diverged."""
     import numpy as np
 
     from atomo_tpu.data.pipeline import BlockStream, SuperstepFeed
 
-    feed = SuperstepFeed(
-        BlockStream(stream),
-        lambda im, lb: (jax.device_put(jnp.asarray(im)),
-                        jax.device_put(jnp.asarray(lb))),
-    )
+    put_fn = lambda im, lb: (jax.device_put(jnp.asarray(im)),  # noqa: E731
+                             jax.device_put(jnp.asarray(lb)))
+    feed = SuperstepFeed(BlockStream(stream), put_fn)
     s = start_step
     last_saved = start_step
     last_logged = start_step
@@ -519,6 +642,22 @@ def _superstep_steps(
         m = jax.device_get(mblk)  # the block's ONE host sync
         if monitor is not None:
             monitor.beat(s)
+        if rig is not None:
+            alarm_step, reason = rig.observe(b0 + 1, m)
+            if reason is not None:
+                state, stream, step_fn, chaos, s = rig.recover(
+                    alarm_step, reason, chaos
+                )
+                last_saved = min(last_saved, s)
+                last_logged = min(last_logged, s)
+                # drop the feed's staged lookahead block: it belongs to
+                # the discarded timeline
+                feed = SuperstepFeed(BlockStream(stream), put_fn)
+                feed.start(min(superstep, max_steps - s))
+                continue
+            new_fn = rig.maybe_end_densify(s)
+            if new_fn is not None:
+                step_fn = new_fn
         n_skipped = float(np.sum(m["skipped"])) if guard is not None else 0.0
         if guard is not None and _crossed(log_every, b0, s) and n_skipped > 0:
             log_fn(
@@ -544,6 +683,8 @@ def _superstep_steps(
                 train_dir, state, s, compress=compress_ckpt, keep=keep_ckpts
             )
             last_saved = s
+            if rig is not None:
+                rig.note_save(s)
             # ckpt faults snap like kill/sleep: a fault aimed anywhere in
             # this block corrupts the boundary file
             _chaos_corrupt_range(chaos, path, b0, s)
@@ -554,5 +695,7 @@ def _superstep_steps(
             train_dir, state, max_steps, compress=compress_ckpt,
             keep=keep_ckpts,
         )
+        if rig is not None:
+            rig.note_save(max_steps)
         _chaos_corrupt_range(chaos, path, last_saved, max_steps)
     return state

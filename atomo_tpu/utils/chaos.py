@@ -13,11 +13,30 @@ Fault kinds
   nan@S       gradient becomes non-finite (NaN) at step S   (in-graph)
   inf@S       gradient becomes non-finite (Inf) at step S   (in-graph)
   explode@S   gradient norm blows up (finite) at step S     (in-graph)
+  spike@S:W   gradients amplified by a FINITE factor for W steps starting
+              at S (default W=3, factor ``spike_scale``) — sustained,
+              norm-screen-passing divergence pressure: the per-step guard
+              sees nothing wrong, only the windowed divergence detector
+              can catch the trend                           (in-graph)
   slow@S:SEC  host sleeps SEC seconds before step S         (host)
   kill@S      process dies (os._exit) before step S runs    (host)
+  crashloop@M the process dies at loop start on the first M runs and
+              succeeds from run M+1 on (run index = the supervisor's
+              ATOMO_RUN_ATTEMPT env, 0 on an unsupervised run) — the
+              crash-loop-budget drill                       (host)
   truncate@S  the checkpoint written at step S is truncated (host, post-save)
   bitflip@S   one bit of the step-S checkpoint is flipped   (host, post-save)
   badmagic@S  the step-S checkpoint's magic is clobbered    (host, post-save)
+
+Generations: step-targeted faults (grad faults, spike, slow, kill, ckpt
+corruption) fire only at injector ``generation`` 0. The divergence
+doctor's in-process rollback replays the data stream through the faulted
+step range — a rolled-back run bumps the generation
+(:meth:`ChaosInjector.with_generation`) so the replay is clean, modelling
+a transient fault rather than a permanently poisoned step number.
+``spike`` always hits every replica (divergence is a global condition);
+``crashloop`` is attempt-keyed, not step-keyed, so generations don't
+apply to it.
 
 Specs are comma-separated (``"nan@3,kill@6"``) and come from the
 ``ATOMO_CHAOS`` env var or the ``--chaos`` CLI flag. The in-graph faults
@@ -43,6 +62,8 @@ import sys
 import time
 from typing import Optional
 
+from atomo_tpu.utils.tracing import ATTEMPT_ENV
+
 GRAD_FAULTS = {"nan": 1, "inf": 2, "explode": 3}
 CKPT_FAULTS = ("truncate", "bitflip", "badmagic")
 CHAOS_EXIT_CODE = 43  # distinct from crashes (1) and the watchdog's 13
@@ -64,6 +85,9 @@ class ChaosConfig:
     slow_steps: tuple[tuple[int, float], ...] = ()
     kill_steps: tuple[int, ...] = ()
     ckpt_faults: tuple[tuple[int, str], ...] = ()
+    spike_faults: tuple[tuple[int, int], ...] = ()  # (start_step, window)
+    spike_scale: float = 8.0  # finite: passes grad_ok's finiteness screen
+    crashloop: int = 0  # first M runs die at loop start; run M+1 succeeds
     explode_scale: float = 1e12
     target_replica: int = 0
     exit_code: int = CHAOS_EXIT_CODE
@@ -81,8 +105,25 @@ class ChaosConfig:
             )
 
     @classmethod
-    def from_spec(cls, spec: str, *, seed: int = 0) -> "ChaosConfig":
-        grad, slow, kill, ckpt = [], [], [], []
+    def from_spec(
+        cls,
+        spec: str,
+        *,
+        seed: Optional[int] = None,
+        spike_scale: Optional[float] = None,
+        environ=None,
+    ) -> "ChaosConfig":
+        """Parse a fault spec. ``seed`` and ``spike_scale`` default to the
+        ATOMO_CHAOS_SEED / ATOMO_CHAOS_SPIKE_SCALE env knobs, so a spec
+        armed via ``--chaos`` behaves identically to the same spec in the
+        ATOMO_CHAOS env var; explicit arguments override the env."""
+        env = os.environ if environ is None else environ
+        if seed is None:
+            seed = int(env.get("ATOMO_CHAOS_SEED", "0"))
+        if spike_scale is None:
+            spike_scale = float(env.get("ATOMO_CHAOS_SPIKE_SCALE", "8.0"))
+        grad, slow, kill, ckpt, spike = [], [], [], [], []
+        crashloop = 0
         for raw in spec.split(","):
             tok = raw.strip().lower()
             if not tok:
@@ -91,16 +132,25 @@ class ChaosConfig:
             if m is None:
                 raise ValueError(
                     f"bad chaos token {tok!r}; expected kind@step[*][:arg] "
-                    f"with kind in {sorted(GRAD_FAULTS) + ['slow', 'kill'] + list(CKPT_FAULTS)}"
+                    f"with kind in "
+                    f"{sorted(GRAD_FAULTS) + ['spike', 'slow', 'kill', 'crashloop'] + list(CKPT_FAULTS)}"
                 )
             kind, step = m.group("kind"), int(m.group("step"))
             arg = m.group("arg")
             if kind in GRAD_FAULTS:
                 grad.append((step, kind, bool(m.group("all"))))
+            elif kind == "spike":
+                window = int(float(arg)) if arg else 3
+                if window < 1:
+                    raise ValueError(f"spike window must be >= 1, got {window}")
+                spike.append((step, window))
             elif kind == "slow":
                 slow.append((step, float(arg) if arg else 0.25))
             elif kind == "kill":
                 kill.append(step)
+            elif kind == "crashloop":
+                # the @N slot carries the doomed-run count, not a step
+                crashloop = max(crashloop, step)
             elif kind in CKPT_FAULTS:
                 ckpt.append((step, kind))
             else:
@@ -110,6 +160,9 @@ class ChaosConfig:
             slow_steps=tuple(slow),
             kill_steps=tuple(kill),
             ckpt_faults=tuple(ckpt),
+            spike_faults=tuple(spike),
+            spike_scale=spike_scale,
+            crashloop=crashloop,
             seed=seed,
         )
 
@@ -121,21 +174,34 @@ class ChaosConfig:
         spec = env.get("ATOMO_CHAOS", "")
         if not spec.strip():
             return None
-        return cls.from_spec(spec, seed=int(env.get("ATOMO_CHAOS_SEED", "0")))
+        return cls.from_spec(spec, environ=env)
 
     def enabled(self) -> bool:
         return bool(
             self.grad_faults or self.slow_steps or self.kill_steps
-            or self.ckpt_faults
+            or self.ckpt_faults or self.spike_faults or self.crashloop
         )
 
 
 class ChaosInjector:
     """Applies a :class:`ChaosConfig`. In-graph methods take traced step
-    scalars; host methods take Python ints."""
+    scalars; host methods take Python ints.
 
-    def __init__(self, config: ChaosConfig):
+    ``generation`` (default 0) is the divergence doctor's rollback
+    counter: every step-targeted fault is a trace/host-time no-op at
+    generation > 0, so a rolled-back run replays the faulted step range
+    clean — and the rebuilt step program is identical to a chaos-free one
+    (the fault hooks emit no ops). ``crashloop`` ignores generations (it
+    is keyed on the supervised run attempt, not a step)."""
+
+    def __init__(self, config: ChaosConfig, generation: int = 0):
         self.config = config
+        self.generation = generation
+
+    def with_generation(self, generation: int) -> "ChaosInjector":
+        """The injector the doctor rebuilds step programs with after a
+        rollback: same plan, step-targeted faults disarmed."""
+        return ChaosInjector(self.config, generation=generation)
 
     @classmethod
     def from_env(cls, environ=None) -> Optional["ChaosInjector"]:
@@ -151,7 +217,7 @@ class ChaosInjector:
         ``state.step + 1`` (the step being computed)."""
         import jax.numpy as jnp
 
-        if not self.config.grad_faults:
+        if not self.config.grad_faults or self.generation:
             return jnp.int32(0)
         steps = jnp.asarray(
             [f[0] for f in self.config.grad_faults], jnp.int32
@@ -166,10 +232,17 @@ class ChaosInjector:
         """Poison the gradient pytree when ``step`` matches a grad fault.
         With ``replica`` (a traced replica index) given, a fault hits only
         ``target_replica`` — unless that fault was starred (``@S*``), which
-        hits every replica (the zero-survivors drill)."""
+        hits every replica (the zero-survivors drill). ``spike`` faults
+        always hit every replica: a sustained finite amplification models
+        a globally diverging trajectory, the condition only the windowed
+        detector (not the per-step screen) can see. No-op past
+        generation 0 (see class docstring)."""
         import jax
         import jax.numpy as jnp
 
+        if self.generation:
+            return grads
+        grads = self._inject_spike(grads, step)
         if not self.config.grad_faults:
             return grads
         code = self.grad_fault_code(step)
@@ -200,10 +273,50 @@ class ChaosInjector:
             lambda g: g * mul.astype(g.dtype) + add.astype(g.dtype), grads
         )
 
+    def _inject_spike(self, grads, step):
+        """Finite sustained amplification: multiply by ``spike_scale`` when
+        ``step`` falls inside any configured [S, S+W) spike window."""
+        import jax
+        import jax.numpy as jnp
+
+        if not self.config.spike_faults:
+            return grads
+        step_t = jnp.asarray(step, jnp.int32)
+        active = jnp.bool_(False)
+        for start, window in self.config.spike_faults:
+            active |= (step_t >= start) & (step_t < start + window)
+        mul = jnp.where(
+            active, jnp.float32(self.config.spike_scale), jnp.float32(1.0)
+        )
+        return jax.tree_util.tree_map(
+            lambda g: g * mul.astype(g.dtype), grads
+        )
+
     # ---- host-side faults ---------------------------------------------
+
+    def maybe_die_crashloop(self, attempt: Optional[int] = None) -> None:
+        """crashloop@M: hard-exit at loop start while the run attempt is
+        below M. ``attempt`` defaults to the supervisor's ATOMO_RUN_ATTEMPT
+        env (0 when unsupervised). Ignores generations — the fault is
+        keyed on process runs, not steps."""
+        m = self.config.crashloop
+        if not m:
+            return
+        if attempt is None:
+            attempt = int(os.environ.get(ATTEMPT_ENV, "0"))
+        if attempt < m:
+            print(
+                f"CHAOS: crashloop killing run attempt {attempt} "
+                f"(dies until attempt {m}; exit {self.config.exit_code})",
+                file=sys.stderr,
+                flush=True,
+            )
+            os._exit(self.config.exit_code)
 
     def maybe_sleep(self, step: int) -> float:
         """Sleep if a slow@ fault targets ``step``; returns seconds slept."""
+        if self.generation:
+            return 0.0
         total = 0.0
         for s, sec in self.config.slow_steps:
             if s == step:
@@ -212,7 +325,7 @@ class ChaosInjector:
         return total
 
     def should_die(self, step: int) -> bool:
-        return step in self.config.kill_steps
+        return not self.generation and step in self.config.kill_steps
 
     def maybe_die(self, step: int) -> None:
         """Simulated process death: flush and hard-exit BEFORE the step runs
@@ -227,6 +340,8 @@ class ChaosInjector:
             os._exit(self.config.exit_code)
 
     def ckpt_fault_for(self, step: int) -> Optional[str]:
+        if self.generation:
+            return None
         for s, kind in self.config.ckpt_faults:
             if s == step:
                 return kind

@@ -16,14 +16,28 @@ program, so wall-clock phase spans are replaced by:
                             profiler timelines.
   * ``StepTimer``         — per-step host timing with a trailing-window
                             summary, feeding StepMetrics.time_cost.
+  * ``IncidentLog``       — the robustness stack's machine-readable
+                            post-mortem artifact (train_dir/incidents.jsonl):
+                            every divergence alarm, rollback, retried host
+                            op, supervised restart, and give-up lands here
+                            as one JSON line, so "what happened to this
+                            run" is a file read, not a log archaeology dig.
 """
 
 from __future__ import annotations
 
 import collections
 import contextlib
+import json
+import os
 import time
 from typing import Iterator, Optional
+
+# Supervisor protocol: training.resilience.run_supervised sets this on each
+# child to the 0-based run attempt index; utils.chaos keys crashloop@M on
+# it. Defined in this stdlib-only module because utils cannot import
+# training, and sharing one name keeps setter and reader from drifting.
+ATTEMPT_ENV = "ATOMO_RUN_ATTEMPT"
 
 
 @contextlib.contextmanager
@@ -110,6 +124,116 @@ def profile(log_dir: str) -> Iterator[None]:
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+INCIDENT_LOG_NAME = "incidents.jsonl"
+
+
+class IncidentLog:
+    """Append-only JSONL incident stream (the post-mortem artifact).
+
+    Schema — every record carries:
+      ts        unix seconds at append time
+      uptime_s  seconds since this writer process opened the log
+      cause     what happened ("divergence", "crash", "retry",
+                "clean_exit", "budget_exhausted", ...)
+      action    what was done about it ("rollback", "restart", "give_up",
+                "done", "retry", ...)
+    plus the optional context fields ``step`` (trainer step), ``target``
+    (rollback target step), ``attempt`` (supervised restart index), and any
+    extra keyword detail the caller provides.
+
+    Each record is ONE ``write()`` of one newline-terminated line in append
+    mode, so concurrent writers (the trainer process and its supervisor)
+    interleave at line granularity on POSIX — the file always parses.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._t0 = time.time()
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+
+    @classmethod
+    def for_train_dir(cls, train_dir: str) -> "IncidentLog":
+        return cls(os.path.join(train_dir, INCIDENT_LOG_NAME))
+
+    def append(
+        self,
+        cause: str,
+        *,
+        action: str = "",
+        step: Optional[int] = None,
+        target: Optional[int] = None,
+        attempt: Optional[int] = None,
+        **detail,
+    ) -> dict:
+        now = time.time()
+        rec = {
+            "ts": round(now, 3),
+            "uptime_s": round(now - self._t0, 3),
+            "cause": cause,
+            "action": action,
+        }
+        if step is not None:
+            rec["step"] = int(step)
+        if target is not None:
+            rec["target"] = int(target)
+        if attempt is not None:
+            rec["attempt"] = int(attempt)
+        rec.update(detail)
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError as exc:
+            # best-effort: incidents are often recorded exactly when the
+            # filesystem is misbehaving (e.g. inside with_retries' except
+            # handler for a failed checkpoint save) — the post-mortem
+            # artifact must never crash the run it documents
+            import warnings
+
+            warnings.warn(f"incident log append failed: {exc}")
+        return rec
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Parse an incidents.jsonl; missing file = no incidents. Torn
+        trailing lines (a write interrupted by a kill) are skipped — the
+        log must stay readable after exactly the failures it documents."""
+        if not os.path.exists(path):
+            return []
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        return out
+
+    @staticmethod
+    def summarize(path: str) -> str:
+        """Human post-mortem: one line per incident, oldest first."""
+        recs = IncidentLog.read(path)
+        if not recs:
+            return f"no incidents recorded in {path!r}"
+        lines = [f"incident log {path} ({len(recs)} records):"]
+        for r in recs:
+            bits = [f"+{r.get('uptime_s', 0.0):.1f}s", r.get("cause", "?")]
+            if "step" in r:
+                bits.append(f"step={r['step']}")
+            if "target" in r:
+                bits.append(f"target={r['target']}")
+            if "attempt" in r:
+                bits.append(f"attempt={r['attempt']}")
+            if r.get("action"):
+                bits.append(f"-> {r['action']}")
+            lines.append("  " + " ".join(bits))
+        return "\n".join(lines)
 
 
 class StepTimer:

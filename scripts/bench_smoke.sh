@@ -18,6 +18,11 @@
 #      artifact must still parse with whatever rows completed (rc=124
 #      resilience — the three-round zero-valid-TPU-rows failure mode).
 #
+#   5. the supervisor contract (<60 s, CPU): a crashloop@2 chaos run
+#      under --max-restarts 2 must exit 0 on the third attempt and
+#      leave a parseable incidents.jsonl (2 crash records + the clean
+#      exit) — the PR-5 escalation ladder's run-level rung.
+#
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
 cd "$(dirname "$0")/.." || exit 2
@@ -53,7 +58,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/4]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/5]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -82,7 +87,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/4]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/5]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -119,7 +124,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/4]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/5]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -150,6 +155,33 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/4]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/5]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
+EOF
+
+[ $? -ne 0 ] && exit 1
+
+# --- 5: supervisor crashloop budget drill --------------------------------
+sup="$art/sup"
+out=$(timeout -k 5 60 env JAX_PLATFORMS=cpu ATOMO_COMPILE_CACHE="$art/xla" \
+      python -m atomo_tpu.cli train --synthetic --dataset mnist \
+      --network lenet --batch-size 8 --max-steps 3 --eval-freq 2 \
+      --log-interval 1 --n-devices 1 --train-dir "$sup" \
+      --chaos crashloop@2 --max-restarts 2 --restart-backoff 0.05 2>&1)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: supervisor drill exited rc=$rc"
+  printf '%s\n' "$out" | tail -5
+  exit 1
+fi
+python - "$sup/incidents.jsonl" <<'EOF'
+import json, sys
+
+recs = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+causes = [r["cause"] for r in recs]
+assert causes == ["crash", "crash", "clean_exit"], causes
+assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
+assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
+print(f"bench_smoke OK[5/5]: crashloop@2 recovered on attempt 2 under "
+      f"budget; incident log parses ({len(recs)} records)")
 EOF

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Run-level supervisor: wrap ANY train command in a crash-loop budget.
+
+The `--max-restarts` CLI flag covers the common case (the trainer
+re-execs itself); this script is the generic form for commands the CLI
+does not own — launcher wrappers, multi-flag shell pipelines, other
+entrypoints:
+
+    python scripts/supervise.py --max-restarts 3 --restart-backoff 0.5 \
+        --train-dir out/models -- \
+        python -m atomo_tpu.cli train --synthetic --max-steps 200 ...
+
+Semantics (training.resilience.run_supervised):
+  * child exit 0                 -> clean exit, done (rc 0)
+  * child exit 23 (ROLLBACK_EXIT_CODE: the in-process rollback budget is
+    spent)                       -> prune the checkpoint timeline back to
+    the newest HEALTHY step so --resume cannot land on diverged weights,
+    then restart against the budget
+  * any other nonzero exit       -> crash; restart against the budget
+Restarts wait a decorrelated-jittered backoff and burn one unit of the
+budget; exhaustion exits with the child's last code. When --train-dir is
+given, restarts also append `--resume` (once) — resume is only meaningful
+against a checkpoint dir, and an arbitrary wrapped command may not accept
+the flag (--no-resume-flag suppresses it explicitly). Every decision is
+one JSON line in train_dir/incidents.jsonl (utils.tracing.IncidentLog) —
+the machine-readable post-mortem.
+
+The child sees ATOMO_SUPERVISED=1 (so a supervised CLI run never
+re-supervises itself) and ATOMO_RUN_ATTEMPT=<n> (the 0-based run index,
+which attempt-keyed chaos like `crashloop@M` reads).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="supervise",
+        description="crash-loop-budgeted supervisor for train commands",
+    )
+    parser.add_argument("--max-restarts", type=int, default=2, metavar="N")
+    parser.add_argument("--restart-backoff", type=float, default=1.0,
+                        metavar="SEC", help="backoff base seconds "
+                        "(decorrelated jitter, capped at 30x)")
+    parser.add_argument("--train-dir", type=str, default="",
+                        help="checkpoint dir: enables healthy-checkpoint "
+                        "pruning on rollback-requested exits and the "
+                        "incidents.jsonl record")
+    parser.add_argument("--no-resume-flag", action="store_true",
+                        default=False,
+                        help="do not append --resume to restarted commands "
+                        "(--resume is only appended when --train-dir is "
+                        "given; commands without the flag would otherwise "
+                        "die parsing it on every restart)")
+    parser.add_argument("cmd", nargs=argparse.REMAINDER,
+                        help="the command to supervise (prefix with --)")
+    args = parser.parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        parser.error("no command given (append it after --)")
+
+    from atomo_tpu.training.resilience import run_supervised
+
+    resume = None
+    if args.train_dir and not args.no_resume_flag:
+        resume = "--resume"
+    return run_supervised(
+        cmd,
+        max_restarts=args.max_restarts,
+        backoff_base=args.restart_backoff,
+        backoff_max=args.restart_backoff * 30,
+        train_dir=args.train_dir or None,
+        resume_flag=resume,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -16,7 +16,10 @@ Env: ATOMO_FT_DIR (train_dir), ATOMO_FT_RESUME=1 (resume), ATOMO_FT_STEPS
 (default 8), ATOMO_CHAOS (fault plan, e.g. "nan@3,kill@6"),
 ATOMO_FT_SUPERSTEP (default 1: fused K-step blocks — the superstep drill
 runs crash/resume legs with DIFFERENT K values to prove block-partition
-invariance of the recovered trajectory).
+invariance of the recovered trajectory), ATOMO_FT_DIVERGE (arm the
+divergence doctor with this remedy: skip|rewarm|densify — the PR-5
+rollback drill; detector knobs via ATOMO_FT_DIVERGE_WINDOW /
+ATOMO_FT_ZMAX, in-process budget via ATOMO_FT_MAX_ROLLBACKS).
 """
 
 import hashlib
@@ -44,6 +47,20 @@ def main() -> None:
     resume = os.environ.get("ATOMO_FT_RESUME") == "1"
     max_steps = int(os.environ.get("ATOMO_FT_STEPS", "8"))
     superstep = int(os.environ.get("ATOMO_FT_SUPERSTEP", "1"))
+    diverge = None
+    if os.environ.get("ATOMO_FT_DIVERGE"):
+        from atomo_tpu.training import DetectorConfig, DivergeConfig
+
+        diverge = DivergeConfig(
+            remedy=os.environ["ATOMO_FT_DIVERGE"],
+            detector=DetectorConfig(
+                window=int(os.environ.get("ATOMO_FT_DIVERGE_WINDOW", "4")),
+                zmax=float(os.environ.get("ATOMO_FT_ZMAX", "4.0")),
+                patience=2,
+                min_history=4,
+            ),
+            max_rollbacks=int(os.environ.get("ATOMO_FT_MAX_ROLLBACKS", "2")),
+        )
     model = get_model("lenet", 10)
     opt = make_optimizer("sgd", lr=0.05, momentum=0.9)  # momentum: the
     # restart must restore the optimizer state, not just params
@@ -62,6 +79,7 @@ def main() -> None:
         guard=GuardConfig(),
         log_fn=lambda s: print(s, flush=True),
         superstep=superstep,
+        diverge=diverge,
     )
     h = hashlib.sha256()
     for leaf in jax.tree_util.tree_leaves(jax.device_get(state.params)):

@@ -157,3 +157,100 @@ def test_corrupt_unknown_kind(tmp_path):
     _write(p, bytes(20))
     with pytest.raises(ValueError):
         corrupt_file(p, "gamma-ray")
+
+
+# ---------------- spike + crashloop (PR 5) ----------------
+
+
+def test_spec_parses_spike_and_crashloop():
+    cfg = ChaosConfig.from_spec("spike@7:3,crashloop@2", spike_scale=30.0)
+    assert cfg.spike_faults == ((7, 3),)
+    assert cfg.spike_scale == 30.0
+    assert cfg.crashloop == 2
+    assert cfg.enabled()
+    # window defaults to 3 when the :arg is omitted
+    assert ChaosConfig.from_spec("spike@5").spike_faults == ((5, 3),)
+    with pytest.raises(ValueError):
+        ChaosConfig.from_spec("spike@5:0")  # window must be >= 1
+
+
+def test_from_spec_reads_env_knobs_like_from_env():
+    """--chaos and ATOMO_CHAOS must behave identically for the same spec:
+    from_spec defaults seed/spike_scale to the env knobs."""
+    env = {"ATOMO_CHAOS_SPIKE_SCALE": "50", "ATOMO_CHAOS_SEED": "7"}
+    cfg = ChaosConfig.from_spec("spike@3", environ=env)
+    assert cfg.spike_scale == 50.0
+    assert cfg.seed == 7
+    # explicit arguments still beat the env
+    cfg = ChaosConfig.from_spec("spike@3", spike_scale=9.0, environ=env)
+    assert cfg.spike_scale == 9.0
+    # no env knobs -> the documented defaults
+    cfg = ChaosConfig.from_spec("spike@3", environ={})
+    assert cfg.spike_scale == 8.0 and cfg.seed == 0
+
+
+def test_spike_amplifies_finite_window_only():
+    import jax.numpy as jnp
+
+    inj = ChaosInjector(ChaosConfig.from_spec("spike@3:2", spike_scale=8.0))
+    g = {"w": jnp.ones((4,))}
+    for step, want in [(2, 1.0), (3, 8.0), (4, 8.0), (5, 1.0)]:
+        out = inj.inject_grads(g, step)
+        np.testing.assert_allclose(np.asarray(out["w"]), want)
+        # finite: the norm-screen-passing property that distinguishes
+        # spike from explode
+        assert np.isfinite(np.asarray(out["w"])).all()
+
+
+def test_generation_disarms_step_faults_but_not_crashloop(tmp_path):
+    import jax.numpy as jnp
+
+    inj = ChaosInjector(
+        ChaosConfig.from_spec("spike@3:2,nan@5,kill@7,slow@2:9,truncate@4")
+    )
+    g1 = inj.with_generation(1)
+    g = {"w": jnp.ones((4,))}
+    for step in (3, 4, 5):  # spike and nan steps: replay must be clean
+        np.testing.assert_array_equal(
+            np.asarray(g1.inject_grads(g, step)["w"]), 1.0
+        )
+    assert not g1.should_die(7)
+    assert g1.maybe_sleep(2) == 0.0
+    assert g1.ckpt_fault_for(4) is None
+    # crashloop is attempt-keyed, not step-keyed: generations don't apply
+    cfg = ChaosConfig.from_spec("crashloop@2")
+    assert ChaosInjector(cfg, generation=1).config.crashloop == 2
+
+
+def test_crashloop_dies_below_attempt_threshold():
+    """The injector must hard-exit for attempts < M and return for
+    attempts >= M. os._exit can't be intercepted in-process, so the doomed
+    side runs in a child interpreter."""
+    import subprocess
+    import sys
+
+    code = (
+        "from atomo_tpu.utils.chaos import ChaosConfig, ChaosInjector\n"
+        "inj = ChaosInjector(ChaosConfig.from_spec('crashloop@2'))\n"
+        "inj.maybe_die_crashloop(attempt={a})\n"
+        "print('SURVIVED')\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    dead = subprocess.run(
+        [sys.executable, "-c", code.format(a=1)], env=env,
+        capture_output=True, text=True,
+    )
+    assert dead.returncode == CHAOS_EXIT_CODE
+    assert "SURVIVED" not in dead.stdout
+    alive = subprocess.run(
+        [sys.executable, "-c", code.format(a=2)], env=env,
+        capture_output=True, text=True,
+    )
+    assert alive.returncode == 0 and "SURVIVED" in alive.stdout
+
+
+def test_spike_scale_env_plumbs_through():
+    cfg = ChaosConfig.from_env(
+        {"ATOMO_CHAOS": "spike@4:2", "ATOMO_CHAOS_SPIKE_SCALE": "12.5"}
+    )
+    assert cfg.spike_faults == ((4, 2),) and cfg.spike_scale == 12.5

@@ -380,3 +380,204 @@ def test_chaos_corrupts_final_autosave_too(tmp_path):
     )
     assert list_steps(str(tmp_path)) == [3, 4]  # periodic + autosave
     assert latest_valid_step(str(tmp_path)) == 3  # autosave was corrupted
+
+# ---------------- healthy tags + verify memoization (PR 5) ----------------
+
+
+def test_healthy_tags_and_latest_healthy_step(tmp_path):
+    import os
+
+    from atomo_tpu.training.checkpoint import (
+        is_marked_healthy,
+        latest_healthy_step,
+        latest_valid_step,
+        mark_healthy,
+    )
+    from atomo_tpu.utils.chaos import corrupt_file
+
+    d = str(tmp_path)
+    _state_for_ckpt(tmp_path, steps=(1, 2, 3))
+    assert latest_healthy_step(d) is None  # valid != healthy
+    mark_healthy(d, 1)
+    mark_healthy(d, 2)
+    assert is_marked_healthy(d, 2) and not is_marked_healthy(d, 3)
+    assert latest_healthy_step(d) == 2
+    assert latest_valid_step(d) == 3  # unchanged: different predicate
+    # a healthy-TAGGED file that is later torn must not be a target
+    corrupt_file(os.path.join(d, "model_step_2"), "truncate")
+    assert latest_healthy_step(d) == 1
+
+
+def test_prune_after_cuts_diverged_timeline(tmp_path):
+    import os
+
+    from atomo_tpu.training.checkpoint import (
+        healthy_marker_path,
+        mark_healthy,
+        prune_after,
+    )
+
+    d = str(tmp_path)
+    _state_for_ckpt(tmp_path, steps=(1, 2, 3))
+    mark_healthy(d, 3)
+    removed = prune_after(d, 1)
+    assert removed == [2, 3]
+    assert list_steps(d) == [1]
+    assert not os.path.exists(healthy_marker_path(d, 3))  # sidecar followed
+
+
+def test_retention_removes_healthy_sidecar_with_its_checkpoint(tmp_path):
+    """A SUPERSEDED healthy checkpoint (a newer save holds the tag) leaves
+    with its sidecar — an orphaned tag would let a future file reusing the
+    step number inherit a health verdict it never earned."""
+    import os
+
+    from atomo_tpu.training.checkpoint import (
+        healthy_marker_path,
+        mark_healthy,
+    )
+
+    model, opt, it = _small_setup()
+    images, _ = next(iter(it.epoch()))
+    state = create_state(model, opt, jax.random.PRNGKey(0), jnp.asarray(images))
+    d = str(tmp_path)
+    save_checkpoint(d, state, 1, compress=False)
+    mark_healthy(d, 1)
+    save_checkpoint(d, state, 2, compress=False, keep=2)
+    mark_healthy(d, 2)  # newer anchor supersedes step 1's
+    save_checkpoint(d, state, 3, compress=False, keep=2)
+    assert list_steps(d) == [2, 3]
+    assert not os.path.exists(healthy_marker_path(d, 1))
+
+
+def test_retention_preserves_newest_healthy_anchor(tmp_path):
+    """The newest healthy-tagged checkpoint rides OUTSIDE the keep budget
+    until a newer save earns the tag: deleting it would leave
+    latest_healthy_step() empty and turn the doctor's next rollback into a
+    from-scratch restart."""
+    import os
+
+    from atomo_tpu.training.checkpoint import (
+        healthy_marker_path,
+        latest_healthy_step,
+        mark_healthy,
+    )
+
+    model, opt, it = _small_setup()
+    images, _ = next(iter(it.epoch()))
+    state = create_state(model, opt, jax.random.PRNGKey(0), jnp.asarray(images))
+    d = str(tmp_path)
+    save_checkpoint(d, state, 1, compress=False)
+    mark_healthy(d, 1)
+    # keep=2 would normally retain only {new, newest-other}; the untagged
+    # saves must not evict the only rollback anchor
+    for s in (2, 3, 4):
+        save_checkpoint(d, state, s, compress=False, keep=2)
+    assert list_steps(d) == [1, 3, 4]
+    assert latest_healthy_step(d) == 1
+    # a newer save earning the tag supersedes the anchor; the old one is
+    # then an ordinary out-of-budget candidate and leaves with its sidecar
+    mark_healthy(d, 4)
+    save_checkpoint(d, state, 5, compress=False, keep=2)
+    assert list_steps(d) == [4, 5]
+    assert latest_healthy_step(d) == 4
+    assert not os.path.exists(healthy_marker_path(d, 1))
+
+
+def test_verify_memoization_hits_and_invalidates(tmp_path, monkeypatch):
+    """Repeated latest_valid_step scans must not re-read every blob; a
+    rewritten/corrupted file (stat change) must drop its cached verdict."""
+    import builtins
+    import os
+
+    from atomo_tpu.training import checkpoint as ck
+    from atomo_tpu.utils.chaos import corrupt_file
+
+    d = str(tmp_path)
+    _state_for_ckpt(tmp_path, steps=(1, 2))
+    ck.reset_verify_cache()
+    reads = []
+    real_open = builtins.open
+
+    def counting_open(path, *a, **kw):
+        if "model_step" in str(path) and a and "b" in a[0]:
+            reads.append(str(path))
+        return real_open(path, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", counting_open)
+    assert ck.latest_valid_step(d) == 2
+    n_first = len(reads)
+    assert n_first >= 1
+    assert ck.latest_valid_step(d) == 2  # second scan: stat-only
+    assert len(reads) == n_first
+    assert ck.verify_checkpoint(d, 2)
+    assert len(reads) == n_first
+    # corruption rewrites the file (os.replace -> new stat): re-verified
+    monkeypatch.setattr(builtins, "open", real_open)
+    corrupt_file(os.path.join(d, "model_step_2"), "bitflip")
+    assert not ck.verify_checkpoint(d, 2)
+    assert ck.latest_valid_step(d) == 1
+
+
+def test_verify_cache_inode_survives_same_size_same_mtime_rewrite(tmp_path):
+    """Coarse-mtime filesystems (NFS): a same-size rewrite forced into the
+    same mtime tick must still invalidate the cached verdict — os.replace
+    allocates a fresh inode, which is part of the cache key."""
+    import os
+
+    from atomo_tpu.training import checkpoint as ck
+
+    d = str(tmp_path)
+    _state_for_ckpt(tmp_path, steps=(1,))
+    ck.reset_verify_cache()
+    path = os.path.join(d, "model_step_1")
+    assert ck.verify_checkpoint(d, 1)
+    st = os.stat(path)
+    garbage = bytes(st.st_size)  # same size, invalid content
+    tmp = path + ".rw"
+    with open(tmp, "wb") as f:
+        f.write(garbage)
+    os.replace(tmp, path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))  # force same tick
+    assert not ck.verify_checkpoint(d, 1)
+
+
+def test_verify_transient_read_error_is_not_memoized(tmp_path, monkeypatch):
+    """A one-off read blip (EIO) must not permanently disqualify a good
+    checkpoint: the stat won't change when the blip clears, so caching the
+    False would make every later rollback scan skip a healthy target."""
+    import builtins
+    import os
+
+    from atomo_tpu.training import checkpoint as ck
+
+    d = str(tmp_path)
+    _state_for_ckpt(tmp_path, steps=(1,))
+    ck.reset_verify_cache()
+    path = os.path.join(d, "model_step_1")
+    real_open = builtins.open
+
+    def flaky_open(p, *a, **kw):
+        if str(p) == path:
+            raise OSError("transient EIO")
+        return real_open(p, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", flaky_open)
+    assert not ck.verify_checkpoint(d, 1)  # invalid NOW...
+    monkeypatch.setattr(builtins, "open", real_open)
+    assert ck.verify_checkpoint(d, 1)  # ...but recovers after the blip
+
+
+def test_verify_cache_negative_verdicts_are_cached(tmp_path):
+    import os
+
+    from atomo_tpu.training import checkpoint as ck
+    from atomo_tpu.utils.chaos import corrupt_file
+
+    d = str(tmp_path)
+    _state_for_ckpt(tmp_path, steps=(1,))
+    ck.reset_verify_cache()
+    corrupt_file(os.path.join(d, "model_step_1"), "bitflip")
+    assert not ck.verify_checkpoint(d, 1)
+    assert not ck.verify_checkpoint(d, 1)  # cached; must stay False
+    assert ck.latest_valid_step(d) is None

@@ -1,6 +1,7 @@
 """CLI surface tests: flag parity with src/distributed_nn.py:31-82, subcommand
 dispatch, end-to-end smoke train, tuning parser contract."""
 
+import time
 import warnings
 
 import pytest
@@ -277,3 +278,183 @@ def test_overlap_flag_surface():
     assert args.overlap == "delayed"
     with pytest.raises(SystemExit):
         train.parse_args(["--overlap", "eager"])
+
+
+# ---------------- PR 5: divergence-doctor / supervisor flags ----------------
+
+
+def test_on_diverge_flag_validation():
+    # densify needs a compressing codec
+    with pytest.raises(SystemExit):
+        main([
+            "train", "--synthetic", "--n-devices", "1", "--max-steps", "1",
+            "--code", "sgd", "--on-diverge", "densify",
+            "--train-dir", "/tmp/nonexistent-unused",
+        ])
+    # --phase-metrics has no doctor wiring
+    with pytest.raises(SystemExit):
+        main([
+            "train", "--synthetic", "--n-devices", "2", "--max-steps", "1",
+            "--code", "svd", "--on-diverge", "skip", "--phase-metrics",
+            "--train-dir", "/tmp/nonexistent-unused",
+        ])
+    # densify cannot compose with the delayed overlap
+    with pytest.raises(SystemExit):
+        main([
+            "train", "--synthetic", "--n-devices", "2", "--max-steps", "1",
+            "--code", "qsgd", "--aggregate", "gather",
+            "--overlap", "delayed", "--on-diverge", "densify",
+            "--train-dir", "/tmp/nonexistent-unused",
+        ])
+    # densify cannot compose with hierarchical aggregation (hierarchical
+    # needs a codec, so without this guard the conflict surfaced as an
+    # uncaught ValueError at ROLLBACK time, after the timeline was pruned)
+    with pytest.raises(SystemExit):
+        main([
+            "train", "--synthetic", "--n-devices", "2", "--max-steps", "1",
+            "--code", "qsgd", "--aggregate", "hierarchical",
+            "--on-diverge", "densify",
+            "--train-dir", "/tmp/nonexistent-unused",
+        ])
+    # a config conflict must fail fast in the supervisor PARENT (argv-level
+    # pre-flight), not re-exec children through the whole restart budget;
+    # under supervision the old path took >= 2 backoffs before giving up
+    for typo in (
+        ["--code", "sgd", "--on-diverge", "densify"],
+        ["--superstep", "-1"],
+        ["--code", "qsgd", "--overlap", "delayed", "--aggregate", "psum"],
+        ["--chaos", "frob@3"],
+    ):
+        t0 = time.monotonic()
+        with pytest.raises(SystemExit):
+            main([
+                "train", "--synthetic", "--n-devices", "1", "--max-steps",
+                "1", "--max-restarts", "5", "--restart-backoff", "30",
+                "--train-dir", "/tmp/nonexistent-unused", *typo,
+            ])
+        assert time.monotonic() - t0 < 10  # no re-exec, no backoff sleeps
+
+
+def test_on_diverge_preflight_symmetry():
+    """_argv_preflight mirrors the in-run conflict gate: multi-device-only
+    features are claimed only when the mesh can be multi-device, and every
+    argv-knowable conflict (num-aggregate, retention-vs-window) fails fast
+    in the supervisor parent instead of burning the restart budget."""
+    from atomo_tpu.cli import _argv_preflight, build_parser
+
+    parser = build_parser()
+    sub = next(
+        a for a in parser._actions if hasattr(a, "choices") and a.choices
+    )
+    train = sub.choices["train"]
+
+    def preflight(*argv):
+        _argv_preflight(train.parse_args(
+            ["--synthetic", "--train-dir", "/tmp/unused", *argv]
+        ))
+
+    # argv-knowable densify x num-aggregate conflict: caught pre-exec
+    with pytest.raises(SystemExit) as ei:
+        preflight("--code", "qsgd", "--on-diverge", "densify",
+                  "--num-aggregate", "2", "--n-devices", "2")
+    assert "num-aggregate" in str(ei.value)
+    # zero1 is multi-device-only: claimed on a mesh, ignored at n-devices 1
+    with pytest.raises(SystemExit) as ei:
+        preflight("--code", "qsgd", "--on-diverge", "skip",
+                  "--zero1", "--n-devices", "4")
+    assert "zero1" in str(ei.value)
+    # --n-devices 1 disables the multi-device features: the in-run check
+    # passes None for them, and preflight must not reject what it accepts
+    preflight("--code", "qsgd", "--on-diverge", "densify",
+              "--num-aggregate", "2", "--n-devices", "1")
+    preflight("--code", "qsgd", "--on-diverge", "densify",
+              "--aggregate", "hierarchical", "--n-devices", "1")
+    preflight("--code", "qsgd", "--on-diverge", "skip",
+              "--zero1", "--n-devices", "1")
+    # keep-last-K retention shorter than the healthy-tag window
+    with pytest.raises(SystemExit) as ei:
+        preflight("--code", "sgd", "--on-diverge", "skip", "--n-devices",
+                  "1", "--keep-ckpts", "1", "--save-freq", "2",
+                  "--diverge-window", "16")
+    assert "keep-ckpts" in str(ei.value)
+    # supervised restarts append --resume, and a --zero1 run cannot resume
+    # the delayed in-flight payload: every restart would fail instantly
+    with pytest.raises(SystemExit) as ei:
+        preflight("--code", "qsgd", "--overlap", "delayed", "--zero1",
+                  "--n-devices", "4", "--max-restarts", "2")
+    assert "zero1" in str(ei.value)
+    # with checkpointing disabled (--train-dir "") resume is a no-op, so
+    # supervised fresh restarts of a zero1+delayed run are fine
+    _argv_preflight(train.parse_args(
+        ["--synthetic", "--train-dir", "", "--code", "qsgd", "--overlap",
+         "delayed", "--zero1", "--n-devices", "4", "--max-restarts", "2"]
+    ))
+    # a typo'd chaos spec is argv-knowable: caught before any re-exec
+    with pytest.raises(SystemExit) as ei:
+        preflight("--chaos", "frob@3")
+    assert "frob" in str(ei.value)
+    # checkpointing disabled: the doctor could never roll back to anything
+    with pytest.raises(SystemExit) as ei:
+        preflight("--on-diverge", "skip", "--save-freq", "0",
+                  "--eval-freq", "0")
+    assert "cadence" in str(ei.value)
+    # --n-devices 0 (= all visible) is ambiguous from argv: preflight must
+    # NOT claim multi-device features for it (a 1-device host accepts
+    # these configs) — the in-run check rejects cheaply via rc=2 on a mesh
+    preflight("--code", "qsgd", "--on-diverge", "skip", "--zero1",
+              "--n-devices", "0")
+    preflight("--code", "qsgd", "--on-diverge", "densify",
+              "--num-aggregate", "2", "--n-devices", "0")
+    # degenerate detector knobs are argv-knowable too: they must fail in
+    # the supervisor parent, not as a ValueError in every jax-booted child
+    with pytest.raises(SystemExit) as ei:
+        preflight("--on-diverge", "skip", "--diverge-window", "1")
+    assert "window" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--on-diverge", "skip", "--diverge-patience", "0")
+    assert "patience" in str(ei.value)
+
+
+def test_preflight_validates_env_chaos_spec(monkeypatch):
+    """Supervised children inherit ATOMO_CHAOS, so a typo'd env spec would
+    burn the restart budget exactly like a typo'd --chaos flag; preflight
+    must validate it when no flag overrides it."""
+    from atomo_tpu.cli import _argv_preflight, build_parser
+
+    parser = build_parser()
+    sub = next(
+        a for a in parser._actions if hasattr(a, "choices") and a.choices
+    )
+    train = sub.choices["train"]
+    args = train.parse_args(["--synthetic", "--train-dir", "/tmp/unused"])
+
+    monkeypatch.setenv("ATOMO_CHAOS", "frob@3")
+    with pytest.raises(SystemExit) as ei:
+        _argv_preflight(args)
+    assert "frob" in str(ei.value)
+    # a valid env spec passes, and an explicit --chaos flag wins (the env
+    # is ignored in-run when the flag is set, so only the flag is checked)
+    monkeypatch.setenv("ATOMO_CHAOS", "nan@2")
+    _argv_preflight(args)
+    monkeypatch.setenv("ATOMO_CHAOS", "frob@3")
+    args2 = train.parse_args(
+        ["--synthetic", "--train-dir", "/tmp/unused", "--chaos", "nan@2"]
+    )
+    _argv_preflight(args2)
+
+
+def test_on_diverge_smoke_train(tmp_path):
+    """A sane short run with the doctor armed: trains to completion with
+    no rollback, writes healthy tags once the window clears."""
+    rc = main([
+        "train", "--synthetic", "--dataset", "MNIST", "--network", "LeNet",
+        "--batch-size", "8", "--max-steps", "6", "--eval-freq", "0",
+        "--save-freq", "2", "--log-interval", "0", "--n-devices", "1",
+        "--train-dir", str(tmp_path), "--on-diverge", "skip",
+        "--diverge-window", "2",
+    ])
+    assert rc == 0
+    from atomo_tpu.training import latest_healthy_step
+
+    # saves at 2/4/6; window 2 cleared past step 2 and 4 by step 6
+    assert latest_healthy_step(str(tmp_path)) >= 2

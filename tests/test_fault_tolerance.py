@@ -10,6 +10,8 @@ import re
 import subprocess
 import sys
 
+import pytest
+
 from atomo_tpu.utils.chaos import CHAOS_EXIT_CODE
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -19,7 +21,7 @@ _MP_WORKER = os.path.join(_HERE, "_mp_worker.py")
 _STEP_RE = re.compile(r"Worker: 0, Step: (\d+),.*?Loss: ([0-9.+-naif]+)")
 
 
-def _run_ft(train_dir, chaos="", resume=False, timeout=240):
+def _run_ft(train_dir, chaos="", resume=False, timeout=240, extra_env=None):
     env = {
         **os.environ,
         "JAX_PLATFORMS": "cpu",
@@ -28,6 +30,8 @@ def _run_ft(train_dir, chaos="", resume=False, timeout=240):
         "ATOMO_CHAOS": chaos,
         "PYTHONPATH": _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
     }
+    if extra_env:
+        env.update(extra_env)
     proc = subprocess.run(
         [sys.executable, _FT_WORKER],
         env=env,
@@ -126,3 +130,233 @@ def test_mp_worker_chaos_death_is_detected(tmp_path):
         assert p.returncode == CHAOS_EXIT_CODE, (p.returncode, err[-2000:])
         assert "CHAOS: killing process" in err
         assert "RESULT" not in out  # died before doing any work
+
+
+# ---------------- PR 5: divergence doctor drills ----------------
+
+
+def _cli_train(train_dir, *extra, timeout=180):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": _REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    cmd = [
+        sys.executable, "-m", "atomo_tpu.cli", "train",
+        "--synthetic", "--dataset", "mnist", "--network", "lenet",
+        "--batch-size", "8", "--max-steps", "3", "--eval-freq", "2",
+        "--log-interval", "1", "--n-devices", "1",
+        "--train-dir", str(train_dir), *extra,
+    ]
+    return subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=_REPO_ROOT,
+    )
+
+
+def _read_incidents(train_dir):
+    from atomo_tpu.utils.tracing import IncidentLog
+
+    return IncidentLog.read(os.path.join(str(train_dir), "incidents.jsonl"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("superstep", [1, 2])
+def test_spike_divergence_rollback_is_bit_exact_with_clean_run(
+    tmp_path, superstep
+):
+    """The PR-5 acceptance drill: a spike-injected run (finite,
+    norm-screen-passing amplification — invisible to grad_ok) must be
+    caught by the windowed detector, roll back to the last HEALTHY
+    checkpoint, replay the data stream, and end bit-identical to a
+    never-diverged run under the same (skip) remedy. Runs at K=1 and K=2
+    — the detector consumes the same per-step series either way."""
+    doctor_env = {
+        "ATOMO_FT_DIVERGE": "skip",
+        "ATOMO_FT_STEPS": "14",
+        "ATOMO_FT_SUPERSTEP": str(superstep),
+        "ATOMO_CHAOS_SPIKE_SCALE": "30.0",
+    }
+    clean_dir, spike_dir = tmp_path / "clean", tmp_path / "spike"
+
+    p_clean, l_clean, final_clean = _run_ft(
+        clean_dir, extra_env=doctor_env
+    )
+    assert p_clean.returncode == 0, p_clean.stderr[-3000:]
+    assert final_clean is not None
+    assert not any(
+        line.startswith("Doctor:") for line in p_clean.stdout.splitlines()
+    ), p_clean.stdout  # the detector must not false-alarm on a sane run
+
+    p_spike, l_spike, final_spike = _run_ft(
+        spike_dir, chaos="spike@7:3", extra_env=doctor_env
+    )
+    assert p_spike.returncode == 0, p_spike.stderr[-3000:]
+    doctor_lines = [
+        line for line in p_spike.stdout.splitlines()
+        if line.startswith("Doctor:")
+    ]
+    assert len(doctor_lines) == 1, p_spike.stdout  # exactly one rollback
+    assert "rolling back" in doctor_lines[0]
+    # ...and the post-recovery trajectory IS the clean trajectory, down to
+    # bit-identical final parameters (healthy-checkpoint restore + stream
+    # replay + generation-disarmed chaos)
+    assert final_spike == final_clean
+    # the recovered tail steps match the clean run's logged losses exactly
+    tail = {s: l_spike[s] for s in l_spike if s in l_clean and s >= 10}
+    assert tail == {s: l_clean[s] for s in tail}
+    # machine-readable post-mortem: one divergence record with a rollback
+    recs = _read_incidents(spike_dir)
+    div = [r for r in recs if r["cause"] == "divergence"]
+    assert len(div) == 1
+    assert div[0]["action"] == "rollback+skip"
+    assert div[0]["target"] < 7  # rolled back to a pre-spike checkpoint
+    assert "step" in div[0] and "ts" in div[0]
+
+
+@pytest.mark.slow
+def test_rollback_budget_exhaustion_exits_rollback_code(tmp_path):
+    """A run that keeps diverging past max_rollbacks must give up with
+    DivergenceError; the _ft_worker surfaces it as a traceback (library
+    path) — the CLI path maps it to ROLLBACK_EXIT_CODE, covered by the
+    supervisor drills."""
+    doctor_env = {
+        "ATOMO_FT_DIVERGE": "skip",
+        "ATOMO_FT_STEPS": "14",
+        "ATOMO_FT_MAX_ROLLBACKS": "0",  # zero budget: first alarm gives up
+        "ATOMO_CHAOS_SPIKE_SCALE": "30.0",
+    }
+    p, _, final = _run_ft(tmp_path / "d", chaos="spike@7:3", extra_env=doctor_env)
+    assert p.returncode != 0
+    assert "DivergenceError" in p.stderr
+    assert final is None
+    recs = _read_incidents(tmp_path / "d")
+    assert any(
+        r["cause"] == "divergence" and r["action"] == "give_up" for r in recs
+    )
+
+
+@pytest.mark.slow
+def test_supervised_crashloop_recovers_within_budget(tmp_path):
+    """crashloop@2 under --max-restarts 2: attempts 0 and 1 die at loop
+    start, attempt 2 trains to completion — exit 0 and a complete
+    incident log (2 crash records + the clean exit)."""
+    d = tmp_path / "sup"
+    p = _cli_train(
+        d, "--chaos", "crashloop@2", "--max-restarts", "2",
+        "--restart-backoff", "0.05",
+    )
+    assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
+    assert "Supervisor: clean exit (attempt 2)" in p.stdout
+    recs = _read_incidents(d)
+    assert [r["cause"] for r in recs] == ["crash", "crash", "clean_exit"]
+    assert [r["attempt"] for r in recs] == [0, 1, 2]
+    assert recs[-1]["action"] == "done"
+    # decorrelated backoff: recorded and positive
+    assert all(r["backoff_s"] > 0 for r in recs[:2])
+
+
+@pytest.mark.slow
+def test_supervised_budget_exhaustion_exits_nonzero(tmp_path):
+    """crashloop@5 under --max-restarts 1: the budget is exhausted while
+    the fault persists — nonzero exit (the child's last code) and a final
+    summarizing incident record."""
+    d = tmp_path / "sup"
+    p = _cli_train(
+        d, "--chaos", "crashloop@5", "--max-restarts", "1",
+        "--restart-backoff", "0.05",
+    )
+    assert p.returncode == CHAOS_EXIT_CODE, (p.returncode, p.stderr[-2000:])
+    recs = _read_incidents(d)
+    assert recs, "incident log missing"
+    last = recs[-1]
+    assert last["cause"] == "budget_exhausted"
+    assert last["action"] == "give_up"
+    assert last["rc"] == CHAOS_EXIT_CODE
+    assert last["max_restarts"] == 1
+
+
+@pytest.mark.slow
+def test_overlap_delayed_payload_survives_rollback(tmp_path):
+    """--overlap delayed + --aggregate ring: a spike-diverged run's
+    rollback restores the in-flight encoded payload with the params (the
+    DelayedState checkpoint), so the recovered trajectory is bit-exact
+    with a clean delayed run's."""
+    import hashlib
+    import shutil
+
+    import jax
+    import numpy as np
+
+    from atomo_tpu.codecs import QsgdCodec
+    from atomo_tpu.data import SPECS, BatchIterator, synthetic_dataset
+    from atomo_tpu.models import get_model
+    from atomo_tpu.parallel import distributed_train_loop, make_mesh
+    from atomo_tpu.training import (
+        DetectorConfig,
+        DivergeConfig,
+        GuardConfig,
+        make_optimizer,
+    )
+    from atomo_tpu.utils.chaos import ChaosConfig, ChaosInjector
+
+    def run(train_dir, chaos_spec=None):
+        shutil.rmtree(train_dir, ignore_errors=True)
+        mesh = make_mesh(4)
+        model = get_model("lenet", 10)
+        opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+        it = BatchIterator(
+            synthetic_dataset(SPECS["mnist"], True, size=128), 16, seed=0
+        )
+        chaos = (
+            ChaosInjector(
+                ChaosConfig.from_spec(chaos_spec, spike_scale=30.0)
+            )
+            if chaos_spec
+            else None
+        )
+        logs = []
+        st = distributed_train_loop(
+            model, opt, mesh, it, codec=QsgdCodec(bits=8, bucket_size=512),
+            aggregate="ring", overlap="delayed", max_steps=12,
+            train_dir=str(train_dir), save_freq=2, log_every=1, seed=0,
+            guard=GuardConfig(), chaos=chaos,
+            diverge=DivergeConfig(
+                remedy="skip",
+                detector=DetectorConfig(
+                    window=4, zmax=4.0, patience=2, min_history=4
+                ),
+                max_rollbacks=2,
+            ),
+            log_fn=logs.append,
+        )
+        h = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(jax.device_get(st.params)):
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest(), logs
+
+    h_clean, logs_clean = run(tmp_path / "clean")
+    assert not any(l.startswith("Doctor:") for l in logs_clean)
+    h_spike, logs_spike = run(tmp_path / "spike", chaos_spec="spike@6:3")
+    assert any("rolling back" in l for l in logs_spike), logs_spike
+    assert h_spike == h_clean  # carry restored: same program family, same bits
+
+
+@pytest.mark.slow
+def test_host_faults_disarmed_on_rollback_replay(tmp_path):
+    """kill@12 in the same plan as the spike: the alarm fires before step
+    12, the rollback replays PAST step 12 — the loop's own (host-side)
+    injector must have advanced its generation with the step program, or
+    the replayed kill re-fires and the 'recovered' run dies."""
+    doctor_env = {
+        "ATOMO_FT_DIVERGE": "skip",
+        "ATOMO_FT_STEPS": "14",
+        "ATOMO_CHAOS_SPIKE_SCALE": "30.0",
+    }
+    p, losses, final = _run_ft(
+        tmp_path / "d", chaos="spike@7:3,kill@12", extra_env=doctor_env
+    )
+    assert p.returncode == 0, (p.returncode, p.stderr[-3000:])
+    assert final is not None
+    assert any("rolling back" in line for line in p.stdout.splitlines())
+    assert max(losses) == 14  # the replay ran through step 12 alive

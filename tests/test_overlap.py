@@ -284,6 +284,39 @@ def test_delayed_guard_poisons_the_consuming_step():
     assert _eq(jax.device_get(d.train.params), jax.device_get(st.params))
 
 
+def test_delayed_sample_skipped_gates_the_detector_on_all_bad_forward():
+    """metrics['skipped'] follows the CONSUMED step-(t-1) payload, so a
+    step whose every forward gradient the guard rejected reports
+    skipped=0 while _healthy_mean collapses its loss to 0.0 — an invalid
+    sample the detector would fold as clean. 'sample_skipped' is the
+    produce-aligned gate RecoveryRig.observe prefers."""
+    mesh, model, opt, host0, batches = _setup()
+    key = jax.random.PRNGKey(1)
+    step = make_distributed_train_step(
+        model, opt, mesh, QSGD, aggregate="gather", overlap="delayed",
+        guard=GuardConfig(),
+        chaos=ChaosInjector(ChaosConfig.from_spec("nan@2*")),
+        track_grad_norm=True,
+    )
+    d = init_delayed_state(mesh, _fresh_train(mesh, host0), QSGD)
+    ms = []
+    for im, lb in batches[:3]:
+        si, sl = shard_batch(mesh, im, lb)
+        d, m = step(d, key, si, sl)
+        ms.append(jax.device_get(m))
+    # step 1: clean forward, consumes the empty step-0 carry
+    assert float(ms[0]["sample_skipped"]) == 0.0
+    assert float(ms[0]["skipped"]) == 1.0
+    # step 2: every forward rejected (sample gated) — but the consumed
+    # step-1 payload is healthy, so the update applies and skipped=0
+    assert float(ms[1]["sample_skipped"]) == 1.0
+    assert float(ms[1]["skipped"]) == 0.0
+    # step 3: consumes the all-bad step-2 payload (skipped); its own
+    # forward is healthy again
+    assert float(ms[2]["sample_skipped"]) == 0.0
+    assert float(ms[2]["skipped"]) == 1.0
+
+
 # ------------------------------------------------------- validations
 
 
