@@ -80,6 +80,36 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
     t = parser.add_argument_group("tpu-native flags")
     t.add_argument("--n-devices", type=int, default=0,
                    help="devices in the dp mesh; 0 = all visible, 1 = single-host")
+    t.add_argument("--auto", type=str, default="off",
+                   choices=["off", "tune"],
+                   help="tune = performance autopilot: predict a ranked "
+                        "candidate list of knob vectors (aggregate / "
+                        "overlap / superstep / ring bucket) from the comm "
+                        "model, run a short measured probe ladder over the "
+                        "top candidates at startup (amortized by "
+                        "ATOMO_COMPILE_CACHE), pick the winner, write every "
+                        "candidate's predicted-vs-measured ms/step to "
+                        "train_dir/tune_decision.json, and train with the "
+                        "chosen config — bit-identical to launching it "
+                        "statically. Arms the online re-tuner: sustained "
+                        "step-time drift re-probes gather-vs-ring at the "
+                        "next checkpoint boundary (the bit-identical-"
+                        "operator pair) and logs the decision to "
+                        "incidents.jsonl. Conflicts with explicitly pinned "
+                        "knobs (--aggregate/--overlap/--superstep) — pin "
+                        "or tune, not both; an explicit --ring-bucket-size "
+                        "is honored (bit-identical layout knob: the ring "
+                        "candidates probe that value instead of exploring "
+                        "the default and single-bucket packings)")
+    t.add_argument("--tune-steps", type=int, default=3, metavar="N",
+                   help="autopilot: steps per timed probe dispatch loop")
+    t.add_argument("--tune-reps", type=int, default=2, metavar="N",
+                   help="autopilot: best-of-N probe repeats (shared-host "
+                        "contention estimator, the bench discipline)")
+    t.add_argument("--tune-top", type=int, default=4, metavar="N",
+                   help="autopilot: how many top-ranked candidates get a "
+                        "measured probe (the rest are recorded "
+                        "predicted-only in the decision artifact)")
     t.add_argument("--aggregate", type=str, default="auto",
                    choices=["auto", "gather", "ring", "psum", "hierarchical"],
                    help="gradient exchange mode: gather = factor all_gather "
@@ -369,19 +399,11 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
 
 def _codec_byte_budget(codec, model_init_fn) -> tuple[int, int]:
     """(dense_bytes, payload_bytes) for one gradient exchange, computed at
-    zero cost with jax.eval_shape (static shapes make the payload size a
-    trace-time constant — codecs/base.payload_nbytes)."""
-    import jax
+    zero cost with jax.eval_shape — now one implementation shared with
+    the autopilot (tuning.probe.byte_budget)."""
+    from atomo_tpu.tuning.probe import byte_budget
 
-    from atomo_tpu.codecs import encode_tree, tree_nbytes
-
-    def shapes():
-        params = model_init_fn()
-        payload, _ = encode_tree(codec, jax.random.PRNGKey(0), params)
-        return params, payload
-
-    grads_s, payload_s = jax.eval_shape(shapes)
-    return tree_nbytes(grads_s), tree_nbytes(payload_s)
+    return byte_budget(codec, model_init_fn)
 
 
 def _resolve_auto_aggregate(
@@ -392,27 +414,16 @@ def _resolve_auto_aggregate(
     the measured comm-cost model and always say why in one line."""
     import jax
 
-    from atomo_tpu.utils.comm_model import FABRICS, choose_aggregate
+    from atomo_tpu.utils.comm_model import choose_aggregate, resolve_fabric
 
     n_proc = jax.process_count()
     cross_host = (
         n_proc > 1 or getattr(args, "dcn_ways", 0) > 1
     ) and allow_hierarchical
-    fabric = args.fabric
-    if fabric == "auto":
-        bw = FABRICS["dcn" if n_proc > 1 else "ici"]
-    elif fabric in FABRICS:
-        bw = FABRICS[fabric]
-    else:
-        try:
-            bw = float(fabric) * 1e9
-        except ValueError:
-            bw = -1.0
-        if not (0 < bw < float("inf")):  # also rejects nan/inf strings
-            raise SystemExit(
-                f"--fabric {fabric!r}: expected auto | "
-                f"{' | '.join(sorted(FABRICS))} | <positive finite GB/s>"
-            )
+    try:
+        bw = resolve_fabric(args.fabric, n_proc=n_proc)
+    except ValueError as exc:
+        raise SystemExit(str(exc)) from None
     dense_b = payload_b = 0
     if codec is not None:
         dense_b, payload_b = _codec_byte_budget(codec, model_init_fn)
@@ -458,6 +469,37 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             f"--superstep {args.superstep}: must be >= 1 (or 0 for the "
             "per-backend auto default)"
         )
+    if getattr(args, "auto", "off") == "tune":
+        # pin or tune, not both: a knob whose value differs from its
+        # auto/default sentinel was pinned by the user, and silently
+        # overriding an explicit choice is worse than refusing. (Values,
+        # not argv, define "pinned": re-passing a default is a no-op.)
+        pinned = []
+        if args.aggregate != "auto":
+            pinned.append(f"--aggregate {args.aggregate}")
+        if args.overlap != "off":
+            pinned.append(f"--overlap {args.overlap}")
+        if args.superstep != 0:
+            pinned.append(f"--superstep {args.superstep}")
+        if pinned:
+            raise SystemExit(
+                "--auto tune picks the performance knobs itself and "
+                f"conflicts with the pinned {', '.join(pinned)}; drop the "
+                "pinned flag(s) to let the autopilot choose, or drop "
+                "--auto tune to keep your explicit config"
+            )
+        if args.phase_metrics:
+            raise SystemExit(
+                "--auto tune cannot compose with --phase-metrics (the "
+                "phased observability mode forces superstep 1 + gather — "
+                "there is nothing left to tune); drop one"
+            )
+        if not args.train_dir:
+            raise SystemExit(
+                "--auto tune needs a --train-dir: the decision artifact "
+                "(tune_decision.json) and the online re-tuner's incident "
+                "log live there"
+            )
     if args.overlap == "delayed":
         if args.code.lower() in DENSE_CODES:
             raise SystemExit(
@@ -550,6 +592,163 @@ def _argv_preflight(args: argparse.Namespace) -> None:
         )
         if reason:
             raise SystemExit(reason)
+
+
+def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
+                   save_freq):
+    """``--auto tune``: run the startup probe ladder, apply the winning
+    knob vector onto ``args`` (aggregate / overlap / ring bucket) and
+    return ``(superstep, tuner)`` — the chosen fused-block size plus the
+    armed :class:`~atomo_tpu.tuning.autopilot.OnlineRetuner` (or None
+    when there is no checkpoint cadence to snap a re-probe to). The
+    decision artifact lands in ``train_dir/tune_decision.json``; the
+    subsequent training trajectory is bit-identical to launching the
+    chosen config statically (probes never touch the data iterator or
+    the run's init seed)."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.tuning.autopilot import (
+        OnlineRetuner,
+        decision_path,
+        tune,
+    )
+    from atomo_tpu.tuning.probe import (
+        model_init_fn,
+        probe_batch_size,
+        probe_candidate,
+    )
+
+    if jax.process_count() > 1:
+        raise SystemExit(
+            "--auto tune is single-host for now (the candidate space has "
+            "no hierarchical/DCN probes); pick knobs explicitly on "
+            "multi-host meshes"
+        )
+    sample_shape = tuple(train_iter.images.shape[1:])
+    sample = jnp.zeros((1,) + sample_shape, jnp.float32)
+    num_classes = _num_classes(args.dataset)
+    _init_params = model_init_fn(model, sample)
+    zero1 = args.zero1 and n_dev > 1
+    k_agg = 0
+    if (
+        args.num_aggregate is not None
+        and n_dev > 1
+        and 0 < args.num_aggregate < n_dev
+    ):
+        k_agg = args.num_aggregate
+    doc = None
+    if args.resume:
+        # a resumed run (including a supervised restart's appended
+        # --resume) must NOT re-probe: probe timings vary run to run, and
+        # a different winner would try to resume checkpoints written by a
+        # different program family (e.g. delayed payload vs blocking).
+        # The decision artifact IS the stable choice — reuse it.
+        import json as _json
+
+        path = decision_path(args.train_dir)
+        try:
+            with open(path) as f:
+                prior = _json.load(f)
+        except (OSError, ValueError):
+            prior = None
+        if prior and prior.get("complete") and (
+            (prior.get("winner") or {}).get("knobs")
+        ):
+            doc = prior
+            print(
+                f"Autopilot: resuming with the recorded decision from "
+                f"{path} (no re-probe; delete the file to re-tune)",
+                flush=True,
+            )
+    # delayed is excluded from the candidate space whenever a later stage
+    # could not accept it: densify's dense fallback has no delayed form,
+    # and a zero1 run cannot resume the in-flight payload (PR-5 matrix)
+    allow_overlap = (
+        codec is not None and n_dev > 1
+        and args.on_diverge != "densify" and not zero1
+    )
+    compute_dtype = jnp.bfloat16 if args.bf16 else None
+    try:
+        doc = doc if doc is not None else tune(
+            model=model, optimizer=optimizer, codec=codec,
+            model_init_fn=_init_params, n_dev=n_dev,
+            sample_shape=sample_shape, num_classes=num_classes,
+            batch=args.batch_size, fabric=args.fabric, seed=args.seed,
+            artifact_path=decision_path(args.train_dir),
+            allow_psum=args.num_aggregate is None,
+            allow_overlap=allow_overlap,
+            superstep_options=(1, 8),
+            # an explicit --ring-bucket-size pins the ring candidates'
+            # packing (any value is bit-identical — layout only); the
+            # default explores the two packings that differ in dispatch
+            # granularity (default buckets vs one unpadded bucket/dtype)
+            bucket_options=(
+                (args.ring_bucket_size,)
+                if args.ring_bucket_size != 65536 else (65536, 0)
+            ),
+            probe_top=args.tune_top, probe_steps=args.tune_steps,
+            probe_reps=args.tune_reps,
+            num_aggregate=k_agg, zero1=zero1, grad_accum=args.grad_accum,
+            compute_dtype=compute_dtype,
+            codec_tax_s=(
+                None if args.codec_tax_ms is None
+                else args.codec_tax_ms / 1e3
+            ),
+            context={
+                "network": args.network, "dataset": args.dataset,
+                "code": args.code, "seed": args.seed,
+            },
+        )
+    except ValueError as exc:  # unresolvable --fabric
+        raise SystemExit(str(exc)) from None
+    win = doc.get("winner") or {}
+    knobs = win.get("knobs") or {}
+    if not knobs:
+        raise SystemExit(
+            "--auto tune produced no viable candidate (see "
+            f"{decision_path(args.train_dir)})"
+        )
+    if n_dev > 1:
+        args.aggregate = knobs.get("aggregate", "gather")
+    args.overlap = knobs.get("overlap", "off")
+    args.ring_bucket_size = int(
+        knobs.get("ring_bucket_size", args.ring_bucket_size)
+    )
+    superstep = max(int(knobs.get("superstep", 1)), 1)
+    print(f"--auto tune -> {win.get('name')} ({doc.get('why')})", flush=True)
+
+    # online re-tune (rung 0.5): needs a checkpoint cadence to snap the
+    # re-probe to. The re-pickable knob is the gather<->ring pair (the
+    # bit-identical aggregation operators); every other deployment stays
+    # observe-only — drift is still detected and logged.
+    if not (save_freq and args.train_dir):
+        return superstep, None
+    probe_fn = None
+    if (
+        n_dev > 1 and codec is not None
+        and args.aggregate in ("gather", "ring")
+    ):
+        base = dict(knobs)
+
+        def probe_fn(mode, _base=base):
+            from atomo_tpu.utils.comm_model import candidate_name
+
+            cand = {**_base, "aggregate": mode}
+            cand["name"] = candidate_name(cand)
+            row = probe_candidate(
+                cand, model=model, optimizer=optimizer, codec=codec,
+                n_dev=n_dev, sample_shape=sample_shape,
+                num_classes=num_classes,
+                batch=probe_batch_size(args.batch_size, n_dev),
+                seed=args.seed, steps=args.tune_steps, reps=1,
+                num_aggregate=k_agg, zero1=zero1,
+                grad_accum=args.grad_accum, compute_dtype=compute_dtype,
+                ring_bucket_size=args.ring_bucket_size,
+            )
+            return row["measured_ms_per_step"]
+
+    return superstep, OnlineRetuner(probe_fn=probe_fn)
 
 
 def cmd_train(args: argparse.Namespace) -> int:
@@ -665,6 +864,10 @@ def cmd_train(args: argparse.Namespace) -> int:
         )
         superstep = 1
     n_dev = args.n_devices or len(jax.devices())
+    tuner = None
+    if args.auto == "tune":
+        superstep, tuner = _run_autopilot(args, model, optimizer, codec,
+                                          train_iter, n_dev, save_freq)
     diverge = None
     if args.on_diverge != "off":
         from atomo_tpu.training.resilience import (
@@ -719,14 +922,9 @@ def cmd_train(args: argparse.Namespace) -> int:
             sample = jnp.zeros(
                 (1,) + tuple(train_iter.images.shape[1:]), jnp.float32
             )
+            from atomo_tpu.tuning.probe import model_init_fn
 
-            def _init_params():
-                return model.init(
-                    {"params": jax.random.PRNGKey(0),
-                     "dropout": jax.random.PRNGKey(0)},
-                    sample, train=False,
-                )["params"]
-
+            _init_params = model_init_fn(model, sample)
             args.aggregate = _resolve_auto_aggregate(
                 args, codec, _init_params, n_dev,
                 allow_hierarchical=args.overlap != "delayed",
@@ -801,6 +999,7 @@ def cmd_train(args: argparse.Namespace) -> int:
                 ring_bucket_size=args.ring_bucket_size,
                 overlap=args.overlap,
                 diverge=diverge,
+                tuner=tuner,
             )
         except DivergenceError as exc:
             return _diverged_exit(exc)
@@ -833,7 +1032,7 @@ def cmd_train(args: argparse.Namespace) -> int:
                 compute_dtype=jnp.bfloat16 if args.bf16 else None,
                 guard=guard, chaos=chaos, health_timeout=args.health_timeout,
                 keep_ckpts=args.keep_ckpts, superstep=superstep,
-                diverge=diverge,
+                diverge=diverge, tuner=tuner,
             )
         except DivergenceError as exc:
             return _diverged_exit(exc)
@@ -956,16 +1155,10 @@ def cmd_lm(args: argparse.Namespace) -> int:
         # axis; byte budget from the unsharded LM (tp/ep/pp shard both
         # sides of the ratio equally — decision-equivalent heuristic)
         from atomo_tpu.models.transformer import TransformerLM as _LM
+        from atomo_tpu.tuning.probe import model_init_fn
 
         sample = jax.numpy.zeros((1, args.seq_len), jax.numpy.int32)
-
-        def _init_params():
-            return _LM(**cfg).init(
-                {"params": jax.random.PRNGKey(0),
-                 "dropout": jax.random.PRNGKey(0)},
-                sample, train=False,
-            )["params"]
-
+        _init_params = model_init_fn(_LM(**cfg), sample)
         aggregate = _resolve_auto_aggregate(
             args, codec, _init_params, dp, allow_hierarchical=False,
             allow_ring=False,  # the lm layouts ship gather/psum only
@@ -1247,13 +1440,26 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
 
 
 def cmd_tune(args: argparse.Namespace) -> int:
+    import os
+
     from atomo_tpu.tuning import grid_search
 
-    results = grid_search(args)
+    # JSON artifact beside the regex-parsed log contract (the printed
+    # lines below stay the machine-readable surface they always were):
+    # default train_dir/lr_grid.json, --artifact overrides, '' disables
+    artifact = args.artifact
+    if artifact is None:
+        artifact = (
+            os.path.join(args.train_dir, "lr_grid.json")
+            if args.train_dir else ""
+        )
+    results = grid_search(args, artifact_path=artifact or None)
     best = min(results, key=lambda r: r.mean_loss)
     for r in results:
         print(f"lr {r.lr:g}: mean loss {r.mean_loss:.4f} over final {r.window} steps")
     print(f"best lr: {best.lr:g} (mean loss {best.mean_loss:.4f})")
+    if artifact:
+        print(f"lr grid artifact -> {artifact}")
     return 0
 
 
@@ -1370,6 +1576,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="steps per LR (tune.sh max_tuning_step)")
     p_tune.add_argument("--window", type=int, default=10,
                         help="final steps averaged for the score")
+    p_tune.add_argument("--artifact", type=str, default=None,
+                        help="JSON artifact path for the grid results "
+                             "(atomic tmp+rename, partial rows survive a "
+                             "kill); default train_dir/lr_grid.json, '' "
+                             "disables")
     p_tune.set_defaults(fn=cmd_tune)
 
     return parser

@@ -1453,6 +1453,7 @@ def distributed_train_loop(
     ring_bucket_size: int = 65536,
     overlap: str = "off",
     diverge=None,
+    tuner=None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -1502,7 +1503,18 @@ def distributed_train_loop(
     in-flight encoded payload too (delayed checkpoints carry it), so the
     rolled-back trajectory is the same program family's uninterrupted
     one. Not supported with ``--zero1`` (the sharded optimizer template
-    cannot be rebuilt mid-run) or ``--phase-metrics``."""
+    cannot be rebuilt mid-run) or ``--phase-metrics``.
+
+    ``tuner`` (tuning.autopilot.OnlineRetuner) arms the performance
+    ladder's rung 0.5: the loop feeds it the per-step wall-time series
+    (per step in the per-step loop, one block-mean observation per fused
+    block), and a sustained-drift alarm re-probes the config at the next
+    checkpoint boundary. When the re-probe says switch, the aggregation
+    mode flips within the bit-identical gather<->ring operator pair and
+    the step program is rebuilt (at the doctor's current chaos
+    generation, when armed); the decision — switch or keep — lands in
+    ``incidents.jsonl``. Not supported with ``--phase-metrics`` (no
+    fused step to re-pick)."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import (
         SUPERVISED_ENV,
@@ -1538,6 +1550,11 @@ def distributed_train_loop(
                 "sharded optimizer template cannot carry the overlap "
                 "payload); drop --resume or --zero1"
             )
+    if tuner is not None and phase_metrics:
+        raise ValueError(
+            "the online re-tuner rebuilds the fused step; --phase-metrics "
+            "has no fused step to re-pick — drop one"
+        )
     if diverge is not None:
         reason = diverge_conflict(
             diverge.remedy,
@@ -1757,6 +1774,11 @@ def distributed_train_loop(
         )
         build_step = None
     else:
+        # the online re-tuner may flip gather<->ring mid-run (the
+        # bit-identical operator pair); every step (re)build — including
+        # the doctor's rollback rebuilds — reads the CURRENT mode from
+        # this cell so a later rollback cannot silently revert a re-tune
+        agg_cell = {"mode": aggregate}
 
         def build_step(generation=0, remedy_cfg=None, densify=False):
             chaos_now = (
@@ -1767,7 +1789,7 @@ def distributed_train_loop(
             return make_distributed_train_step(
                 model, optimizer, mesh,
                 None if densify else codec,
-                aggregate=aggregate, augment=augment,
+                aggregate=agg_cell["mode"], augment=augment,
                 num_aggregate=num_aggregate, compute_dtype=compute_dtype,
                 zero1_specs=zero1_specs, grad_accum=grad_accum,
                 inner_axis=inner_axis, guard=guard, chaos=chaos_now,
@@ -1798,9 +1820,12 @@ def distributed_train_loop(
     rig = None
     incidents = None
     if train_dir and (
-        diverge is not None or os.environ.get(SUPERVISED_ENV) == "1"
+        diverge is not None or tuner is not None
+        or os.environ.get(SUPERVISED_ENV) == "1"
     ):
         incidents = IncidentLog.for_train_dir(train_dir)
+    if tuner is not None:
+        tuner.bind(incidents=incidents, log_fn=log_fn)
     if diverge is not None:
 
         def _reload(target):
@@ -1836,6 +1861,29 @@ def distributed_train_loop(
             lambda target: train_iter.restream(rng_snapshot, skip=target),
             build_step,
         )
+    retune = None
+    if tuner is not None:
+
+        def retune(step):
+            """Checkpoint-boundary re-probe: returns a rebuilt step_fn
+            when the tuner switched the aggregation mode, else None. The
+            rebuild happens at the doctor's CURRENT chaos generation so a
+            re-tune cannot re-arm faults a rollback disarmed. While a
+            rollback remedy is still shaping the program (rewarm ramp
+            unsaturated, densify window open) the re-probe DEFERS — the
+            pending alarm stays armed for the next boundary — because a
+            default rebuild here would drop the remedy mid-treatment,
+            and densify-window step times are not the config's anyway."""
+            if rig is not None and rig.remedy_active(step):
+                return None
+            new_mode = tuner.maybe_retune(step, agg_cell["mode"])
+            if new_mode is None:
+                return None
+            agg_cell["mode"] = new_mode
+            return build_step(
+                rig.doctor.generation if rig is not None else 0
+            )
+
     # superstep mode beats the watchdog once per BLOCK: scale the budget
     # by K so a per-step-tuned --health-timeout does not falsely fire
     with heartbeat_watchdog(
@@ -1849,7 +1897,7 @@ def distributed_train_loop(
                 log_every, log_fn, eval_freq, save_freq, train_dir,
                 compress_ckpt, monitor, profile_dir, batch_axes,
                 guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
-                rig=rig, incidents=incidents,
+                rig=rig, incidents=incidents, tuner=tuner, retune=retune,
             )
         else:
             state = _distributed_steps(
@@ -1858,7 +1906,7 @@ def distributed_train_loop(
                 eval_freq, save_freq, train_dir, compress_ckpt, monitor, lr_fn,
                 profile_dir, profile_steps, batch_axes,
                 guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
-                rig=rig, incidents=incidents,
+                rig=rig, incidents=incidents, tuner=tuner, retune=retune,
             )
     return state
 
@@ -1920,13 +1968,17 @@ def _distributed_steps(
     save_freq, train_dir, compress_ckpt, monitor, lr_fn=None,
     profile_dir=None, profile_steps=3, batch_axes="dp",
     guard=None, chaos=None, keep_ckpts=0, rig=None, incidents=None,
+    tuner=None, retune=None,
 ):
+    import time as _time
+
     from atomo_tpu.training.resilience import retrying_saver
     from atomo_tpu.utils.metrics import StepMetrics, master_line
     from atomo_tpu.utils.tracing import profile
 
     save_fn = retrying_saver(log_fn, incidents)
     last_saved = start_step
+    t_obs = _time.perf_counter()  # the tuner's step-time series anchor
     # trace steady-state steps only: step 1 is dominated by compilation
     prof_first = start_step + 2 if profile_dir else None
     prof_ctx = None
@@ -1968,10 +2020,22 @@ def _distributed_steps(
                     alarm_step, reason, chaos
                 )
                 last_saved = min(last_saved, step)
+                # recovery wall (reload/replay/recompile) is not step
+                # time: restamp or it pollutes the next drift observation
+                t_obs = _time.perf_counter()
                 continue
             new_fn = rig.maybe_end_densify(step)
             if new_fn is not None:
                 step_fn = new_fn
+        if tuner is not None:
+            # the step is async-dispatched: fence on the loss scalar before
+            # stamping, or the series would time enqueue, not execution
+            # (one fetch per step — the doctor's surveillance price, paid
+            # here only when the tuner is armed; rig already fetched)
+            float(metrics["loss"])
+            now = _time.perf_counter()
+            tuner.observe(now - t_obs)
+            t_obs = now
         # guard diagnostics share the log cadence: a per-step device->host
         # fetch would serialize async dispatch even on all-healthy steps
         if (
@@ -2028,6 +2092,18 @@ def _distributed_steps(
                 rig.note_save(step)
             if chaos is not None:
                 chaos.maybe_corrupt_checkpoint(path, step)
+            if retune is not None:
+                # the drift alarm's pending re-probe snaps to checkpoint
+                # boundaries (a re-tune between saves would make "resume
+                # from here" and "the program that ran here" disagree)
+                new_fn = retune(step)
+                if new_fn is not None:
+                    step_fn = new_fn
+        if tuner is not None:
+            # restamp after the boundary work (eval/save/re-probe): those
+            # spans are cadence costs, not step time — folding them in
+            # would teach the drift baseline the checkpoint cadence
+            t_obs = _time.perf_counter()
     # autosave the final state so a restart never replays the tail
     # (strictly `<`: a resume past max_steps runs no steps and must not
     # write a file whose name disagrees with the state's step field)
@@ -2093,7 +2169,7 @@ def _distributed_superstep_steps(
     timer, n_train, start_step, max_steps, superstep, log_every, log_fn,
     eval_freq, save_freq, train_dir, compress_ckpt, monitor,
     profile_dir=None, batch_axes="dp", guard=None, chaos=None, keep_ckpts=0,
-    rig=None, incidents=None,
+    rig=None, incidents=None, tuner=None, retune=None,
 ):
     """distributed_train_loop's fused block path: one SPMD dispatch per K
     steps, one metric fetch per block, next block's shard_superbatch
@@ -2113,6 +2189,8 @@ def _distributed_superstep_steps(
     )
     from atomo_tpu.utils.tracing import profile
 
+    import time as _time
+
     save_fn = retrying_saver(log_fn, incidents)
     put_fn = lambda im, lb: shard_superbatch(  # noqa: E731
         mesh, im, lb, axis=batch_axes
@@ -2123,6 +2201,7 @@ def _distributed_superstep_steps(
     last_logged = start_step
     block_idx = 0
     prof_ctx = None
+    t_obs = _time.perf_counter()  # the tuner's step-time series anchor
     feed.start(min(superstep, max_steps - s))
     while s < max_steps:
         kb, dev_im, dev_lb = feed.take()
@@ -2158,10 +2237,22 @@ def _distributed_superstep_steps(
                 # drop the staged lookahead block: discarded timeline
                 feed = SuperstepFeed(BlockStream(stream), put_fn)
                 feed.start(min(superstep, max_steps - s))
+                # recovery wall is not step time: restamp or the next
+                # block's K shares alone could fire a bogus drift alarm
+                t_obs = _time.perf_counter()
                 continue
             new_fn = rig.maybe_end_densify(s)
             if new_fn is not None:
                 step_fn = new_fn
+        if tuner is not None:
+            # the block's wall as kb equal per-step shares (device_get
+            # above already fenced the dispatch): feeding ONE mean per
+            # block would make min_history/patience count BLOCKS and the
+            # detector K-times less sensitive than the per-step loop —
+            # the partition consistency the fold contract promises
+            now = _time.perf_counter()
+            kb_n = max(kb, 1)
+            tuner.observe([(now - t_obs) / kb_n] * kb_n)
         if guard is not None and _crossed(log_every, b0, s):
             n_drop = float(np.sum(m.get("dropped", 0.0)))
             if n_drop > 0:
@@ -2193,6 +2284,14 @@ def _distributed_superstep_steps(
             # ckpt faults snap like kill/sleep: a fault aimed anywhere in
             # this block corrupts the boundary file
             _chaos_corrupt_range(chaos, path, b0, s)
+            if retune is not None:
+                new_fn = retune(s)
+                if new_fn is not None:
+                    step_fn = new_fn
+        if tuner is not None:
+            # restamp after boundary work (eval/save/re-probe): cadence
+            # costs must not enter the drift baseline
+            t_obs = _time.perf_counter()
     # autosave the final state (same strictly-< contract as the K=1 loop)
     if save_freq and train_dir and last_saved < max_steps:
         path = save_fn(

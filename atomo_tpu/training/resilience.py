@@ -283,6 +283,128 @@ def _as_seq(x):
     return np.asarray(x).reshape(-1)
 
 
+# ---------------------------------------------------------------------------
+# Step-time drift detection (escalation rung 0.5: performance, not health)
+# ---------------------------------------------------------------------------
+#
+# The loss detector above watches the TRAJECTORY; this one watches the
+# THROUGHPUT series beside it — per-step wall seconds. Sustained step-time
+# drift (a contended host, a degraded link, a changed load profile) does
+# not poison the math, so the response is the gentlest rung on the ladder:
+# re-probe the performance config at the next checkpoint boundary
+# (tuning.autopilot.OnlineRetuner) instead of rolling anything back. Same
+# design rules as DetectorConfig: a pure sequential fold, an EMA baseline
+# FROZEN while the signal is hot (absorbing a drifting series into its own
+# baseline would chase the drift and never alarm), and a patience count so
+# one slow step (a GC pause, an eval) is noise, not an incident.
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftConfig:
+    """Step-time drift knobs.
+
+    window: EMA span (observations) for the step-time baseline.
+    ratio: alarm threshold — an observation counts as drifting when it
+        exceeds ``ratio`` x the frozen baseline.
+    patience: consecutive drifting observations before the alarm fires.
+    min_history: warmup observations before the alarm arms (the first
+        steps after a (re)compile are not a baseline).
+    """
+
+    window: int = 32
+    ratio: float = 1.5
+    patience: int = 8
+    min_history: int = 8
+
+    def __post_init__(self):
+        if self.window < 2:
+            raise ValueError(
+                f"drift window must be >= 2, got {self.window}"
+            )
+        if not self.ratio > 1.0:
+            raise ValueError(
+                f"drift ratio must be > 1, got {self.ratio} (a ratio <= 1 "
+                "would alarm on the baseline itself)"
+            )
+        if self.patience < 1:
+            raise ValueError(
+                f"drift patience must be >= 1, got {self.patience}"
+            )
+        if self.min_history < 0:
+            raise ValueError(
+                f"drift min_history must be >= 0, got {self.min_history}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class DriftState:
+    """The drift detector's carry — folded once per observation."""
+
+    n: int = 0
+    mean: float = 0.0  # step-time EMA baseline (frozen while hot)
+    hot: int = 0  # consecutive observations above ratio * mean
+
+
+# downward EMA coefficient: the baseline tracks the step-time FLOOR, so
+# speedups are adopted fast (a compile-inflated first observation decays
+# within ~10 normal steps instead of ~window*ln(inflation) of them —
+# during that decay a genuine slowdown could not clear ratio*mean and
+# real drift would be silently absorbed) while slowdowns stay on the
+# slow window EMA + hot-counting path that defines drift
+_DRIFT_DOWN_ALPHA = 0.5
+
+
+def drift_update(
+    cfg: DriftConfig, st: DriftState, dt: float
+) -> tuple[DriftState, Optional[str]]:
+    """Fold one per-step wall time into the carry; returns
+    ``(new_state, "step_time_drift" | None)``. Non-finite or non-positive
+    observations are ignored (the count still advances — a gap is not a
+    baseline sample). The baseline is asymmetric by design: observations
+    BELOW it adapt at :data:`_DRIFT_DOWN_ALPHA` (the floor follows
+    speedups and sheds compile-inflated seeds quickly), observations
+    above it move the slow window EMA or, past ``ratio`` x, freeze it
+    and count toward the alarm. A pure fold: feeding the same series one
+    value at a time or in blocks of any partition produces identical
+    states and identical alarm decisions (the superstep block loops rely
+    on this)."""
+    dt = float(dt)
+    alpha = 2.0 / (cfg.window + 1.0)
+    armed = st.n >= cfg.min_history
+    mean, hot = st.mean, st.hot
+    alarm = None
+    if math.isfinite(dt) and dt > 0:
+        if mean <= 0.0:
+            mean, hot = dt, 0
+        elif armed and dt > cfg.ratio * mean:
+            hot += 1  # baseline frozen while hot (see module note)
+        else:
+            hot = 0
+            mean += (
+                alpha if dt >= mean else _DRIFT_DOWN_ALPHA
+            ) * (dt - mean)
+        if hot >= cfg.patience:
+            alarm = "step_time_drift"
+            hot = 0  # one alarm per sustained excursion; the retuner
+            # resets the whole state after acting on it
+    return DriftState(n=st.n + 1, mean=mean, hot=hot), alarm
+
+
+def drift_scan(
+    cfg: DriftConfig, st: DriftState, dts
+) -> tuple[DriftState, Optional[str]]:
+    """Fold a block of per-step wall times (the superstep loops observe
+    once per block: the block wall divided into K equal per-step shares).
+    Unlike detector_scan there is nothing to roll back, so the fold always
+    consumes the whole block; the FIRST alarm in it is returned."""
+    alarm = None
+    for dt in _as_seq(dts):
+        st, a = drift_update(cfg, st, dt)
+        if a is not None and alarm is None:
+            alarm = a
+    return st, alarm
+
+
 class DivergenceError(RuntimeError):
     """The in-process rollback budget is exhausted: the run keeps
     diverging after ``max_rollbacks`` rollback+remedy attempts. Callers
@@ -656,6 +778,7 @@ class RecoveryRig:
         self._restream = restream
         self._build = build_step
         self.densify_until: Optional[int] = None
+        self.remedy_until: Optional[int] = None  # rewarm ramp end step
 
     def observe(self, first_step, metrics):
         """Feed a fetched metrics dict (per-step scalars or (K,) block
@@ -695,6 +818,9 @@ class RecoveryRig:
         self.densify_until = (
             plan.target + plan.window if densify else None
         )
+        self.remedy_until = (
+            plan.target + plan.window if plan.remedy == "rewarm" else None
+        )
         state = self._reload(plan.target)
         stream = self._restream(plan.target)
         step_fn = self._build(plan.generation, remedy_cfg, densify)
@@ -726,6 +852,18 @@ class RecoveryRig:
             self.densify_until = None
             return self._build(self.doctor.generation, None, False)
         return None
+
+    def remedy_active(self, step) -> bool:
+        """True while a rollback remedy still shapes the step program:
+        the densify window is open, or the rewarm ramp has not yet
+        saturated (past ``target + window`` the ramp computes exactly
+        1.0, so a program rebuilt WITHOUT it is arithmetically
+        identical). The online re-tuner defers its aggregate-switch
+        rebuild past this window — a default ``build_step()`` rebuild
+        mid-treatment would silently drop the doctor's remedy."""
+        if self.densify_until is not None and step < self.densify_until:
+            return True
+        return self.remedy_until is not None and step < self.remedy_until
 
 
 def grad_ok(grads, max_grad_norm: float = 0.0):

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from functools import partial
 from typing import Any, Optional
 
@@ -325,6 +326,7 @@ def train_loop(
     keep_ckpts: int = 0,
     superstep: int = 1,
     diverge=None,
+    tuner=None,
 ) -> TrainState:
     """The reference train_and_validate loop (nn_ops.py:123-169), jitted,
     plus working checkpoint/resume (gap §5.4) and the fault-tolerance
@@ -364,7 +366,16 @@ def train_loop(
     the configured remedy — with the chaos generation bumped so
     step-targeted faults do not re-fire on the replay. Budget exhaustion
     raises resilience.DivergenceError (the CLI maps it to
-    ROLLBACK_EXIT_CODE for the run-level supervisor)."""
+    ROLLBACK_EXIT_CODE for the run-level supervisor).
+
+    ``tuner`` (tuning.autopilot.OnlineRetuner) feeds the per-step
+    wall-time series to the step-time drift detector (resilience
+    rung 0.5). A single device has no exchange to re-pick, so the
+    single-host loop runs the tuner observe-only: sustained drift is
+    recorded to ``incidents.jsonl`` at the next checkpoint boundary, the
+    config is kept. Costs one scalar fetch per step in the per-step loop
+    (the doctor's surveillance price); the superstep loop amortizes it
+    into the block's one fetch."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import (
         SUPERVISED_ENV,
@@ -398,9 +409,12 @@ def train_loop(
     rig = None
     incidents = None
     if train_dir and (
-        diverge is not None or os.environ.get(SUPERVISED_ENV) == "1"
+        diverge is not None or tuner is not None
+        or os.environ.get(SUPERVISED_ENV) == "1"
     ):
         incidents = IncidentLog.for_train_dir(train_dir)
+    if tuner is not None:
+        tuner.bind(incidents=incidents, log_fn=log_fn)
     if diverge is not None:
         reason = diverge_conflict(
             diverge.remedy,
@@ -471,10 +485,11 @@ def train_loop(
                 timer, n_train, start_step, max_steps, superstep, log_every,
                 log_fn, eval_freq, save_freq, train_dir, compress_ckpt,
                 save_fn, monitor, guard=guard, chaos=chaos,
-                keep_ckpts=keep_ckpts, rig=rig,
+                keep_ckpts=keep_ckpts, rig=rig, tuner=tuner,
             )
     with heartbeat_watchdog(health_timeout, on_health_failure) as monitor:
         step = start_step
+        t_obs = time.perf_counter()  # the tuner's step-time series anchor
         while step < max_steps:
             step += 1
             if chaos is not None:
@@ -496,10 +511,20 @@ def train_loop(
                         alarm_step, reason, chaos
                     )
                     last_saved = min(last_saved, step)
+                    # recovery wall is not step time: restamp the tuner's
+                    # anchor or it pollutes the next drift observation
+                    t_obs = time.perf_counter()
                     continue
                 new_fn = rig.maybe_end_densify(step)
                 if new_fn is not None:
                     step_fn = new_fn
+            if tuner is not None:
+                # fence before stamping (async dispatch would time the
+                # enqueue); one fetch per step, only while armed
+                float(metrics["loss"])
+                now = time.perf_counter()
+                tuner.observe(now - t_obs)
+                t_obs = now
             # guard diagnostics share the log cadence: fetching the skip
             # flag every step would block host dispatch on every step's
             # result even when nothing is ever dropped
@@ -543,6 +568,14 @@ def train_loop(
                     rig.note_save(step)
                 if chaos is not None:
                     chaos.maybe_corrupt_checkpoint(path, step)
+                if tuner is not None:
+                    # observe-only on one device: records the drift
+                    # incident at the boundary, keeps the config
+                    tuner.maybe_retune(step, "local")
+            if tuner is not None:
+                # restamp after boundary work (eval/save): cadence costs
+                # must not enter the drift baseline
+                t_obs = time.perf_counter()
         # autosave the final state so a restart never replays the tail
         # (strictly `<`: a resume past max_steps runs no steps and must not
         # write a file whose name disagrees with the state's step field)
@@ -602,7 +635,7 @@ def _superstep_steps(
     state, step_fn, model, stream, train_iter, test_iter, key, timer,
     n_train, start_step, max_steps, superstep, log_every, log_fn,
     eval_freq, save_freq, train_dir, compress_ckpt, save_fn, monitor,
-    guard=None, chaos=None, keep_ckpts=0, rig=None,
+    guard=None, chaos=None, keep_ckpts=0, rig=None, tuner=None,
 ):
     """train_loop's fused block path: one dispatch per K steps, one metric
     fetch per block (the fetch is also the fence the watchdog beats on),
@@ -623,6 +656,7 @@ def _superstep_steps(
     s = start_step
     last_saved = start_step
     last_logged = start_step
+    t_obs = time.perf_counter()  # the tuner's step-time series anchor
     feed.start(min(superstep, max_steps - s))
     while s < max_steps:
         kb, dev_im, dev_lb = feed.take()
@@ -654,10 +688,19 @@ def _superstep_steps(
                 # the discarded timeline
                 feed = SuperstepFeed(BlockStream(stream), put_fn)
                 feed.start(min(superstep, max_steps - s))
+                # recovery wall is not step time: restamp the tuner anchor
+                t_obs = time.perf_counter()
                 continue
             new_fn = rig.maybe_end_densify(s)
             if new_fn is not None:
                 step_fn = new_fn
+        if tuner is not None:
+            # the block's wall as kb equal per-step shares (the
+            # device_get above already fenced the dispatch): one mean
+            # per block would make the detector K-times less sensitive
+            # than the per-step loop — partition consistency
+            kb_n = max(kb, 1)
+            tuner.observe([(time.perf_counter() - t_obs) / kb_n] * kb_n)
         n_skipped = float(np.sum(m["skipped"])) if guard is not None else 0.0
         if guard is not None and _crossed(log_every, b0, s) and n_skipped > 0:
             log_fn(
@@ -688,6 +731,10 @@ def _superstep_steps(
             # ckpt faults snap like kill/sleep: a fault aimed anywhere in
             # this block corrupts the boundary file
             _chaos_corrupt_range(chaos, path, b0, s)
+            if tuner is not None:
+                tuner.maybe_retune(s, "local")  # observe-only on 1 device
+        if tuner is not None:
+            t_obs = time.perf_counter()  # boundary work is not step time
     # autosave the final state so a restart never replays the tail (same
     # strictly-< contract as the per-step loop)
     if save_freq and train_dir and last_saved < max_steps:

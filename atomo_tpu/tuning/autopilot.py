@@ -1,0 +1,482 @@
+"""Performance autopilot — ``--auto tune``: probe-driven config selection.
+
+The framework exposes ~6 orthogonal performance knobs (codec+rank,
+``--aggregate``, ``--superstep K``, ``--overlap``, ``--zero1``, ring
+bucket size) and an honest comm model — but a user gets static defaults,
+and the PR-4 measured result (the delayed-overlap win is load-dependent
+skew absorption) proves the best config is not static. This module closes
+the loop, SparCML/Parallax-style (pick the representation/collective per
+density and fabric, per model — PAPERS.md):
+
+  1. PREDICT: ``comm_model.enumerate_candidates`` +
+     ``rank_candidates`` turn (model byte sizes, N, fabric) into a ranked
+     candidate list of knob vectors. Predictions use stated anchors; they
+     only decide which candidates are WORTH measuring.
+  2. PROBE: the top of the ladder is measured for real
+     (tuning.probe.probe_candidate — the same step builders the train
+     path uses, fenced timing, rows written atomically as they land).
+     Compile cost is amortized by ``ATOMO_COMPILE_CACHE``: the winner's
+     program is already warm in the cache when training starts.
+  3. DECIDE: :func:`choose_winner` — a PURE function of the probe rows,
+     so the same artifact always names the same winner (tested). The
+     decision, every candidate's predicted-vs-measured ms/step, and the
+     reason the winner won land in ``tune_decision.json``.
+  4. HONESTY: each probe is checked against its prediction
+     (``comm_model.calibration_warning``); a >2x disagreement is logged
+     with both numbers instead of silently trusted.
+  5. RE-TUNE (rung 0.5 of the resilience ladder): the train loops feed a
+     per-step wall-time series to :class:`OnlineRetuner`; sustained
+     step-time drift (resilience.drift_update — frozen-baseline EMA with
+     patience) arms a re-probe that runs at the next checkpoint boundary
+     and logs its decision to ``incidents.jsonl``. The online knob space
+     is deliberately the gather<->ring pair: the two aggregation
+     OPERATORS are bit-identical (the PR-3 contract), so a mid-run switch
+     stays within the documented cross-program fusion-drift class instead
+     of changing the estimator.
+
+Trajectory contract: probes never touch the training data iterator or
+the run's init seed (tuning.probe docstring), so the tuned run's
+trajectory is bit-identical to launching the chosen config statically —
+asserted by a subprocess drill in tests/test_autopilot.py.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Callable, Optional, Sequence
+
+TUNE_DECISION_NAME = "tune_decision.json"
+
+
+def _num(row, key) -> float:
+    v = row.get(key)
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return math.inf
+    return v if math.isfinite(v) and v > 0 else math.inf
+
+
+def _valid_measure(row) -> float:
+    """A row's measured ms/step, or +inf when the measurement is not
+    trustworthy (not probed, fence scalar came back non-finite, or the
+    number itself is garbage). The ONE validity rule choose_winner and
+    _why share — a sync-invalid number must never decide or be quoted
+    as 'measured'."""
+    if not row.get("probed") or not row.get("sync_ok", True):
+        return math.inf
+    return _num(row, "measured_ms_per_step")
+
+
+def choose_winner(rows: Sequence[dict]) -> Optional[dict]:
+    """The decision: min measured ms/step over the validly-probed rows
+    (``probed`` true, ``sync_ok`` not false, finite measurement); ties
+    break by predicted ms/step then candidate name. When no row was
+    validly probed the prediction decides ALONE (ties by name) —
+    sync-invalid measurements are classified untrustworthy and must not
+    sneak back in through the fallback. A PURE deterministic function of
+    the rows — same probe artifact, same winner, regardless of row
+    order. None only for an empty list."""
+    measured = [r for r in rows if _valid_measure(r) < math.inf]
+    if measured:
+        return min(
+            measured,
+            key=lambda r: (
+                _valid_measure(r),
+                _num(r, "predicted_ms_per_step"),
+                str(r.get("name", "")),
+            ),
+        )
+    if not rows:
+        return None
+    return min(
+        rows,
+        key=lambda r: (
+            _num(r, "predicted_ms_per_step"),
+            str(r.get("name", "")),
+        ),
+    )
+
+
+def winner_knobs(row: dict) -> dict:
+    """The knob vector a decision row pins (the fields the CLI applies and
+    the static-equivalent command must pass)."""
+    return {
+        k: row[k]
+        for k in ("aggregate", "overlap", "superstep", "ring_bucket_size")
+        if k in row
+    }
+
+
+def _why(rows: list[dict], winner: dict) -> str:
+    ranked = sorted(
+        rows,
+        key=lambda r: (
+            _valid_measure(r) == math.inf,
+            _valid_measure(r),
+            _num(r, "predicted_ms_per_step"),
+            str(r.get("name", "")),
+        ),
+    )
+    runner = next(
+        (r for r in ranked if r["name"] != winner["name"]), None
+    )
+    bits = [f"{winner['name']} wins"]
+    if _valid_measure(winner) < math.inf:
+        bits.append(
+            f"measured {winner['measured_ms_per_step']} ms/step "
+            f"(predicted {winner.get('predicted_ms_per_step')})"
+        )
+    else:
+        bits.append(
+            f"by prediction alone ({winner.get('predicted_ms_per_step')} "
+            "ms/step; no valid probe measurements)"
+        )
+    if runner is not None:
+        r_valid = _valid_measure(runner) < math.inf
+        bits.append(
+            f"runner-up {runner['name']} at "
+            f"{runner['measured_ms_per_step'] if r_valid else runner.get('predicted_ms_per_step')}"
+            f" ms/step{' (measured)' if r_valid else ' (predicted)'}"
+        )
+    pred_first = min(
+        rows,
+        key=lambda r: (
+            r.get("predicted_ms_per_step") or math.inf,
+            str(r.get("name", "")),
+        ),
+    )
+    bits.append(
+        "predicted order held"
+        if pred_first["name"] == winner["name"]
+        else f"predicted order did NOT hold (model ranked "
+        f"{pred_first['name']} first) — see calibration fields"
+    )
+    return "; ".join(bits)
+
+
+def tune(
+    *,
+    model,
+    optimizer,
+    codec,
+    model_init_fn: Callable,
+    n_dev: int,
+    sample_shape,
+    num_classes: int,
+    batch: int,
+    fabric: str = "auto",
+    seed: int = 0,
+    artifact_path: Optional[str] = None,
+    allow_ring: bool = True,
+    allow_psum: bool = True,
+    allow_overlap: bool = True,
+    superstep_options=(1, 8),
+    bucket_options=(65536,),
+    probe_top: int = 4,
+    probe_steps: int = 3,
+    probe_reps: int = 2,
+    num_aggregate: int = 0,
+    zero1: bool = False,
+    grad_accum: int = 1,
+    compute_dtype=None,
+    codec_tax_s: Optional[float] = None,
+    context: Optional[dict] = None,
+    log_fn=print,
+) -> dict:
+    """Run the startup autopilot; returns the finished decision document
+    (also written atomically to ``artifact_path`` when given). Raises
+    ValueError on an unresolvable ``fabric`` — the caller owns the exit.
+    """
+    import jax
+
+    from atomo_tpu.tuning.probe import (
+        ProbeLadder,
+        byte_budget,
+        probe_batch_size,
+        probe_candidate,
+    )
+    from atomo_tpu.utils.comm_model import (
+        DISPATCH_ANCHOR_S,
+        calibration_warning,
+        enumerate_candidates,
+        rank_candidates,
+        resolve_fabric,
+    )
+
+    t_start = time.perf_counter()
+    bw = resolve_fabric(fabric, n_proc=jax.process_count())
+    dense_b, payload_b = byte_budget(codec, model_init_fn)
+    backend = jax.default_backend()
+    dispatch_s = DISPATCH_ANCHOR_S.get(backend, 5e-4)
+    cands = enumerate_candidates(
+        has_codec=codec is not None,
+        ways=n_dev,
+        allow_ring=allow_ring,
+        allow_psum=allow_psum,
+        allow_overlap=allow_overlap,
+        superstep_options=superstep_options,
+        bucket_options=bucket_options,
+    )
+    ranked = rank_candidates(
+        cands,
+        dense_bytes=dense_b,
+        payload_bytes=payload_b,
+        ways=n_dev,
+        fabric_bw=bw,
+        tax_s=codec_tax_s,
+        dispatch_s=dispatch_s,
+    )
+    pb = probe_batch_size(batch, n_dev)
+    meta = {
+        "backend": backend,
+        "n_devices": n_dev,
+        "fabric": fabric,
+        "fabric_gbps_per_chip": round(bw / 1e9, 3),
+        "dense_mb": round(dense_b / 1e6, 3),
+        "payload_mb": round(payload_b / 1e6, 3),
+        "batch": pb,
+        "probe": {
+            "steps": probe_steps,
+            "reps": probe_reps,
+            "top": probe_top,
+        },
+        **(context or {}),
+    }
+    ladder = ProbeLadder(
+        artifact_path, kind="tune_decision", meta=meta, log_fn=log_fn
+    )
+    n_probe = max(1, min(int(probe_top), len(ranked)))
+    for i, cand in enumerate(ranked):
+        if i >= n_probe:
+            ladder.record({**cand, "probed": False})
+            continue
+        knobs = {
+            k: v
+            for k, v in cand.items()
+            if k in ("aggregate", "overlap", "superstep",
+                     "ring_bucket_size", "name")
+        }
+        try:
+            row = probe_candidate(
+                knobs,
+                model=model,
+                optimizer=optimizer,
+                codec=codec,
+                n_dev=n_dev,
+                sample_shape=sample_shape,
+                num_classes=num_classes,
+                batch=pb,
+                seed=seed,
+                steps=probe_steps,
+                reps=probe_reps,
+                num_aggregate=num_aggregate,
+                zero1=zero1,
+                grad_accum=grad_accum,
+                compute_dtype=compute_dtype,
+            )
+        except Exception as exc:  # noqa: BLE001 — one candidate failing
+            # to compile/execute (OOM, a backend quirk) must not abort the
+            # whole tune: record the failure, keep climbing the ladder
+            # (the default config and eventual winner may be fine)
+            row = {
+                **cand,
+                "probed": False,
+                "probe_error": f"{type(exc).__name__}: {str(exc)[:200]}",
+            }
+            ladder.record(row)
+            log_fn(
+                f"Autopilot probe [{i + 1}/{n_probe}] {cand['name']} "
+                f"FAILED ({row['probe_error']}); candidate dropped from "
+                "the measured pool"
+            )
+            continue
+        row["predicted_ms_per_step"] = cand["predicted_ms_per_step"]
+        warn = calibration_warning(
+            cand["predicted_ms_per_step"] / 1e3,
+            row["measured_ms_per_step"] / 1e3,
+            label=cand["name"],
+        )
+        row["calibration"] = warn
+        if warn:
+            log_fn(f"Autopilot: {warn}")
+        ladder.record(row)
+        log_fn(
+            f"Autopilot probe [{i + 1}/{n_probe}] {cand['name']}: "
+            f"measured {row['measured_ms_per_step']} ms/step "
+            f"(predicted {cand['predicted_ms_per_step']})"
+        )
+    winner = choose_winner(ladder.rows)
+    why = _why(ladder.rows, winner) if winner is not None else "no candidates"
+    doc = ladder.finish(
+        winner=None if winner is None else {
+            "name": winner["name"],
+            "knobs": winner_knobs(winner),
+            "measured_ms_per_step": winner.get("measured_ms_per_step"),
+            "predicted_ms_per_step": winner.get("predicted_ms_per_step"),
+        },
+        why=why,
+        tune_wall_s=round(time.perf_counter() - t_start, 3),
+    )
+    log_fn(f"Autopilot decision: {why}")
+    if artifact_path:
+        log_fn(f"Autopilot: decision artifact -> {artifact_path}")
+    return doc
+
+
+def decision_path(train_dir: str) -> str:
+    return os.path.join(train_dir, TUNE_DECISION_NAME)
+
+
+class OnlineRetuner:
+    """Rung 0.5 of the resilience ladder: step-time drift -> re-probe.
+
+    The train loops feed per-step wall seconds to :meth:`observe` (the
+    same sequential-fold contract as the divergence detector: one value
+    at a time or a block's worth — identical decisions for any
+    partition). A sustained excursion past the
+    :class:`~atomo_tpu.training.resilience.DriftConfig` threshold arms a
+    PENDING re-probe; the loop executes it at the next checkpoint
+    boundary via :meth:`maybe_retune`, which measures the candidate
+    modes with ``probe_fn`` and logs the decision — switch or keep — to
+    ``incidents.jsonl``.
+
+    The online knob space is the gather<->ring aggregation pair ONLY:
+    their operators are bit-identical (PR-3 contract), so a switch keeps
+    the estimator and stays within the documented cross-program
+    fusion-drift class (~1e-8, the scan-vs-standalone family) — the
+    incident record says when one happened. Heavier knobs (codec,
+    overlap, superstep) are startup-tune territory: changing them mid-run
+    would change the program family the run's determinism contracts are
+    stated over. ``probe_fn=None`` is the observe-only mode (the
+    single-host loop): drift is still detected and logged as an incident,
+    but nothing is switched — a single device has no exchange to re-pick.
+    """
+
+    def __init__(
+        self,
+        probe_fn: Optional[Callable[[str], float]] = None,
+        modes: Sequence[str] = ("gather", "ring"),
+        drift=None,
+        margin: float = 1.05,
+        incidents=None,
+        log_fn=print,
+    ):
+        from atomo_tpu.training.resilience import DriftConfig, DriftState
+
+        self.probe_fn = probe_fn
+        self.modes = tuple(modes)
+        self.cfg = drift if drift is not None else DriftConfig()
+        self.state = DriftState()
+        self.margin = float(margin)
+        self.incidents = incidents
+        self.log_fn = log_fn
+        self.pending: Optional[str] = None
+        self.retunes = 0
+        self.switches = 0
+
+    def bind(self, incidents=None, log_fn=None) -> "OnlineRetuner":
+        """Late-bind the loop-owned incident log / logger (the CLI builds
+        the retuner before the loop builds its IncidentLog)."""
+        if incidents is not None:
+            self.incidents = incidents
+        if log_fn is not None:
+            self.log_fn = log_fn
+        return self
+
+    def observe(self, dts) -> Optional[str]:
+        """Fold per-step wall seconds (scalar or a block's series); arms
+        the pending re-probe on a drift alarm. Returns the alarm reason
+        when one fired (already-pending blocks re-arming noise)."""
+        from atomo_tpu.training.resilience import drift_scan
+
+        self.state, alarm = drift_scan(self.cfg, self.state, dts)
+        if alarm is not None and self.pending is None:
+            self.pending = alarm
+            self.log_fn(
+                f"Autopilot: sustained step-time drift detected "
+                f"(baseline {self.state.mean * 1e3:.1f} ms/step); "
+                "re-probe scheduled for the next checkpoint boundary"
+            )
+            return alarm
+        return None
+
+    def maybe_retune(self, step: int, current_mode: str) -> Optional[str]:
+        """Execute the pending re-probe (call at a checkpoint boundary).
+        Returns the new aggregation mode when the probe says switch, else
+        None. Every outcome is one incident record; the drift baseline
+        restarts either way (the world just changed — relearn it)."""
+        from atomo_tpu.training.resilience import DriftState
+
+        if self.pending is None:
+            return None
+        reason, self.pending = self.pending, None
+        self.retunes += 1
+        self.state = DriftState()
+        if self.probe_fn is None or current_mode not in self.modes:
+            # observe-only (single-host, or a mode outside the safe online
+            # pair, e.g. psum/hierarchical): record the drift, keep config
+            if self.incidents is not None:
+                self.incidents.append(
+                    "perf_drift",
+                    action="observed",
+                    step=step,
+                    reason=reason,
+                    mode=current_mode,
+                )
+            self.log_fn(
+                f"Autopilot: step-time drift at step {step} recorded; "
+                f"no online knob to re-pick for mode {current_mode!r}"
+            )
+            return None
+        measured = {}
+        for m in self.modes:
+            try:
+                measured[m] = float(self.probe_fn(m))
+            except Exception as exc:  # a failed probe must not kill training
+                self.log_fn(f"Autopilot: re-probe of {m!r} failed: {exc}")
+        finite = {
+            m: v for m, v in measured.items()
+            if math.isfinite(v) and v > 0
+        }
+        new_mode = None
+        if finite:
+            best = min(finite, key=lambda m: (finite[m], m))
+            cur = finite.get(current_mode)
+            if (
+                best != current_mode
+                and cur is not None
+                and finite[best] * self.margin < cur
+            ):
+                new_mode = best
+        action = f"retune->{new_mode}" if new_mode else "retune_keep"
+        if self.incidents is not None:
+            self.incidents.append(
+                "perf_drift",
+                action=action,
+                step=step,
+                reason=reason,
+                mode=current_mode,
+                measured_ms={
+                    m: round(v, 4) for m, v in measured.items()
+                },
+            )
+        if new_mode:
+            self.switches += 1
+            self.log_fn(
+                f"Autopilot: re-tune at step {step}: aggregate "
+                f"{current_mode} -> {new_mode} "
+                f"({finite[new_mode]:.2f} vs {finite[current_mode]:.2f} "
+                "ms/step; operators bit-identical, program family change "
+                "logged)"
+            )
+        else:
+            self.log_fn(
+                f"Autopilot: re-tune at step {step} keeps aggregate "
+                f"{current_mode} (measured "
+                + ", ".join(f"{m}={v:.2f}" for m, v in measured.items())
+                + " ms/step)"
+            )
+        return new_mode

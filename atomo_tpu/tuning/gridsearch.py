@@ -42,17 +42,44 @@ class TuneResult:
 DEFAULT_GRID = [2.0**-k for k in range(7, 0, -1)]  # tune.sh:7
 
 
-def grid_search(args) -> list[TuneResult]:
+def grid_search(args, artifact_path=None, log_fn=print) -> list[TuneResult]:
     """Run a short training per LR candidate; score by mean loss over the
     final ``window`` logged steps (the reference scores the single final
     step across 16 workers; a trailing window is the single-process
-    equivalent with the same sample count)."""
+    equivalent with the same sample count).
+
+    Rides the autopilot's shared probe ladder (tuning.probe.ProbeLadder):
+    with ``artifact_path``, each LR's result is ALSO written to a JSON
+    artifact atomically as it completes — a killed grid leaves parseable
+    partial evidence — alongside the regex-parsed log contract the
+    reference established (tiny_tuning_parser.py), which stays unchanged.
+    A JSON-null ``mean_loss`` row is a diverged candidate (every logged
+    loss was non-finite; it scores +inf in-process and can never win)."""
+    import math
+    import time
+
     from atomo_tpu.cli import _build_common
+    from atomo_tpu.tuning.probe import ProbeLadder
 
     grid = (
         [float(x) for x in args.grid.split(",") if x]
         if getattr(args, "grid", "")
         else DEFAULT_GRID
+    )
+    ladder = ProbeLadder(
+        artifact_path,
+        kind="lr_grid",
+        meta={
+            "network": args.network,
+            "dataset": args.dataset,
+            "batch_size": args.batch_size,
+            "code": args.code,
+            "tuning_steps": args.tuning_steps,
+            "window": args.window,
+            "seed": args.seed,
+            "grid": grid,
+        },
+        log_fn=log_fn,
     )
     results = []
     for lr in grid:
@@ -61,6 +88,7 @@ def grid_search(args) -> list[TuneResult]:
         from atomo_tpu.training import train_loop
 
         buf = io.StringIO()
+        t0 = time.perf_counter()
         train_loop(
             model,
             optimizer,
@@ -74,6 +102,7 @@ def grid_search(args) -> list[TuneResult]:
             log_fn=lambda line: buf.write(line + "\n"),
             log_every=1,
         )
+        wall = time.perf_counter() - t0
         losses = parse_worker_lines(buf.getvalue())
         window = min(args.window, len(losses))
         if window == 0:
@@ -83,6 +112,26 @@ def grid_search(args) -> list[TuneResult]:
         else:
             mean = sum(losses[-window:]) / window
         results.append(TuneResult(lr=lr, mean_loss=mean, window=window))
+        ladder.record(
+            {
+                "lr": lr,
+                # JSON has no Infinity token — null + the window=0 marker
+                # carries the diverged-candidate fact portably
+                "mean_loss": mean if math.isfinite(mean) else None,
+                "window": window,
+                "steps": args.tuning_steps,
+                "wall_s": round(wall, 3),
+            }
+        )
+    best = min(results, key=lambda r: r.mean_loss) if results else None
+    ladder.finish(
+        best=None if best is None else {
+            "lr": best.lr,
+            "mean_loss": (
+                best.mean_loss if math.isfinite(best.mean_loss) else None
+            ),
+        }
+    )
     return results
 
 

@@ -1,0 +1,279 @@
+"""Shared measured-probe runner — the autopilot's measurement half.
+
+One timing discipline for every short measured probe in the tuning
+package (and bench.py's scenario matrix): warm the compiled program, then
+time a dispatch loop ended by a device->host scalar fetch
+(utils.tracing.fence_tree — ``block_until_ready`` does not wait on
+tunneled backends, the bench ladder's founding finding), best-of-N
+against shared-host contention. Every completed row is ALSO written to a
+JSON artifact atomically as it lands (:class:`ProbeLadder`), so a killed
+or timed-out tune leaves parseable partial evidence — the same
+tmp+rename contract the bench ladder's partial artifact carries.
+
+Probes are TRAJECTORY-NEUTRAL by construction: they run on synthetic
+batches drawn from their own PRNG keys and on states initialized from
+their own seeds, never touching the training data iterator's shuffle RNG
+or the run's model-init seed — which is what lets ``--auto tune`` hand
+the chosen config to the normal train path bit-identically to launching
+that config statically (the PR-7 acceptance contract).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Optional
+
+from atomo_tpu.utils.tracing import write_json_atomic
+
+
+class ProbeLadder:
+    """Rows-as-they-complete artifact recorder (atomic partial JSON).
+
+    ``artifact_path=None`` disables writing (rows still accumulate for
+    the caller). The document shape mirrors bench.py's partial artifact:
+    ``{"kind": ..., "meta": {...}, "rows": [...], "complete": bool}``.
+    Write failures warn and never crash the run being tuned — evidence is
+    best-effort, training is not.
+    """
+
+    def __init__(
+        self, artifact_path: Optional[str] = None, kind: str = "probe",
+        meta: Optional[dict] = None, log_fn=print,
+    ):
+        self.artifact_path = artifact_path
+        self.doc = {
+            "kind": kind,
+            "meta": dict(meta or {}),
+            "rows": [],
+            "complete": False,
+        }
+        self.log_fn = log_fn
+
+    @property
+    def rows(self) -> list[dict]:
+        return self.doc["rows"]
+
+    def _write(self) -> None:
+        if not self.artifact_path:
+            return
+        try:
+            write_json_atomic(self.artifact_path, self.doc)
+        except OSError as exc:
+            self.log_fn(f"probe artifact write failed: {exc}")
+
+    def record(self, row: dict) -> dict:
+        self.doc["rows"].append(row)
+        self._write()
+        return row
+
+    def finish(self, **extra) -> dict:
+        self.doc.update(extra)
+        self.doc["complete"] = True
+        self._write()
+        return self.doc
+
+
+def model_init_fn(model, sample):
+    """The deterministic param-init closure every byte-budget consumer
+    shares (the CLI's ``--aggregate auto`` resolution, the autopilot, the
+    bench scenario matrix, the README table generator): fixed PRNGKey(0)
+    for params/dropout over a zeros ``sample``, params extracted. ONE
+    definition so the byte budgets those surfaces compute can never
+    silently diverge. Meant for jax.eval_shape — never materializes."""
+    import jax
+
+    def init():
+        return model.init(
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(0)},
+            sample, train=False,
+        )["params"]
+
+    return init
+
+
+def byte_budget(codec, init_fn) -> tuple[int, int]:
+    """(dense_bytes, payload_bytes) of one gradient exchange, at zero cost
+    via jax.eval_shape (static shapes make the payload size a trace-time
+    constant). ``codec=None`` (dense training) reports payload 0. The one
+    implementation behind the CLI's ``--aggregate auto`` resolution and
+    the autopilot's prediction context; build ``init_fn`` with
+    :func:`model_init_fn`."""
+    import jax
+
+    from atomo_tpu.codecs import encode_tree, tree_nbytes
+
+    if codec is None:
+        params_s = jax.eval_shape(init_fn)
+        return tree_nbytes(params_s), 0
+
+    def shapes():
+        params = init_fn()
+        payload, _ = encode_tree(codec, jax.random.PRNGKey(0), params)
+        return params, payload
+
+    grads_s, payload_s = jax.eval_shape(shapes)
+    return tree_nbytes(grads_s), tree_nbytes(payload_s)
+
+
+def fenced_seconds_per_call(
+    call, *, reps: int, warmup: int = 2, best_of: int = 1
+) -> tuple[float, bool]:
+    """Best-of-``best_of`` mean seconds per ``call()`` over ``reps``-call
+    dispatch loops, each fenced by a scalar fetch of the last call's
+    output. Returns ``(seconds, sync_ok)`` — ``sync_ok`` False when the
+    fence scalar came back non-finite (the measurement is then invalid,
+    reported, never silently trusted)."""
+    from atomo_tpu.utils.tracing import fence_tree
+
+    out = None
+    for _ in range(max(warmup, 1)):
+        out = call()
+    sync = fence_tree(out)  # drain warmup + compile
+    best = float("inf")
+    for _ in range(max(best_of, 1)):
+        t0 = time.perf_counter()
+        for _ in range(max(reps, 1)):
+            out = call()
+        sync = fence_tree(out)
+        best = min(best, (time.perf_counter() - t0) / max(reps, 1))
+    return best, bool(math.isfinite(sync))
+
+
+def synthetic_batch(key, batch: int, sample_shape, num_classes: int):
+    """A probe batch from the probe's OWN key — never the training
+    stream (trajectory neutrality, module docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    ki, kl = jax.random.split(key)
+    images = jax.random.uniform(
+        ki, (batch,) + tuple(sample_shape), jnp.float32
+    )
+    labels = jax.random.randint(kl, (batch,), 0, num_classes)
+    return images, labels
+
+
+def probe_candidate(
+    cand: dict,
+    *,
+    model,
+    optimizer,
+    codec,
+    n_dev: int,
+    sample_shape,
+    num_classes: int,
+    batch: int,
+    seed: int = 0,
+    steps: int = 3,
+    reps: int = 2,
+    warmup: int = 2,
+    num_aggregate: int = 0,
+    zero1: bool = False,
+    grad_accum: int = 1,
+    compute_dtype=None,
+    ring_bucket_size: int = 65536,
+) -> dict:
+    """Measure one candidate knob vector: build the REAL step program the
+    train path would run (same builders, same knobs — zero1 / grad_accum
+    / compute_dtype / num_aggregate ride along because they change the
+    program's speed; guard/chaos/remedy stay off, they are correctness
+    machinery, not a performance knob) and time it with the fence
+    discipline. Returns the probe row (measured ms/step per OPTIMIZER
+    step — a superstep-K program's one dispatch covers K of them)."""
+    import jax
+    import jax.numpy as jnp
+
+    k = max(int(cand.get("superstep", 1)), 1)
+    key = jax.random.PRNGKey(seed + 7)
+    images, labels = synthetic_batch(
+        jax.random.PRNGKey(seed + 11), batch, sample_shape, num_classes
+    )
+
+    if n_dev <= 1:
+        from atomo_tpu.training import create_state, make_train_step
+
+        state = create_state(
+            model, optimizer, jax.random.PRNGKey(seed), images
+        )
+        step = make_train_step(
+            model, optimizer, codec=codec, compute_dtype=compute_dtype,
+            superstep=k,
+        )
+        if k > 1:
+            im = jnp.broadcast_to(images, (k,) + images.shape)
+            lb = jnp.broadcast_to(labels, (k,) + labels.shape)
+        else:
+            im, lb = images, labels
+        box = {"st": state}
+
+        def call():
+            box["st"], m = step(box["st"], key, im, lb)
+            return m["loss"]
+
+    else:
+        from atomo_tpu.parallel import (
+            init_delayed_state,
+            make_distributed_train_step,
+            make_mesh,
+            replicate_state,
+            shard_batch,
+        )
+        from atomo_tpu.parallel.replicated import shard_superbatch
+        from atomo_tpu.training import create_state
+
+        mesh = make_mesh(n_dev)
+        state = create_state(
+            model, optimizer, jax.random.PRNGKey(seed), images
+        )
+        agg = cand.get("aggregate", "gather")
+        overlap = cand.get("overlap", "off")
+        zero1_specs = None
+        if zero1:
+            from atomo_tpu.parallel.replicated import zero1_state
+
+            state, zero1_specs = zero1_state(mesh, state, optimizer)
+        else:
+            state = replicate_state(mesh, state)
+        step = make_distributed_train_step(
+            model, optimizer, mesh, codec, aggregate=agg,
+            num_aggregate=num_aggregate if agg in ("gather", "ring") else 0,
+            compute_dtype=compute_dtype, zero1_specs=zero1_specs,
+            grad_accum=grad_accum, superstep=k, overlap=overlap,
+            ring_bucket_size=cand.get("ring_bucket_size", ring_bucket_size),
+        )
+        if overlap == "delayed":
+            state = init_delayed_state(mesh, state, codec)
+        if k > 1:
+            im_k = jnp.broadcast_to(images, (k,) + images.shape)
+            lb_k = jnp.broadcast_to(labels, (k,) + labels.shape)
+            im, lb = shard_superbatch(mesh, im_k, lb_k)
+        else:
+            im, lb = shard_batch(mesh, images, labels)
+        box = {"st": state}
+
+        def call():
+            box["st"], m = step(box["st"], key, im, lb)
+            return m["loss"]
+
+    t0 = time.perf_counter()
+    per_call, sync_ok = fenced_seconds_per_call(
+        call, reps=steps, warmup=warmup, best_of=max(reps, 1)
+    )
+    row = {
+        **{kk: v for kk, v in cand.items()},
+        "measured_ms_per_step": round(per_call / k * 1e3, 4),
+        "probe_wall_s": round(time.perf_counter() - t0, 3),
+        "sync_ok": sync_ok,
+        "probed": True,
+    }
+    return row
+
+
+def probe_batch_size(batch: int, n_dev: int) -> int:
+    """The probe's batch: the run's batch rounded down to a mesh multiple
+    (floored at one sample per device) so shard_batch always accepts it."""
+    if n_dev <= 1:
+        return max(int(batch), 1)
+    return max((int(batch) // n_dev) * n_dev, n_dev)

@@ -42,6 +42,8 @@ Two structural facts the tables below make visible:
 
 from __future__ import annotations
 
+import math
+
 DEFAULT_WAYS = (8, 16, 32, 64)
 # (label, bytes/s): per-chip effective ring bandwidths to tabulate
 DEFAULT_BANDWIDTHS = (
@@ -298,6 +300,303 @@ def overlap_report(
             "atomo_tpu/utils/comm_model.py"
         ),
     }
+
+
+def resolve_fabric(fabric: str, *, n_proc: int = 1) -> float:
+    """Per-chip bandwidth (bytes/s) for a ``--fabric`` value: ``auto``
+    (ici single-host, dcn multi-host), a named preset, or a positive
+    finite per-chip GB/s number. ONE parser for the CLI's ``--aggregate
+    auto`` advisory and the autopilot's predictor, so the two surfaces
+    cannot disagree about what a fabric string means. Raises ValueError
+    with the usage line on anything else."""
+    if fabric == "auto":
+        return FABRICS["dcn" if n_proc > 1 else "ici"]
+    if fabric in FABRICS:
+        return FABRICS[fabric]
+    try:
+        bw = float(fabric) * 1e9
+    except (TypeError, ValueError):
+        bw = -1.0
+    if not (0 < bw < float("inf")):  # also rejects nan/inf strings
+        raise ValueError(
+            f"--fabric {fabric!r}: expected auto | "
+            f"{' | '.join(sorted(FABRICS))} | <positive finite GB/s>"
+        )
+    return bw
+
+
+# ---------------------------------------------------------------------------
+# Autopilot predictor: candidate knob vectors + analytic step-time model
+# ---------------------------------------------------------------------------
+#
+# The ~6 orthogonal performance knobs (codec+rank, --aggregate, --superstep,
+# --overlap, --zero1, ring bucket size) define a config space no static
+# default covers (the PR-4 measured result: the delayed-overlap win is
+# load-dependent skew absorption, not a constant). These helpers turn the
+# byte accounting above into a RANKED candidate list the autopilot probes:
+# the prediction orders the ladder (so the few measured probes go to the
+# plausible winners), the measurement decides, and a >2x disagreement is
+# logged as a calibration warning instead of silently trusted either way.
+#
+# Anchors (estimates, stated): compute scales the measured single-chip
+# ResNet-18 dense step (6.50 ms on a 44.7 MB gradient, v5e —
+# artifacts/BENCH_ONCHIP_r3.md) linearly with gradient bytes, like the
+# codec-tax anchor; per-dispatch host cost is ~3 ms on tunneled TPU
+# backends (measured, bench.py timing notes) and noise locally.
+
+_COMPUTE_ANCHOR_S = 6.5e-3
+_COMPUTE_ANCHOR_BYTES = 44.7e6
+DISPATCH_ANCHOR_S = {"tpu": 3e-3, "cpu": 2e-4, "gpu": 5e-4}
+# measured-vs-predicted ratio past which the model is called out as stale
+CALIBRATION_MAX_RATIO = 2.0
+
+
+def estimate_compute_s(dense_bytes: float) -> float:
+    """Crude fwd+bwd+update wall estimate from gradient size (the measured
+    ResNet-18 anchor scaled linearly — same estimator class as
+    :func:`estimate_codec_tax_s`). Only used to ORDER the probe ladder and
+    to model how much comm ``--overlap delayed`` can hide; the measured
+    probes decide, and :func:`calibration_warning` reports when this
+    anchor has drifted from reality."""
+    return _COMPUTE_ANCHOR_S * float(dense_bytes) / _COMPUTE_ANCHOR_BYTES
+
+
+def candidate_name(cand: dict) -> str:
+    """Stable display/sort key for a knob vector (also the tie-break of
+    last resort in the autopilot's winner selection — deterministic)."""
+    bits = []
+    if cand.get("aggregate"):
+        bits.append(cand["aggregate"])
+        bits.append(cand.get("overlap", "off"))
+    bits.append(f"k{cand.get('superstep', 1)}")
+    if cand.get("aggregate") == "ring":
+        bits.append(f"b{cand.get('ring_bucket_size', 65536)}")
+    return "+".join(bits)
+
+
+def enumerate_candidates(
+    *,
+    has_codec: bool,
+    ways: int,
+    allow_ring: bool = True,
+    allow_psum: bool = True,
+    allow_overlap: bool = True,
+    superstep_options=(1, 8),
+    bucket_options=(65536,),
+) -> list[dict]:
+    """The autopilot's candidate knob vectors, conflict-free by
+    construction (the same compatibility matrix ``_argv_preflight`` and
+    the loops enforce): a single device has no exchange to tune, a dense
+    code has only psum, ``delayed`` exists only for the compressed
+    gather/ring exchanges. The caller narrows further via the allow_*
+    flags (e.g. ``--num-aggregate`` excludes psum, ``--on-diverge
+    densify`` and ``--zero1`` exclude delayed)."""
+    ks = sorted({max(int(k), 1) for k in superstep_options})
+    out: list[dict] = []
+    if ways <= 1:
+        for k in ks:
+            out.append({"superstep": k})
+    elif not has_codec:
+        for k in ks:
+            out.append({"aggregate": "psum", "overlap": "off", "superstep": k})
+    else:
+        aggs = ["gather"]
+        if allow_ring:
+            aggs.append("ring")
+        if allow_psum:
+            aggs.append("psum")
+        for agg in aggs:
+            overlaps = ["off"]
+            if allow_overlap and agg in ("gather", "ring"):
+                overlaps.append("delayed")
+            buckets = (
+                sorted({int(b) for b in bucket_options})
+                if agg == "ring"
+                else [None]
+            )
+            for ov in overlaps:
+                for k in ks:
+                    for b in buckets:
+                        c = {"aggregate": agg, "overlap": ov, "superstep": k}
+                        if b is not None:
+                            c["ring_bucket_size"] = b
+                        out.append(c)
+    for c in out:
+        c["name"] = candidate_name(c)
+    return out
+
+
+def predict_step_s(
+    cand: dict,
+    *,
+    dense_bytes: float,
+    payload_bytes: float,
+    ways: int,
+    fabric_bw: float,
+    compute_s: float | None = None,
+    tax_s: float | None = None,
+    dispatch_s: float = 0.0,
+) -> float:
+    """Model one candidate's synchronous step time (seconds).
+
+    step = compute + encode + comm_chain + dispatch/K, where the comm
+    chain is the candidate's wire bytes over ``fabric_bw`` plus the
+    decode-mean, ``--overlap delayed`` replaces the chain with its
+    exposed excess over compute (overlap_exposed_comm_s — encode stays on
+    the critical path, it consumes this step's gradient), and
+    ``--superstep K`` divides the per-dispatch host cost by K. The codec
+    tax (encode + decode round trip) is split evenly across the two ends
+    — the anchor measures only their sum. All the byte formulas are the
+    honest-accounting ones above; the anchors are stated estimates the
+    probe ladder corrects."""
+    dense_bytes = float(dense_bytes)
+    if compute_s is None:
+        compute_s = estimate_compute_s(dense_bytes)
+    ways = int(ways)
+    k = max(int(cand.get("superstep", 1)), 1)
+    if ways <= 1:
+        # no exchange; the codec round trip still runs when armed (the
+        # caller models the single-device compression-study step)
+        rt = tax_s if tax_s is not None else (
+            estimate_codec_tax_s(dense_bytes) if payload_bytes else 0.0
+        )
+        return compute_s + rt + dispatch_s / k
+    agg = cand.get("aggregate", "psum")
+    has_codec = bool(payload_bytes) and payload_bytes > 0
+    if not has_codec:
+        wire = ring_allreduce_wire_bytes(dense_bytes, ways)
+        return compute_s + wire / fabric_bw + dispatch_s / k
+    if tax_s is None:
+        tax_s = estimate_codec_tax_s(dense_bytes)
+    encode_s = decode_s = tax_s / 2.0
+    if agg == "psum":
+        # codec semantics over a dense wire: the round trip runs per-chip,
+        # the exchange is the dense all-reduce
+        wire = ring_allreduce_wire_bytes(dense_bytes, ways)
+    elif agg == "ring":
+        wire = ring_stream_wire_bytes(payload_bytes, dense_bytes, ways)
+    else:
+        wire = ring_allgather_wire_bytes(payload_bytes, ways)
+    chain = wire / fabric_bw + decode_s
+    if cand.get("overlap") == "delayed" and agg in ("gather", "ring"):
+        chain = overlap_exposed_comm_s(chain, compute_s)
+    return compute_s + encode_s + chain + dispatch_s / k
+
+
+def rank_candidates(
+    cands: list[dict],
+    *,
+    dense_bytes: float,
+    payload_bytes: float,
+    ways: int,
+    fabric_bw: float,
+    compute_s: float | None = None,
+    tax_s: float | None = None,
+    dispatch_s: float = 0.0,
+) -> list[dict]:
+    """Candidates + their predicted ms/step, best first (ties broken by
+    name so the order — and therefore which candidates get probed — is
+    deterministic for a given context)."""
+    rows = []
+    for c in cands:
+        s = predict_step_s(
+            c,
+            dense_bytes=dense_bytes,
+            payload_bytes=payload_bytes,
+            ways=ways,
+            fabric_bw=fabric_bw,
+            compute_s=compute_s,
+            tax_s=tax_s,
+            dispatch_s=dispatch_s,
+        )
+        rows.append({**c, "predicted_ms_per_step": round(s * 1e3, 4)})
+    rows.sort(key=lambda r: (r["predicted_ms_per_step"], r["name"]))
+    return rows
+
+
+def recommend_for_scenario(
+    *,
+    codec_budgets: dict,
+    measured_ms: dict,
+    ways: int,
+    fabric_bw: float,
+    dense_key: str = "dense",
+    dispatch_s: float = 0.0,
+    allow_overlap: bool = True,
+) -> dict:
+    """Per-scenario recommended config: measured single-chip anchors +
+    the analytic fabric term (exactly crossover_report's construction,
+    generalized over the whole candidate space INCLUDING the codec axis
+    — the SparCML-style pick the scenario-matrix bench row and the
+    README tables publish).
+
+    ``codec_budgets``: codec name -> (dense_bytes, payload_bytes);
+    ``measured_ms``: codec name -> measured single-chip ms/step (the
+    dense entry is the compute anchor; a codec's measured excess over it
+    is its measured tax — no estimate anchors involved). Returns
+    ``{"winner": {...}, "ranked": [...]}``, one entry per codec carrying
+    its best candidate's name and predicted ms/step at ``ways`` over
+    ``fabric_bw``. Pure and deterministic (same inputs, same table)."""
+    if dense_key not in measured_ms:
+        raise ValueError(f"measured_ms needs the {dense_key!r} anchor")
+    compute_s = float(measured_ms[dense_key]) / 1e3
+    rows = []
+    for name, (db, pb) in sorted(codec_budgets.items()):
+        has_codec = name != dense_key and pb
+        tax_s = (
+            max(float(measured_ms[name]) / 1e3 - compute_s, 0.0)
+            if has_codec and name in measured_ms
+            else 0.0
+        )
+        cands = enumerate_candidates(
+            has_codec=bool(has_codec), ways=ways,
+            allow_overlap=allow_overlap,
+        )
+        top = rank_candidates(
+            cands,
+            dense_bytes=db,
+            payload_bytes=pb if has_codec else 0,
+            ways=ways,
+            fabric_bw=fabric_bw,
+            compute_s=compute_s,
+            tax_s=tax_s if has_codec else None,
+            dispatch_s=dispatch_s,
+        )[0]
+        rows.append(
+            {
+                "code": name,
+                "candidate": top["name"],
+                "predicted_ms_per_step": top["predicted_ms_per_step"],
+                "measured_1chip_ms": measured_ms.get(name),
+                "codec_tax_ms": round(tax_s * 1e3, 3),
+            }
+        )
+    rows.sort(key=lambda r: (r["predicted_ms_per_step"], r["code"]))
+    return {"winner": rows[0], "ranked": rows}
+
+
+def calibration_warning(
+    predicted_s: float, measured_s: float, label: str = ""
+) -> str | None:
+    """The model-honesty check: when a probe's measured step time and the
+    prediction disagree by more than :data:`CALIBRATION_MAX_RATIO` in
+    EITHER direction, return a one-line warning carrying both numbers
+    (the caller logs it) — the model is stale for this deployment and
+    must not be silently trusted for the next ranking. None = within
+    tolerance (or nothing to compare)."""
+    p, m = float(predicted_s), float(measured_s)
+    if not (p > 0 and m > 0) or not (math.isfinite(p) and math.isfinite(m)):
+        return None
+    ratio = max(p / m, m / p)
+    if ratio <= CALIBRATION_MAX_RATIO:
+        return None
+    return (
+        f"comm_model calibration: {label or 'candidate'} measured "
+        f"{m * 1e3:.2f} ms/step vs predicted {p * 1e3:.2f} ms/step "
+        f"({ratio:.1f}x apart, tolerance {CALIBRATION_MAX_RATIO:.0f}x) — "
+        "the analytic anchors are stale for this deployment; trust the "
+        "measured ladder (predictions only order the probes)"
+    )
 
 
 def max_beneficial_ways(dense_bytes: float, payload_bytes: float) -> float:

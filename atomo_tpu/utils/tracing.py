@@ -126,6 +126,23 @@ def profile(log_dir: str) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
+def write_json_atomic(path: str, obj) -> None:
+    """Write ``obj`` as JSON via tmp + ``os.replace`` — readers never see a
+    torn file, even under SIGKILL mid-write (atomic on POSIX). The ONE
+    artifact-writing discipline shared by the bench ladder's partial
+    artifact, the autopilot's ``tune_decision.json``, and the LR grid's
+    ``lr_grid.json``, so every evidence file survives the failures the
+    robustness stack drills. Raises OSError to the caller — artifact
+    criticality (best-effort vs must-land) is a per-call-site policy."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
+
+
 INCIDENT_LOG_NAME = "incidents.jsonl"
 
 

@@ -28,8 +28,9 @@ scalar is non-finite or a computed MFU falls outside (0, 1).
 
 By default the WHOLE ladder runs (the five BASELINE.md configs plus the LM
 config 6, the shipped-loop superstep config 7, and the forced-CPU-mesh
-semantics compares: ring-vs-gather config 8 and overlap-vs-blocking
-config 9): one JSON row per config
+semantics compares: ring-vs-gather config 8, overlap-vs-blocking
+config 9, and the autopilot scenario matrix config 10): one JSON row per
+config
 as it completes, then ONE final aggregate line — the headline config-2 row
 with a "configs" list embedding every row (VERDICT r2 next-round #4; the
 driver parses the last line). The parent enforces a global wall-clock
@@ -137,6 +138,21 @@ CONFIGS = {
     # micro-compare, not a chip-speed claim. Baseline "none".
     9: dict(metric="overlap_vs_blocking", kind="overlapcmp",
             network="lenet", batch=16, n_dev=4, ways=4, force_cpu_mesh=True),
+    # Config 10 (PR-7 autopilot tentpole): scenario_matrix — the sweep
+    # that regression-gates the autopilot's choices the way configs 8-9
+    # gated ring and overlap. {lenet, resnet18} x {1, 4 devices} x
+    # {dense, qsgd8, svd3} on the forced CPU mesh: fenced ms/step + byte
+    # reduction per cell (the shared tuning.probe runner — the same code
+    # path `--auto tune` measures with), the gather-vs-ring aggregation-
+    # operator bit-parity assert for every compressed multi-device cell
+    # (the invariant that keeps the online re-tuner's switch trajectory-
+    # safe), and per-fabric recommended configs from measured anchors +
+    # the comm model (comm_model.recommend_for_scenario — the README's
+    # recommended-config tables read from this row). Baseline "none";
+    # fast mode keeps the lenet cells only, and a per-config cell budget
+    # (ATOMO_SCENARIO_BUDGET_S) skips-and-records instead of overrunning.
+    10: dict(metric="scenario_matrix", kind="scenarios", batch=8, n_dev=4,
+             ways=4, force_cpu_mesh=True),
 }
 
 # Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
@@ -928,6 +944,249 @@ def measure_overlap_compare(cfg: dict) -> dict:
     return out
 
 
+def gather_vs_ring_parity(mesh, codec, grads, key, n_dev: int,
+                          bucket_size: int = 65536) -> bool:
+    """The PR-3 aggregation-operator contract, as one reusable check:
+    gather's CANONICAL decode-mean (``decode_mean_tree(fused=False)`` —
+    the fused SVD matmul reassociates, a documented ~1e-6 drift, not a
+    parity break) must be BIT-identical to ring's streamed fold over the
+    same per-chip payloads. tests/test_ring_aggregate.py is the full
+    oracle; this is the in-row bench evidence — config 10 calls it per
+    compressed multi-device cell (config 8's inline variant additionally
+    times each phase program, which is why it keeps its own copy of the
+    construction). The invariant is what makes the autopilot's online
+    gather<->ring re-tune trajectory-safe."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from atomo_tpu.codecs import decode_mean_tree, encode_tree
+    from atomo_tpu.parallel.replicated import _ring_stream_mean
+
+    def sm(fn, in_specs, out_specs):
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        ))
+
+    def enc(g):
+        my = jax.lax.axis_index("dp")
+        p, _ = encode_tree(codec, jax.random.fold_in(key, my), g)
+        return jax.tree_util.tree_map(lambda a: a[None], p)
+
+    payloads_x = sm(enc, (P(),), P("dp"))(grads)
+    gathered = sm(
+        lambda px: jax.lax.all_gather(
+            jax.tree_util.tree_map(lambda a: a[0], px), "dp"
+        ),
+        (P("dp"),), P(),
+    )(payloads_x)
+    mean_g = sm(
+        lambda gth: decode_mean_tree(codec, gth, grads, n_dev,
+                                     fused=False),
+        (P(),), P(),
+    )(gathered)
+
+    def ring_xdec(px):
+        my = jax.lax.axis_index("dp")
+        local = jax.tree_util.tree_map(lambda a: a[0], px)
+        mean, _ = _ring_stream_mean(
+            codec, local, grads, axis="dp", n_dev=n_dev, my=my,
+            n_contrib=n_dev, bucket_size=bucket_size,
+        )
+        return mean
+
+    mean_r = sm(ring_xdec, (P("dp"),), P())(payloads_x)
+    return bool(all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(mean_g)),
+            jax.tree_util.tree_leaves(jax.device_get(mean_r)),
+        )
+    ))
+
+
+def measure_scenarios(cfg: dict) -> dict:
+    """Config-10: the scenario matrix (autopilot regression gate).
+
+    Every cell is measured by the SAME probe runner ``--auto tune`` uses
+    (tuning.probe.probe_candidate — real step builders, fenced dispatch
+    loops), so a bench regression here is a regression in exactly the
+    numbers the autopilot decides from. The compressed 4-device cells
+    additionally assert the gather-vs-ring aggregation-operator bit
+    parity in-row; the per-network recommendations combine the matrix's
+    own measured single-chip anchors with the comm model's fabric term
+    (comm_model.recommend_for_scenario)."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import QsgdCodec, SvdCodec
+    from atomo_tpu.models import get_model
+    from atomo_tpu.parallel import make_mesh
+    from atomo_tpu.training import create_state, make_optimizer
+    from atomo_tpu.tuning.probe import (
+        byte_budget,
+        model_init_fn,
+        probe_candidate,
+    )
+    from atomo_tpu.utils.comm_model import (
+        FABRICS,
+        recommend_for_scenario,
+    )
+
+    fast = os.environ.get("ATOMO_BENCH_FAST") == "1"
+    dev = jax.devices()[0]
+    n_mesh = min(int(cfg.get("n_dev", 4)), len(jax.devices()))
+    batch = int(cfg.get("batch", 8))
+    steps = _env_int("ATOMO_BENCH_STEPS", 3 if fast else 5)
+    reps = 1 if fast else 2
+    budget_s = _env_float("ATOMO_SCENARIO_BUDGET_S", 300.0)
+    t0_all = time.perf_counter()
+
+    networks = {"lenet": (28, 28, 1)}
+    if not fast:
+        # a resnet18 cell costs multi-minute 1-core compiles; fast mode
+        # (the orchestrated CPU-fallback path) keeps the lenet cells only
+        networks["resnet18"] = (32, 32, 3)
+
+    def codecs():
+        return {
+            "dense": None,
+            "qsgd8": QsgdCodec(bits=8, bucket_size=512),
+            "svd3": SvdCodec(rank=3),
+        }
+
+    base = dict(
+        metric=cfg["metric"], unit="ms/step", value=None,
+        vs_baseline=None, baseline="none", byte_reduction=None, mfu=None,
+        flops_per_step=None, peak_tflops=None, platform=dev.platform,
+        device=dev.device_kind, ways=n_mesh, chips_measured=n_mesh,
+        timing="dispatch-loop-scalar-fenced",
+        config=dict(kind="scenarios", batch=batch, n_dev=n_mesh,
+                    steps=steps, networks=sorted(networks),
+                    codecs=sorted(codecs())),
+        note=(f"autopilot regression matrix on a {n_mesh}-device "
+              f"{dev.platform} mesh; semantics + probe-runner evidence, "
+              "not a chip-speed row"),
+    )
+    if n_mesh < 2:
+        base.update(measurement_valid=False,
+                    invalid_reason="single device: no mesh for the matrix")
+        return base
+
+    out = dict(base, measurement_valid=True, invalid_reason=None)
+    cells, skipped = [], []
+    parities_ok = True
+    budgets_by_net = {}
+    measured_1dev = {}
+    try:
+        for net, shape in networks.items():
+            opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+            model = get_model(net, 10)
+            rng = jax.random.PRNGKey(0)
+            sample = jnp.zeros((1,) + shape, jnp.float32)
+            _init_params = model_init_fn(model, sample)
+            budgets_by_net[net] = {}
+            measured_1dev[net] = {}
+            for cname, codec in codecs().items():
+                db, pb = byte_budget(codec, _init_params)
+                budgets_by_net[net][cname] = (db, pb)
+                for nd in (1, n_mesh):
+                    if time.perf_counter() - t0_all > budget_s:
+                        skipped.append(f"{net}/{nd}dev/{cname}")
+                        continue
+                    cand = {"superstep": 1}
+                    if nd > 1:
+                        cand.update(aggregate="gather", overlap="off")
+                    row = probe_candidate(
+                        cand, model=model, optimizer=opt, codec=codec,
+                        n_dev=nd, sample_shape=shape, num_classes=10,
+                        batch=batch, steps=steps, reps=reps,
+                    )
+                    cell = {
+                        "network": net, "n_dev": nd, "code": cname,
+                        "ms_per_step": row["measured_ms_per_step"],
+                        "sync_ok": row["sync_ok"],
+                        "byte_reduction": (
+                            round(db / pb, 2) if pb else None
+                        ),
+                    }
+                    if not row["sync_ok"]:
+                        _mark_invalid(
+                            out, f"cell {net}/{nd}dev/{cname}: fence "
+                            "scalar not finite",
+                        )
+                    if nd == 1:
+                        measured_1dev[net][cname] = (
+                            row["measured_ms_per_step"]
+                        )
+                    if nd > 1 and codec is not None:
+                        # the autopilot-safety invariant: gather's
+                        # decode-mean and ring's streamed fold must be
+                        # BIT-identical (PR-3 contract) — what makes a
+                        # mid-run gather<->ring re-tune trajectory-safe
+                        params = jax.device_get(
+                            create_state(model, opt, rng,
+                                         jnp.zeros((batch,) + shape))
+                        ).params
+                        grads = jax.tree_util.tree_map(
+                            lambda a: jax.random.normal(
+                                jax.random.PRNGKey(7), a.shape,
+                                jnp.float32,
+                            ),
+                            params,
+                        )
+                        parity = gather_vs_ring_parity(
+                            make_mesh(nd), codec, grads,
+                            jax.random.PRNGKey(1), nd,
+                        )
+                        cell["aggregation_bit_parity"] = parity
+                        parities_ok &= parity
+                        if not parity:
+                            _mark_invalid(
+                                out,
+                                f"cell {net}/{nd}dev/{cname}: ring "
+                                "aggregation operator is NOT bit-"
+                                "identical to gather's decode-mean "
+                                "(the PR-3 contract the autopilot's "
+                                "re-tune relies on)",
+                            )
+                    cells.append(cell)
+        out["cells"] = cells
+        out["skipped_cells"] = skipped
+        out["aggregation_bit_parity"] = parities_ok
+        # per-(network, fabric) recommended configs from the matrix's own
+        # measured single-chip anchors + the analytic fabric term
+        recs = {}
+        for net, anchors in measured_1dev.items():
+            if "dense" not in anchors:
+                continue
+            recs[net] = {}
+            for label, bw in sorted(FABRICS.items()):
+                recs[net][label] = recommend_for_scenario(
+                    codec_budgets=budgets_by_net[net],
+                    measured_ms=anchors,
+                    ways=n_mesh,
+                    fabric_bw=bw,
+                )
+        out["recommendations"] = recs
+        head = next(
+            (c for c in cells
+             if c["network"] == "lenet" and c["n_dev"] == n_mesh
+             and c["code"] == "qsgd8"),
+            cells[0] if cells else None,
+        )
+        if head is not None:
+            out["value"] = head["ms_per_step"]
+            out["byte_reduction"] = head["byte_reduction"]
+        if not cells:
+            _mark_invalid(out, "no cells completed inside the budget")
+    except Exception as exc:  # noqa: BLE001 — a failed matrix is a failed row
+        _mark_invalid(out, f"scenario matrix failed: {str(exc)[:200]}")
+    return out
+
+
 def measure_ours(cfg: dict) -> dict:
     import jax
     import jax.numpy as jnp
@@ -944,6 +1203,8 @@ def measure_ours(cfg: dict) -> dict:
         return measure_ring_compare(cfg)
     if cfg.get("kind") == "overlapcmp":
         return measure_overlap_compare(cfg)
+    if cfg.get("kind") == "scenarios":
+        return measure_scenarios(cfg)
 
     model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
@@ -1663,13 +1924,12 @@ def _write_artifact() -> None:
     if not path:
         return
     try:
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(_ARTIFACT, f, indent=1)
-        os.replace(tmp, path)  # atomic on POSIX: readers never see a torn file
+        # atomic tmp+rename (utils.tracing.write_json_atomic — the one
+        # artifact discipline shared with the autopilot's decision file
+        # and the LR grid): readers never see a torn file
+        from atomo_tpu.utils.tracing import write_json_atomic
+
+        write_json_atomic(path, _ARTIFACT)
     except OSError as exc:
         print(f"bench artifact write failed: {exc}", file=sys.stderr)
 

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Bench smoke (~6 min): prove the bench entrypoint still emits parseable
-# evidence without burning the full-ladder window. Four checks:
+# evidence without burning the full-ladder window. Six checks:
 #
 #   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
 #      the driver's last-line JSON contract, PLUS the partial-artifact
@@ -22,6 +22,12 @@
 #      under --max-restarts 2 must exit 0 on the third attempt and
 #      leave a parseable incidents.jsonl (2 crash records + the clean
 #      exit) — the PR-5 escalation ladder's run-level rung.
+#
+#   6. the autopilot contract (<60 s, forced 4-device CPU mesh): a
+#      --auto tune run must probe, train, exit 0, and leave a
+#      tune_decision.json that parses, names a winner, and records
+#      predicted AND measured ms/step for every probed candidate —
+#      the PR-7 probe-driven config selection.
 #
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
@@ -58,7 +64,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/5]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/6]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -87,7 +93,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/5]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/6]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -124,7 +130,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/5]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/6]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -155,7 +161,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/5]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/6]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -182,6 +188,41 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/5]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/6]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
+EOF
+[ $? -ne 0 ] && exit 1
+
+# --- 6: autopilot probe ladder + decision artifact -----------------------
+tune="$art/tune"
+out=$(timeout -k 5 60 env JAX_PLATFORMS=cpu ATOMO_COMPILE_CACHE="$art/xla" \
+      XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+      python -m atomo_tpu.cli train --synthetic --dataset mnist \
+      --network lenet --batch-size 8 --max-steps 2 --eval-freq 0 \
+      --save-freq 2 --log-interval 1 --n-devices 4 --code qsgd \
+      --quantization-level 8 --train-dir "$tune" \
+      --auto tune --tune-steps 2 --tune-reps 1 --tune-top 2 2>&1)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: --auto tune exited rc=$rc"
+  printf '%s\n' "$out" | tail -5
+  exit 1
+fi
+python - "$tune/tune_decision.json" <<'EOF'
+import json, sys
+
+doc = json.load(open(sys.argv[1]))
+assert doc["complete"] is True, doc
+win = doc.get("winner") or {}
+assert win.get("name") and win.get("knobs"), f"no winner named: {win}"
+probed = [r for r in doc["rows"] if r.get("probed")]
+assert probed, "no candidate was measured"
+for r in probed:
+    assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
+    assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
+assert doc.get("why"), doc
+print(f"bench_smoke OK[6/6]: --auto tune picked {win['name']} "
+      f"({win.get('measured_ms_per_step')} ms/step measured, "
+      f"{len(probed)}/{len(doc['rows'])} candidates probed); "
+      "decision artifact parses")
 EOF
