@@ -160,7 +160,22 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                         "(outer/DCN) groups; the n-devices mesh becomes "
                         "(dp=K) x (ici=n/K). 0 = infer from "
                         "jax.process_count() (one group per host), "
-                        "falling back to 2 on a single process")
+                        "falling back to 2 on a single process. With "
+                        "--dcn-ways > 1, --aggregate auto plans over the "
+                        "two-tier fabric and --auto tune probes "
+                        "hierarchical candidates")
+    t.add_argument("--plan", type=str, default="auto",
+                   help="two-level schedule for hierarchical aggregation "
+                        "(topology.schedule): auto = the cost-driven "
+                        "planner when --aggregate auto resolved "
+                        "hierarchical, the legacy plan when you pinned "
+                        "--aggregate hierarchical yourself (today's exact "
+                        "program); legacy = dense psum over ICI + one "
+                        "factor gather over DCN; or an explicit "
+                        "inner+outer pair from {psum,cring}+{gather,ring,"
+                        "psum}, e.g. cring+ring — inner dense-psum or "
+                        "compressed-ring, boundary re-encode, outer "
+                        "re-encoded gather/ring or SparCML dense fallback")
     t.add_argument("--sample", type=str, default="fixed_k",
                    choices=["fixed_k", "bernoulli_budget", "bernoulli", "topk"],
                    help="SVD atom sampling mode (bernoulli_budget = reference "
@@ -411,22 +426,69 @@ def _resolve_auto_aggregate(
     allow_ring=True, log=print,
 ) -> str:
     """``--aggregate auto`` (VERDICT r4 #3): pick the exchange mode from
-    the measured comm-cost model and always say why in one line."""
+    the measured comm-cost model and always say why in one line.
+
+    On a two-tier mesh (``--dcn-ways`` > 1 or multi-host) the advisory
+    quotes PER-TIER numbers from :class:`TwoTierFabric` — a single
+    blended bandwidth would price ICI hops at DCN speed — and runs the
+    topology planner; the chosen plan is stashed on ``args._auto_plan``
+    for the caller to execute."""
     import jax
 
     from atomo_tpu.utils.comm_model import choose_aggregate, resolve_fabric
 
     n_proc = jax.process_count()
-    cross_host = (
-        n_proc > 1 or getattr(args, "dcn_ways", 0) > 1
-    ) and allow_hierarchical
+    dcn_ways = getattr(args, "dcn_ways", 0)
+    cross_host = (n_proc > 1 or dcn_ways > 1) and allow_hierarchical
+    dense_b = payload_b = 0
+    if codec is not None:
+        dense_b, payload_b = _codec_byte_budget(codec, model_init_fn)
+    if cross_host and codec is not None:
+        # two-tier: per-tier advisory + planner, not a blended scalar
+        from atomo_tpu.topology.fabric import resolve_two_tier
+        from atomo_tpu.topology.schedule import choose_plan
+
+        k = dcn_ways or max(n_proc, 2)
+        try:
+            fabric2 = resolve_two_tier(
+                args.fabric, dcn_ways=k, n_dev=n_dev, n_proc=n_proc
+            )
+        except ValueError as exc:
+            raise SystemExit(str(exc)) from None
+        # an explicit --plan wins the precedence chain, so the advisory
+        # must price THAT plan (printing the planner's own pick here
+        # would announce a schedule that will not run); restricting the
+        # plan space to the pinned name keeps the per-tier numbers while
+        # skipping the selection
+        pinned = getattr(args, "plan", "auto")
+        pinned_names = None
+        suffix = ""
+        if pinned != "auto":
+            from atomo_tpu.topology.schedule import plan_from_name
+
+            pinned_names = (plan_from_name(pinned).name,)
+            suffix = " — pinned by --plan, planner selection skipped"
+        plan, plan_reason = choose_plan(
+            dense_bytes=dense_b,
+            payload_bytes=payload_b,
+            fabric=fabric2,
+            tax_s=(
+                None if args.codec_tax_ms is None
+                else args.codec_tax_ms / 1e3
+            ),
+            plan_names=pinned_names,
+        )
+        if pinned == "auto":
+            args._auto_plan = plan.name
+        log(
+            f"--aggregate auto -> hierarchical ({fabric2.describe()}; "
+            f"{plan_reason}{suffix})"
+        )
+        return "hierarchical"
     try:
         bw = resolve_fabric(args.fabric, n_proc=n_proc)
     except ValueError as exc:
         raise SystemExit(str(exc)) from None
-    dense_b = payload_b = 0
-    if codec is not None:
-        dense_b, payload_b = _codec_byte_budget(codec, model_init_fn)
     mode, reason = choose_aggregate(
         has_codec=codec is not None,
         dense_bytes=dense_b,
@@ -481,6 +543,8 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             pinned.append(f"--overlap {args.overlap}")
         if args.superstep != 0:
             pinned.append(f"--superstep {args.superstep}")
+        if getattr(args, "plan", "auto") != "auto":
+            pinned.append(f"--plan {args.plan}")
         if pinned:
             raise SystemExit(
                 "--auto tune picks the performance knobs itself and "
@@ -500,6 +564,25 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "(tune_decision.json) and the online re-tuner's incident "
                 "log live there"
             )
+    plan_flag = getattr(args, "plan", "auto")
+    if plan_flag not in ("auto", "legacy"):
+        from atomo_tpu.topology.schedule import plan_from_name
+
+        try:
+            # pure-python plan-name grammar: a typo'd --plan must fail
+            # here, not in every re-exec'd jax-booted child
+            plan_from_name(plan_flag)
+        except ValueError as exc:
+            raise SystemExit(str(exc))
+    if plan_flag != "auto" and args.aggregate not in (
+        "auto", "hierarchical"
+    ):
+        raise SystemExit(
+            f"--plan {plan_flag} selects a two-level hierarchical "
+            f"schedule and cannot compose with --aggregate "
+            f"{args.aggregate}; use --aggregate hierarchical (or auto on "
+            "a --dcn-ways mesh)"
+        )
     if args.overlap == "delayed":
         if args.code.lower() in DENSE_CODES:
             raise SystemExit(
@@ -515,8 +598,15 @@ def _argv_preflight(args: argparse.Namespace) -> None:
         if args.aggregate in ("psum", "hierarchical"):
             raise SystemExit(
                 f"--overlap delayed does not compose with --aggregate "
-                f"{args.aggregate} (only the compressed gather/ring "
-                "exchanges have a delayed form)"
+                f"{args.aggregate} (only the compressed flat gather/ring "
+                "exchanges have a delayed form; no two-level topology "
+                "plan — legacy or re-encoded — does)"
+            )
+        if plan_flag != "auto":
+            raise SystemExit(
+                f"--overlap delayed does not compose with --plan "
+                f"{plan_flag}: no two-level topology plan — legacy or "
+                "re-encoded — has a delayed form; drop one"
             )
         if args.phase_metrics:
             raise SystemExit(
@@ -621,10 +711,23 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
 
     if jax.process_count() > 1:
         raise SystemExit(
-            "--auto tune is single-host for now (the candidate space has "
-            "no hierarchical/DCN probes); pick knobs explicitly on "
-            "multi-host meshes"
+            "--auto tune is single-host for now (probe meshes are built "
+            "over this host's devices; a multi-host probe would need "
+            "every process in the dispatch loop); pick knobs explicitly "
+            "on multi-host meshes — hierarchical plans ARE probed on "
+            "single-host --dcn-ways meshes"
         )
+    dcn_ways = 0
+    if getattr(args, "dcn_ways", 0) > 1 and n_dev > 1:
+        # a forced two-tier mesh: the candidate space gains one
+        # hierarchical candidate per topology plan, probed on the
+        # (dp=K, ici=n/K) mesh the train path would run
+        dcn_ways = args.dcn_ways
+        if n_dev % dcn_ways or not 1 < dcn_ways <= n_dev:
+            raise SystemExit(
+                f"--dcn-ways {dcn_ways} must divide --n-devices {n_dev} "
+                "(outer slow-fabric groups x inner fast-fabric chips)"
+            )
     sample_shape = tuple(train_iter.images.shape[1:])
     sample = jnp.zeros((1,) + sample_shape, jnp.float32)
     num_classes = _num_classes(args.dataset)
@@ -637,6 +740,27 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         and 0 < args.num_aggregate < n_dev
     ):
         k_agg = args.num_aggregate
+    # the candidate space must stay conflict-free by construction (the
+    # enumerate_candidates contract): a hierarchical winner would be
+    # rejected by the in-run densify matrix AFTER the whole probe ladder
+    # ran, and would silently drop a requested --num-aggregate subset
+    # (replica subsetting exists only in flat gather/ring) — narrow the
+    # space up front, out loud, exactly like allow_overlap below
+    if dcn_ways and args.on_diverge == "densify":
+        print(
+            "Autopilot: excluding hierarchical candidates (--on-diverge "
+            "densify cannot compose with a two-level schedule — the "
+            "dense fallback aggregates with a flat psum)",
+            flush=True,
+        )
+        dcn_ways = 0
+    if dcn_ways and k_agg:
+        print(
+            "Autopilot: excluding hierarchical candidates "
+            "(--num-aggregate subsets replicas only in flat gather/ring)",
+            flush=True,
+        )
+        dcn_ways = 0
     doc = None
     if args.resume:
         # a resumed run (including a supervised restart's appended
@@ -687,6 +811,7 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
                 (args.ring_bucket_size,)
                 if args.ring_bucket_size != 65536 else (65536, 0)
             ),
+            dcn_ways=dcn_ways,
             probe_top=args.tune_top, probe_steps=args.tune_steps,
             probe_reps=args.tune_reps,
             num_aggregate=k_agg, zero1=zero1, grad_accum=args.grad_accum,
@@ -695,6 +820,11 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
                 None if args.codec_tax_ms is None
                 else args.codec_tax_ms / 1e3
             ),
+            # hierarchical candidates carry no per-candidate bucket knob;
+            # their ring tiers must be probed at the value the run will
+            # execute with (bit-identical layout knob, but the measured
+            # ms/step must describe the dispatched packing)
+            ring_bucket_size=args.ring_bucket_size,
             context={
                 "network": args.network, "dataset": args.dataset,
                 "code": args.code, "seed": args.seed,
@@ -712,6 +842,10 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
     if n_dev > 1:
         args.aggregate = knobs.get("aggregate", "gather")
     args.overlap = knobs.get("overlap", "off")
+    if knobs.get("plan"):
+        # a hierarchical winner carries its topology plan; cmd_train's
+        # hierarchical block executes it (highest plan precedence)
+        args._tuned_plan = knobs["plan"]
     args.ring_bucket_size = int(
         knobs.get("ring_bucket_size", args.ring_bucket_size)
     )
@@ -949,7 +1083,20 @@ def cmd_train(args: argparse.Namespace) -> int:
                     f"{args.aggregate!r} — pass --aggregate gather "
                     "explicitly to subset replicas"
                 )
+            if args.plan != "auto" and args.aggregate != "hierarchical":
+                # an explicitly pinned plan must never be silently
+                # dropped (the --overlap delayed auto-resolution
+                # precedent): auto only goes hierarchical on a two-tier
+                # deployment with a codec
+                raise SystemExit(
+                    f"--plan {args.plan}: --aggregate auto resolved to "
+                    f"{args.aggregate!r} for this deployment (a planned "
+                    "two-level schedule needs a compressing --code and a "
+                    "--dcn-ways/multi-host mesh); pass --aggregate "
+                    "hierarchical explicitly to force it, or drop --plan"
+                )
         inner_axis = None
+        plan = None
         if args.aggregate == "hierarchical":
             k = args.dcn_ways or max(jax.process_count(), 2)
             if codec is None:
@@ -965,6 +1112,23 @@ def cmd_train(args: argparse.Namespace) -> int:
                 )
             mesh = make_mesh(n_dev, axes=(("dp", k), ("ici", n_dev // k)))
             inner_axis = "ici"
+            # plan precedence: autopilot winner > explicit --plan >
+            # auto-resolution's planner choice > legacy (None). A
+            # user-pinned --aggregate hierarchical under --plan auto
+            # falls through to legacy (args._auto_plan is only set when
+            # the auto-resolution ran the planner), so today's exact
+            # program stays the default for explicit hierarchical; the
+            # legacy plan is byte-identical to the pre-topology path
+            pname = (
+                getattr(args, "_tuned_plan", None)
+                or (args.plan if args.plan != "auto" else None)
+                or getattr(args, "_auto_plan", None)
+            )
+            if pname and pname != "legacy":
+                from atomo_tpu.topology.schedule import plan_from_name
+
+                plan = plan_from_name(pname)
+                print(f"Topology plan: {plan.name}", flush=True)
         else:
             mesh = make_mesh(n_dev)
         k_agg = 0
@@ -1000,6 +1164,7 @@ def cmd_train(args: argparse.Namespace) -> int:
                 overlap=args.overlap,
                 diverge=diverge,
                 tuner=tuner,
+                plan=plan,
             )
         except DivergenceError as exc:
             return _diverged_exit(exc)
@@ -1015,6 +1180,12 @@ def cmd_train(args: argparse.Namespace) -> int:
             warnings.warn(
                 "--zero1 needs a multi-device mesh; single-device training "
                 "has no dp axis to shard the optimizer state over — "
+                "ignoring it"
+            )
+        if args.plan != "auto":
+            warnings.warn(
+                "--plan selects a two-level schedule over a multi-device "
+                "mesh; single-device training has no tiers to schedule — "
                 "ignoring it"
             )
         if args.grad_accum > 1:
@@ -1150,9 +1321,12 @@ def cmd_lm(args: argparse.Namespace) -> int:
 
     aggregate = args.aggregate
     if aggregate == "auto":
-        # the lm path has no hierarchical mode (model axes already own the
-        # second mesh dimension), so auto picks gather vs psum over the dp
-        # axis; byte budget from the unsharded LM (tp/ep/pp shard both
+        # the lm path has no hierarchical mode and therefore NO topology
+        # plan space (allow_hierarchical=False stays load-bearing: the
+        # model axes — sp/tp/ep/pp — already own the second mesh
+        # dimension, so there is no free inner data axis for a two-level
+        # schedule to reduce over); auto picks gather vs psum over the dp
+        # axis. Byte budget from the unsharded LM (tp/ep/pp shard both
         # sides of the ratio equally — decision-equivalent heuristic)
         from atomo_tpu.models.transformer import TransformerLM as _LM
         from atomo_tpu.tuning.probe import model_init_fn

@@ -419,9 +419,24 @@ def make_distributed_train_step(
     overlap: str = "off",
     remedy=None,
     track_grad_norm: bool = False,
+    plan=None,
     _oracle_parts: bool = False,
 ):
     """Build the jitted SPMD train step over ``mesh``.
+
+    ``plan`` (topology.schedule.AggregationPlan, hierarchical mode only)
+    selects the two-level schedule: inner primitive over the fast fabric
+    (dense psum, or a compressed ring via the same ``_ring_stream_mean``
+    machinery the flat ring mode uses), outer primitive over the slow one
+    (boundary-RE-ENCODED gather or ring — a fresh outer-keyed codec draw
+    over the inner-reduced gradient, unbiased by composition — or the
+    SparCML dense fallback once density crosses the crossover). ``None``
+    or ``topology.schedule.LEGACY_PLAN`` runs the pre-topology
+    hard-coded path BYTE-FOR-BYTE (the legacy plan is one point in the
+    plan space; bit-identity is tested). Non-legacy plans execute via
+    :func:`atomo_tpu.topology.execute.planned_two_level_mean` and honor
+    ``unfused_decode`` on their outer gather (the canonical-decode-order
+    ablation the per-plan parity oracle drives).
 
     ``remedy`` (training.resilience.RemedyConfig) applies the divergence
     doctor's rewarm ramp: the aggregated mean gradient is pre-scaled by
@@ -601,6 +616,16 @@ def make_distributed_train_step(
             )
     elif inner_axis is not None:
         raise ValueError("inner_axis only applies to aggregate='hierarchical'")
+    if plan is not None and not hierarchical:
+        raise ValueError(
+            "plan= selects a two-level hierarchical schedule "
+            "(topology.schedule) and only applies to "
+            "aggregate='hierarchical'"
+        )
+    planned = (
+        hierarchical and plan is not None and not plan.is_legacy
+    )  # non-legacy plans route through topology.execute; the legacy
+    # plan (or plan=None) keeps the frozen inline path byte-for-byte
     k_agg = num_aggregate if 0 < num_aggregate < n_dev else 0
     if k_agg and (codec is None or aggregate not in ("gather", "ring")):
         raise ValueError(
@@ -619,8 +644,10 @@ def make_distributed_train_step(
         raise ValueError(
             "overlap='delayed' needs a compressing codec with "
             "aggregate='gather' or 'ring' — the mode takes the encoded "
-            "exchange+decode off the critical path, and psum/hierarchical "
-            "have no delayed form"
+            "exchange+decode off the critical path; psum and every "
+            "two-level hierarchical schedule (the legacy plan and the "
+            "topology.schedule re-encoded plans alike) have no delayed "
+            "form"
         )
     if _oracle_parts and overlap != "delayed":
         raise ValueError("_oracle_parts only applies to overlap='delayed'")
@@ -742,6 +769,25 @@ def make_distributed_train_step(
             else:
                 mean_grads = jax.lax.pmean(grads, axis)
             msg_bytes = dense_bytes
+        elif planned:
+            # non-legacy two-level schedule: topology.execute runs the
+            # plan (inner psum/cring, boundary re-encode, outer
+            # gather/ring/dense) and hands back the guard bookkeeping
+            # this tail consumes exactly like the legacy branch's
+            from atomo_tpu.topology.execute import (
+                inner_codec_key,
+                planned_two_level_mean,
+            )
+
+            step_key = jax.random.fold_in(key, state.step)
+            mean_grads, ok, kept, msg_bytes = planned_two_level_mean(
+                codec, plan, grads,
+                inner_codec_key(step_key, my), k_codec,
+                axis=axis, inner_axis=inner_axis,
+                n_inner=mesh.shape[inner_axis], n_outer=n_dev,
+                guard=guard, ring_bucket_size=ring_bucket_size,
+                unfused_decode=unfused_decode,
+            )
         elif hierarchical:
             # fast fabric first: dense pmean over the inner (ICI) axis —
             # the regime where the codec tax cannot pay for itself
@@ -1454,6 +1500,7 @@ def distributed_train_loop(
     overlap: str = "off",
     diverge=None,
     tuner=None,
+    plan=None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -1505,6 +1552,11 @@ def distributed_train_loop(
     one. Not supported with ``--zero1`` (the sharded optimizer template
     cannot be rebuilt mid-run) or ``--phase-metrics``.
 
+    ``plan`` (topology.schedule.AggregationPlan) selects the two-level
+    schedule for ``aggregate='hierarchical'`` — inner psum/cring,
+    boundary re-encode, outer gather/ring/dense (see
+    make_distributed_train_step); None keeps the legacy plan.
+
     ``tuner`` (tuning.autopilot.OnlineRetuner) arms the performance
     ladder's rung 0.5: the loop feeds it the per-step wall-time series
     (per step in the per-step loop, one block-mean observation per fused
@@ -1536,8 +1588,9 @@ def distributed_train_loop(
         if codec is None or aggregate not in ("gather", "ring"):
             raise ValueError(
                 "--overlap delayed needs a compressing codec with "
-                "--aggregate gather or ring (psum/hierarchical have no "
-                "delayed form)"
+                "--aggregate gather or ring (psum and the two-level "
+                "hierarchical schedules — legacy plan or the "
+                "topology re-encoded plans — have no delayed form)"
             )
         if phase_metrics:
             raise ValueError(
@@ -1796,6 +1849,7 @@ def distributed_train_loop(
                 superstep=superstep, ring_bucket_size=ring_bucket_size,
                 overlap="off" if densify else overlap,
                 remedy=remedy_cfg, track_grad_norm=diverge is not None,
+                plan=plan,
             )
 
         step_fn = build_step()

@@ -1,0 +1,290 @@
+"""Execute a planned two-level aggregation schedule inside the SPMD step.
+
+This is the generalization of ``parallel/replicated``'s hard-coded
+hierarchical path: :func:`planned_two_level_mean` runs ANY
+:class:`~atomo_tpu.topology.schedule.AggregationPlan` — compressed ring
+within the fast domain via the existing ``_ring_stream_mean`` machinery,
+re-encoded gather/ring (or the SparCML dense fallback) across the slow
+domain — and returns the global mean-gradient estimate plus the guard
+bookkeeping the step's shared metric tail consumes.
+
+Key discipline (the unbiasedness-by-composition contract):
+
+  * INNER keys are per-chip: ``inner_codec_key(step_key, chip_id)`` —
+    each chip encodes its RAW gradient independently, so the inner ring's
+    decode-mean is an unbiased estimate of the group mean (the flat-ring
+    argument, per group).
+  * OUTER keys are per-GROUP: ``outer_codec_key(step_key, outer_index)``
+    — the legacy hierarchical construction (sentinel ``1 << 20``),
+    identical across an inner group's chips so the boundary re-encode
+    produces identical payloads group-wide and the replicated-update
+    invariant holds with zero extra comm.
+  * The two streams use DISJOINT sentinels, so the boundary re-encode is
+    a FRESH draw independent of the inner draws: each stage is unbiased
+    given its input, stages are independent, and the law of total
+    expectation makes the composed two-level estimate unbiased —
+    E[outer ∘ inner] = true global mean (Monte-Carlo-tested per codec in
+    tests/test_topology.py).
+
+Determinism: the inner ring inherits PR-3's bit-identical-to-canonical
+contract per group; the outer gather decodes identical bytes identically
+on every chip (the legacy argument); the outer ring is bit-identical to
+the outer gather's canonical (unfused) decode order. So every plan's
+aggregation OPERATOR is bit-identical to the canonical unfused
+decode-order oracle in SPMD form (:func:`two_level_canonical_mean` —
+gather + ``fused=False`` at every compressed tier, pmean at every dense
+one), and replicas stay bit-identical — both tested per plan and codec.
+
+Guard semantics match the legacy hierarchical mode: the screen runs on
+the INNER-REDUCED gradient (identical across a group's chips), so the
+unit of drop is an inner group — one bad chip poisons its group's
+reduction (dense pmean or compressed ring alike) and that whole group is
+masked from the slow-fabric exchange, with the surviving average rescaled
+by K/kept (valid because every stage is unbiased).
+"""
+
+from __future__ import annotations
+
+# codec-key sentinels: folds beyond any chip id keep these streams
+# disjoint from the per-chip dropout/augment streams AND from each other
+# (outer must match compute_grads' legacy inline construction exactly —
+# the legacy plan's bit-identity depends on it)
+OUTER_KEY_SENTINEL = 1 << 20
+INNER_KEY_SENTINEL = (1 << 20) + 1
+
+
+def outer_codec_key(step_key, outer_index):
+    """The boundary re-encode's per-GROUP key — the exact legacy
+    construction from ``compute_grads`` (same sentinel, same fold order),
+    restated here so the host oracle and the step cannot drift."""
+    import jax
+
+    return jax.random.fold_in(
+        jax.random.fold_in(step_key, OUTER_KEY_SENTINEL), outer_index
+    )
+
+
+def inner_codec_key(step_key, chip_id):
+    """The inner compressed ring's per-CHIP key (disjoint sentinel —
+    independent of the outer stream, which is what makes the two-level
+    composition's stages independent draws)."""
+    import jax
+
+    return jax.random.fold_in(
+        jax.random.fold_in(step_key, INNER_KEY_SENTINEL), chip_id
+    )
+
+
+def planned_two_level_mean(
+    codec,
+    plan,
+    grads,
+    k_inner,
+    k_outer,
+    *,
+    axis: str,
+    inner_axis: str,
+    n_inner: int,
+    n_outer: int,
+    guard=None,
+    ring_bucket_size: int = 65536,
+    unfused_decode: bool = False,
+):
+    """Run one plan's two-level aggregation inside the SPMD step.
+
+    Returns ``(mean_grads, ok, kept, msg_bytes)``: the global mean
+    estimate, the group-level guard flag (None unguarded), the surviving
+    group count (None unguarded), and the per-chip bytes on the SLOW
+    fabric (the ``msg_bytes`` honesty convention the legacy mode set:
+    payload bytes for a compressed outer, dense bytes for the SparCML
+    dense fallback).
+
+    ``unfused_decode`` forces the canonical vmap-decode + mean order on
+    the outer gather (the decode-order ablation that makes gather's
+    arithmetic match the outer ring and the :func:`two_level_mean_host`
+    oracle exactly — the per-plan parity tests drive it).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import decode_mean_tree, encode_tree, tree_nbytes
+    from atomo_tpu.parallel.replicated import _mask_gathered, _ring_stream_mean
+    from atomo_tpu.training.resilience import (
+        grad_ok,
+        masked_mean,
+        rescale_by_survivors,
+    )
+
+    # ---- inner stage: reduce over the fast tier ----------------------
+    if plan.inner == "psum":
+        grads_in = jax.lax.pmean(grads, inner_axis)
+    else:  # cring: compressed ring over the fast tier, per-chip keys
+        payloads_in, _ = encode_tree(codec, k_inner, grads)
+        grads_in, _ = _ring_stream_mean(
+            codec,
+            payloads_in,
+            grads,
+            axis=inner_axis,
+            n_dev=n_inner,
+            my=jax.lax.axis_index(inner_axis),
+            n_contrib=n_inner,
+            bucket_size=ring_bucket_size,
+        )
+    ok = kept = None
+    if guard is not None:
+        # group-level screen on the inner-reduced gradient (identical
+        # across the group's chips for BOTH inner primitives): one bad
+        # chip poisons its group's reduction, the group is the drop unit
+        ok = grad_ok(grads_in, guard.max_grad_norm)
+    dense_bytes = tree_nbytes(grads)
+
+    # ---- outer stage: exchange across the slow tier ------------------
+    if plan.outer == "psum":
+        # SparCML dense fallback: density crossed the crossover, ship the
+        # inner-reduced gradient dense (no boundary re-encode)
+        if guard is not None:
+            kept = jax.lax.psum(ok.astype(jnp.float32), axis)
+            mean_grads = masked_mean(grads_in, ok, kept, axis)
+        else:
+            mean_grads = jax.lax.pmean(grads_in, axis)
+        return mean_grads, ok, kept, dense_bytes
+
+    # boundary re-encode: FRESH outer-keyed draw over the inner estimate
+    # (identical payloads within a group — k_outer is per-group)
+    payloads, stats = encode_tree(codec, k_outer, grads_in)
+    msg_bytes = stats.payload_bytes
+    if plan.outer == "gather":
+        gathered = jax.lax.all_gather(payloads, axis)
+        if guard is not None:
+            okg = jax.lax.all_gather(ok.astype(jnp.float32), axis)
+            kept = jnp.sum(okg)
+            mean_grads = rescale_by_survivors(
+                decode_mean_tree(
+                    codec,
+                    _mask_gathered(gathered, okg),
+                    grads_in,
+                    n_outer,
+                    fused=not unfused_decode,
+                ),
+                n_outer,
+                kept,
+            )
+        else:
+            mean_grads = decode_mean_tree(
+                codec, gathered, grads_in, n_outer,
+                fused=not unfused_decode,
+            )
+    else:  # outer ring: PR-3's streamed schedule on the slow axis
+        mean_grads, ok_stage = _ring_stream_mean(
+            codec,
+            payloads,
+            grads_in,
+            axis=axis,
+            n_dev=n_outer,
+            my=jax.lax.axis_index(axis),
+            ok=ok,
+            n_contrib=n_outer,
+            bucket_size=ring_bucket_size,
+        )
+        if guard is not None:
+            kept = jnp.sum(ok_stage)
+            mean_grads = rescale_by_survivors(mean_grads, n_outer, kept)
+    return mean_grads, ok, kept, msg_bytes
+
+
+def two_level_canonical_mean(
+    codec,
+    plan,
+    grads,
+    k_inner,
+    k_outer,
+    *,
+    axis: str,
+    inner_axis: str,
+    n_inner: int,
+    n_outer: int,
+):
+    """The CANONICAL-decode-order oracle in SPMD form: every compressed
+    tier is an all_gather + ``decode_mean_tree(fused=False)`` (gather's
+    canonical order — exactly what PR-3 pinned the flat ring against),
+    every dense tier a pmean. Run inside shard_map on the same mesh as
+    the plan under test: per-plan operator BIT-parity is stated against
+    this program (two jitted SPMD programs, the ring-vs-gather precedent
+    — a host-side eager/jit oracle sits in a different fusion context and
+    drifts by last-mantissa bits in codec-internal reductions, which is a
+    harness artifact, not an operator property; the host oracle below
+    remains the semantics/unbiasedness reference)."""
+    import jax
+
+    from atomo_tpu.codecs import decode_mean_tree, encode_tree
+
+    if plan.inner == "psum":
+        gm = jax.lax.pmean(grads, inner_axis)
+    else:
+        p_in, _ = encode_tree(codec, k_inner, grads)
+        gathered = jax.lax.all_gather(p_in, inner_axis)
+        gm = decode_mean_tree(codec, gathered, grads, n_inner, fused=False)
+    if plan.outer == "psum":
+        return jax.lax.pmean(gm, axis)
+    p_out, _ = encode_tree(codec, k_outer, gm)
+    gathered = jax.lax.all_gather(p_out, axis)
+    return decode_mean_tree(codec, gathered, gm, n_outer, fused=False)
+
+
+def two_level_mean_host(
+    codec, plan, grads_by_chip, step_key, *, n_outer: int, n_inner: int
+):
+    """The HOST-side reference for one plan, computed without
+    collectives: chip ``o * n_inner + i`` belongs to outer group ``o``,
+    keys come from the SAME helpers the step uses, every decode-mean is
+    the canonical unfused order (per-replica decode, elementwise
+    ``mean(axis=0)`` at canonical source index). This is the semantics
+    and unbiasedness reference (the Monte-Carlo expectation tests drive
+    it); the per-plan BIT-parity contract is stated against
+    :func:`two_level_canonical_mean` instead — a host program sits in a
+    different XLA fusion context than the SPMD step, and codec-internal
+    reductions (e.g. QSGD's per-bucket L2 norm) can associate
+    differently there, a last-mantissa-bit harness artifact the
+    ring-vs-gather precedent avoids the same way (it compares SPMD
+    programs to SPMD programs). Compiled as ONE jitted program so the
+    drift stays within that documented class (eager per-op dispatch
+    adds more)."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import decode_mean_tree, decode_tree, encode_tree
+
+    assert len(grads_by_chip) == n_outer * n_inner
+
+    def canonical_mean(trees):
+        return jax.tree_util.tree_map(
+            lambda *xs: jnp.mean(jnp.stack(xs), axis=0), *trees
+        )
+
+    def oracle(grads_by_chip, step_key):
+        group_means = []
+        for o in range(n_outer):
+            chips = grads_by_chip[o * n_inner:(o + 1) * n_inner]
+            if plan.inner == "psum":
+                group_means.append(canonical_mean(chips))
+            else:
+                decoded = []
+                for i, g in enumerate(chips):
+                    k = inner_codec_key(step_key, o * n_inner + i)
+                    p, _ = encode_tree(codec, k, g)
+                    decoded.append(decode_tree(codec, p, g))
+                group_means.append(canonical_mean(decoded))
+        if plan.outer == "psum":
+            return canonical_mean(group_means)
+        payloads = [
+            encode_tree(codec, outer_codec_key(step_key, o), gm)[0]
+            for o, gm in enumerate(group_means)
+        ]
+        gathered = jax.tree_util.tree_map(
+            lambda *a: jnp.stack(a), *payloads
+        )
+        return decode_mean_tree(
+            codec, gathered, group_means[0], n_outer, fused=False
+        )
+
+    return jax.jit(oracle)(list(grads_by_chip), step_key)

@@ -1,0 +1,133 @@
+"""Two-tier fabric description — the topology the comm model can price.
+
+``utils/comm_model.resolve_fabric`` returns ONE scalar bandwidth (the
+slowest link on the gradient path). On a two-tier mesh that prices ICI
+hops at DCN bandwidth: a flat advisory quoting one blended number cannot
+say "the inner dense psum costs 1.7 ms over ICI while the outer factor
+gather costs 9 ms over DCN", which is exactly the arithmetic that decides
+whether re-compressing at the boundary wins. :class:`TwoTierFabric` keeps
+the two tiers separate and the prediction honest per tier.
+
+Parsing (``resolve_two_tier``) extends the ONE-parser rule: each tier
+token goes through ``comm_model.resolve_fabric``'s grammar (named preset
+or positive finite GB/s), so the CLI advisory, the planner, and the
+autopilot cannot disagree about what a fabric string means. Accepted
+forms for ``--fabric`` on a two-tier mesh:
+
+  ``auto``            inner = ici preset, outer = dcn preset
+  ``<outer>``         one token names the OUTER (slow) tier; inner stays
+                      the ici preset (the historical single-scalar
+                      meaning: the slowest link on the gradient path)
+  ``<inner>:<outer>`` both tiers explicit, e.g. ``ici:eth10g`` or
+                      ``45:1.25`` (per-chip GB/s numbers)
+
+Latency anchors are stated estimates (per-hop ICI ~1 us, DCN ~25 us —
+the order-of-magnitude split between on-chip links and a routed
+datacenter network), included so many-hop collectives on the slow tier
+are not priced as free below the bandwidth floor; the probe ladder
+corrects them like every other anchor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from atomo_tpu.utils.comm_model import FABRICS, resolve_fabric
+
+# stated per-hop latency estimates (seconds); see module docstring
+ICI_HOP_LATENCY_S = 1e-6
+DCN_HOP_LATENCY_S = 25e-6
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoTierFabric:
+    """Per-tier bandwidth/latency + the (outer, inner) group shape.
+
+    ``inner_*`` is the fast tier (ICI within a slice/host): groups of
+    ``inner_ways`` chips with an all-to-all-capable fast interconnect.
+    ``outer_*`` is the slow tier (DCN/Ethernet across slices):
+    ``outer_ways`` groups whose representatives exchange over the scarce
+    fabric. ``outer_ways * inner_ways`` == the mesh's data-parallel chip
+    count. Bandwidths are per-chip effective ring bandwidths (bytes/s),
+    the same convention as ``comm_model.FABRICS``.
+    """
+
+    inner_bw: float
+    outer_bw: float
+    inner_ways: int
+    outer_ways: int
+    inner_latency_s: float = ICI_HOP_LATENCY_S
+    outer_latency_s: float = DCN_HOP_LATENCY_S
+    inner_label: str = "ici"
+    outer_label: str = "dcn"
+
+    def tier_ways(self, tier: str) -> int:
+        return self.inner_ways if tier == "inner" else self.outer_ways
+
+    def tier_bw(self, tier: str) -> float:
+        return self.inner_bw if tier == "inner" else self.outer_bw
+
+    def tier_time_s(self, nbytes: float, tier: str, hops: int = 0) -> float:
+        """Seconds to move ``nbytes`` per chip over one tier, plus the
+        per-hop latency floor for ``hops`` serialized collective hops
+        (0 = bandwidth term only)."""
+        lat = (
+            self.inner_latency_s if tier == "inner" else self.outer_latency_s
+        )
+        return float(nbytes) / self.tier_bw(tier) + lat * max(int(hops), 0)
+
+    def describe(self) -> str:
+        """One advisory-ready line: both tiers with their group shape and
+        bandwidth — the per-tier numbers a blended scalar cannot carry."""
+        return (
+            f"inner {self.inner_ways}x {self.inner_label} @ "
+            f"{self.inner_bw / 1e9:.2f} GB/s/chip, outer {self.outer_ways}x "
+            f"{self.outer_label} @ {self.outer_bw / 1e9:.2f} GB/s/chip"
+        )
+
+
+def _tier_label(token: str) -> str:
+    return token if token in FABRICS else f"{token}GBps"
+
+
+def resolve_two_tier(
+    fabric: str,
+    *,
+    dcn_ways: int,
+    n_dev: int,
+    n_proc: int = 1,
+) -> TwoTierFabric:
+    """Parse a ``--fabric`` value into a :class:`TwoTierFabric` for a mesh
+    of ``n_dev`` data-parallel chips split into ``dcn_ways`` slow-fabric
+    groups. Grammar in the module docstring; every token reuses
+    :func:`comm_model.resolve_fabric` so the two parsers cannot drift.
+    Raises ValueError (same contract as resolve_fabric) on a bad token or
+    a group shape that does not divide the mesh."""
+    k = int(dcn_ways)
+    n = int(n_dev)
+    if not (1 < k <= n) or n % k:
+        raise ValueError(
+            f"two-tier fabric needs 1 < dcn_ways <= n_dev with "
+            f"dcn_ways | n_dev; got dcn_ways={k}, n_dev={n}"
+        )
+    if fabric == "auto":
+        inner_tok, outer_tok = "ici", "dcn"
+    elif ":" in fabric:
+        inner_tok, _, outer_tok = fabric.partition(":")
+        if not inner_tok or not outer_tok:
+            raise ValueError(
+                f"--fabric {fabric!r}: two-tier form is <inner>:<outer> "
+                "with each side a named preset or a positive GB/s number"
+            )
+    else:
+        # historical single-scalar meaning: the slowest link on the
+        # gradient path = the OUTER tier; inner keeps the ici preset
+        inner_tok, outer_tok = "ici", fabric
+    return TwoTierFabric(
+        inner_bw=resolve_fabric(inner_tok, n_proc=1),
+        outer_bw=resolve_fabric(outer_tok, n_proc=n_proc),
+        inner_ways=n // k,
+        outer_ways=k,
+        inner_label=_tier_label(inner_tok),
+        outer_label=_tier_label(outer_tok),
+    )

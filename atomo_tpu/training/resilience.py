@@ -578,7 +578,10 @@ def diverge_conflict(
             return (
                 "--on-diverge densify cannot compose with --aggregate "
                 "hierarchical (the dense fallback aggregates with a flat "
-                "psum; hierarchical needs a codec); use skip or rewarm"
+                "psum; every two-level topology plan — the legacy "
+                "psum+gather schedule and the re-encoded plans alike — "
+                "needs a codec to compress at least one tier); use skip "
+                "or rewarm"
             )
         if num_aggregate:
             return (

@@ -105,7 +105,8 @@ def winner_knobs(row: dict) -> dict:
     the static-equivalent command must pass)."""
     return {
         k: row[k]
-        for k in ("aggregate", "overlap", "superstep", "ring_bucket_size")
+        for k in ("aggregate", "overlap", "superstep", "ring_bucket_size",
+                  "plan")
         if k in row
     }
 
@@ -175,6 +176,8 @@ def tune(
     allow_overlap: bool = True,
     superstep_options=(1, 8),
     bucket_options=(65536,),
+    dcn_ways: int = 0,
+    plan_names=None,
     probe_top: int = 4,
     probe_steps: int = 3,
     probe_reps: int = 2,
@@ -183,12 +186,22 @@ def tune(
     grad_accum: int = 1,
     compute_dtype=None,
     codec_tax_s: Optional[float] = None,
+    ring_bucket_size: int = 65536,
     context: Optional[dict] = None,
     log_fn=print,
 ) -> dict:
     """Run the startup autopilot; returns the finished decision document
     (also written atomically to ``artifact_path`` when given). Raises
     ValueError on an unresolvable ``fabric`` — the caller owns the exit.
+
+    ``dcn_ways`` > 1 declares a two-tier mesh: the candidate space gains
+    one hierarchical candidate per topology.schedule plan (``plan_names``
+    narrows them), priced per tier by the :class:`TwoTierFabric` resolved
+    from ``fabric`` and probed on the forced ``(dp=K, ici=n/K)`` mesh by
+    the shared runner — the hierarchical/DCN probes the autopilot used to
+    refuse. Flat candidates are then priced at the OUTER tier's bandwidth
+    (the slowest link on their gradient path). The chosen plan lands in
+    the decision artifact's winner knobs.
     """
     import jax
 
@@ -207,7 +220,37 @@ def tune(
     )
 
     t_start = time.perf_counter()
-    bw = resolve_fabric(fabric, n_proc=jax.process_count())
+    fabric2 = None
+    two_tier = int(dcn_ways) > 1 and n_dev > 1 and n_dev % int(dcn_ways) == 0
+    if two_tier:
+        from atomo_tpu.topology.fabric import resolve_two_tier
+
+        fabric2 = resolve_two_tier(
+            fabric, dcn_ways=int(dcn_ways), n_dev=n_dev,
+            n_proc=jax.process_count(),
+        )
+        # flat candidates cross the slow tier end to end: price them at
+        # the OUTER bandwidth, not a blended scalar
+        bw = fabric2.outer_bw
+    else:
+        try:
+            bw = resolve_fabric(fabric, n_proc=jax.process_count())
+        except ValueError:
+            # a two-tier <inner>:<outer> fabric string with a flat
+            # candidate space (e.g. the CLI excluded the hierarchical
+            # candidates for densify/num-aggregate, or dcn_ways does not
+            # divide the mesh): flat candidates cross the slow tier end
+            # to end, so price them at the OUTER token — do not reject a
+            # valid two-tier string with the single-scalar usage line
+            if ":" not in fabric:
+                raise
+            outer_tok = fabric.rpartition(":")[2]
+            bw = resolve_fabric(outer_tok, n_proc=jax.process_count())
+            log_fn(
+                f"Autopilot: two-tier --fabric {fabric!r} with a flat "
+                "candidate space; pricing flat candidates at the outer "
+                f"tier ({outer_tok})"
+            )
     dense_b, payload_b = byte_budget(codec, model_init_fn)
     backend = jax.default_backend()
     dispatch_s = DISPATCH_ANCHOR_S.get(backend, 5e-4)
@@ -219,6 +262,8 @@ def tune(
         allow_overlap=allow_overlap,
         superstep_options=superstep_options,
         bucket_options=bucket_options,
+        dcn_ways=int(dcn_ways) if two_tier else 0,
+        plan_names=plan_names,
     )
     ranked = rank_candidates(
         cands,
@@ -228,6 +273,7 @@ def tune(
         fabric_bw=bw,
         tax_s=codec_tax_s,
         dispatch_s=dispatch_s,
+        fabric2=fabric2,
     )
     pb = probe_batch_size(batch, n_dev)
     meta = {
@@ -235,6 +281,14 @@ def tune(
         "n_devices": n_dev,
         "fabric": fabric,
         "fabric_gbps_per_chip": round(bw / 1e9, 3),
+        **(
+            {
+                "dcn_ways": int(dcn_ways),
+                "two_tier_fabric": fabric2.describe(),
+            }
+            if fabric2 is not None
+            else {}
+        ),
         "dense_mb": round(dense_b / 1e6, 3),
         "payload_mb": round(payload_b / 1e6, 3),
         "batch": pb,
@@ -257,7 +311,7 @@ def tune(
             k: v
             for k, v in cand.items()
             if k in ("aggregate", "overlap", "superstep",
-                     "ring_bucket_size", "name")
+                     "ring_bucket_size", "plan", "name")
         }
         try:
             row = probe_candidate(
@@ -276,6 +330,12 @@ def tune(
                 zero1=zero1,
                 grad_accum=grad_accum,
                 compute_dtype=compute_dtype,
+                dcn_ways=int(dcn_ways) if two_tier else 0,
+                # the fallback for candidates that carry no explicit
+                # ring_bucket_size knob (the hierarchical plans' ring
+                # tiers): probe at the value the run will execute with,
+                # not the builder default
+                ring_bucket_size=ring_bucket_size,
             )
         except Exception as exc:  # noqa: BLE001 — one candidate failing
             # to compile/execute (OOM, a backend quirk) must not abort the

@@ -174,6 +174,7 @@ def probe_candidate(
     grad_accum: int = 1,
     compute_dtype=None,
     ring_bucket_size: int = 65536,
+    dcn_ways: int = 0,
 ) -> dict:
     """Measure one candidate knob vector: build the REAL step program the
     train path would run (same builders, same knobs — zero1 / grad_accum
@@ -181,7 +182,13 @@ def probe_candidate(
     program's speed; guard/chaos/remedy stay off, they are correctness
     machinery, not a performance knob) and time it with the fence
     discipline. Returns the probe row (measured ms/step per OPTIMIZER
-    step — a superstep-K program's one dispatch covers K of them)."""
+    step — a superstep-K program's one dispatch covers K of them).
+
+    Hierarchical candidates (``aggregate='hierarchical'`` + a ``plan``
+    knob) probe on the two-tier mesh ``(dp=dcn_ways, ici=n_dev/dcn_ways)``
+    through the same builder the train path uses (inner_axis='ici',
+    topology plan attached) — the probes `--auto tune` was missing on
+    ``--dcn-ways`` meshes."""
     import jax
     import jax.numpy as jnp
 
@@ -210,6 +217,7 @@ def probe_candidate(
 
         def call():
             box["st"], m = step(box["st"], key, im, lb)
+            box["m"] = m
             return m["loss"]
 
     else:
@@ -223,17 +231,38 @@ def probe_candidate(
         from atomo_tpu.parallel.replicated import shard_superbatch
         from atomo_tpu.training import create_state
 
-        mesh = make_mesh(n_dev)
+        agg = cand.get("aggregate", "gather")
+        overlap = cand.get("overlap", "off")
+        plan = None
+        inner_axis = None
+        batch_axes = "dp"
+        if agg == "hierarchical":
+            from atomo_tpu.topology.schedule import plan_from_name
+
+            kw = int(dcn_ways)
+            if not (1 < kw <= n_dev) or n_dev % kw:
+                raise ValueError(
+                    f"hierarchical candidate needs dcn_ways dividing "
+                    f"n_dev; got dcn_ways={kw}, n_dev={n_dev}"
+                )
+            mesh = make_mesh(
+                n_dev, axes=(("dp", kw), ("ici", n_dev // kw))
+            )
+            plan = plan_from_name(cand.get("plan", "legacy"))
+            inner_axis = "ici"
+            batch_axes = ("dp", "ici")
+        else:
+            mesh = make_mesh(n_dev)
         state = create_state(
             model, optimizer, jax.random.PRNGKey(seed), images
         )
-        agg = cand.get("aggregate", "gather")
-        overlap = cand.get("overlap", "off")
         zero1_specs = None
         if zero1:
             from atomo_tpu.parallel.replicated import zero1_state
 
-            state, zero1_specs = zero1_state(mesh, state, optimizer)
+            state, zero1_specs = zero1_state(
+                mesh, state, optimizer, axis=batch_axes
+            )
         else:
             state = replicate_state(mesh, state)
         step = make_distributed_train_step(
@@ -242,19 +271,21 @@ def probe_candidate(
             compute_dtype=compute_dtype, zero1_specs=zero1_specs,
             grad_accum=grad_accum, superstep=k, overlap=overlap,
             ring_bucket_size=cand.get("ring_bucket_size", ring_bucket_size),
+            inner_axis=inner_axis, plan=plan,
         )
         if overlap == "delayed":
             state = init_delayed_state(mesh, state, codec)
         if k > 1:
             im_k = jnp.broadcast_to(images, (k,) + images.shape)
             lb_k = jnp.broadcast_to(labels, (k,) + labels.shape)
-            im, lb = shard_superbatch(mesh, im_k, lb_k)
+            im, lb = shard_superbatch(mesh, im_k, lb_k, axis=batch_axes)
         else:
-            im, lb = shard_batch(mesh, images, labels)
+            im, lb = shard_batch(mesh, images, labels, axis=batch_axes)
         box = {"st": state}
 
         def call():
             box["st"], m = step(box["st"], key, im, lb)
+            box["m"] = m
             return m["loss"]
 
     t0 = time.perf_counter()
@@ -268,6 +299,19 @@ def probe_candidate(
         "sync_ok": sync_ok,
         "probed": True,
     }
+    m = box.get("m")
+    if m is not None and "msg_bytes" in m:
+        # the executed program's OWN byte accounting (per-chip message on
+        # the scarcest fabric + dense gradient size) — what bench config
+        # 11 compares the comm model's predictions against
+        import numpy as np
+
+        row["measured_msg_bytes"] = int(
+            np.ravel(jax.device_get(m["msg_bytes"]))[-1]
+        )
+        row["measured_dense_bytes"] = int(
+            np.ravel(jax.device_get(m["dense_bytes"]))[-1]
+        )
     return row
 
 

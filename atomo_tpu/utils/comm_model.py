@@ -308,7 +308,12 @@ def resolve_fabric(fabric: str, *, n_proc: int = 1) -> float:
     finite per-chip GB/s number. ONE parser for the CLI's ``--aggregate
     auto`` advisory and the autopilot's predictor, so the two surfaces
     cannot disagree about what a fabric string means. Raises ValueError
-    with the usage line on anything else."""
+    with the usage line on anything else.
+
+    A single scalar prices every hop at one bandwidth — on a two-tier
+    mesh that is the OUTER (slowest) tier by convention, and per-tier
+    arithmetic lives in ``topology.fabric.resolve_two_tier``, which
+    reuses this grammar per tier token."""
     if fabric == "auto":
         return FABRICS["dcn" if n_proc > 1 else "ici"]
     if fabric in FABRICS:
@@ -363,9 +368,14 @@ def estimate_compute_s(dense_bytes: float) -> float:
 
 def candidate_name(cand: dict) -> str:
     """Stable display/sort key for a knob vector (also the tie-break of
-    last resort in the autopilot's winner selection — deterministic)."""
+    last resort in the autopilot's winner selection — deterministic).
+    Hierarchical candidates carry their topology.schedule plan inline:
+    ``hier[psum+ring]+off+k1``."""
     bits = []
-    if cand.get("aggregate"):
+    if cand.get("aggregate") == "hierarchical":
+        bits.append(f"hier[{cand.get('plan', 'legacy')}]")
+        bits.append(cand.get("overlap", "off"))
+    elif cand.get("aggregate"):
         bits.append(cand["aggregate"])
         bits.append(cand.get("overlap", "off"))
     bits.append(f"k{cand.get('superstep', 1)}")
@@ -383,6 +393,8 @@ def enumerate_candidates(
     allow_overlap: bool = True,
     superstep_options=(1, 8),
     bucket_options=(65536,),
+    dcn_ways: int = 0,
+    plan_names=None,
 ) -> list[dict]:
     """The autopilot's candidate knob vectors, conflict-free by
     construction (the same compatibility matrix ``_argv_preflight`` and
@@ -390,7 +402,14 @@ def enumerate_candidates(
     code has only psum, ``delayed`` exists only for the compressed
     gather/ring exchanges. The caller narrows further via the allow_*
     flags (e.g. ``--num-aggregate`` excludes psum, ``--on-diverge
-    densify`` and ``--zero1`` exclude delayed)."""
+    densify`` and ``--zero1`` exclude delayed).
+
+    ``dcn_ways`` > 1 (a multi-tier mesh: ``--dcn-ways`` groups over the
+    slow fabric) additionally emits one hierarchical candidate per
+    topology.schedule plan (``plan_names`` narrows the plan space) —
+    the PR-8 lift of the autopilot's hierarchical exclusion. They carry
+    no delayed form (the two-level schedules are blocking) and require a
+    codec (the plans compress at least one tier)."""
     ks = sorted({max(int(k), 1) for k in superstep_options})
     out: list[dict] = []
     if ways <= 1:
@@ -421,6 +440,25 @@ def enumerate_candidates(
                         if b is not None:
                             c["ring_bucket_size"] = b
                         out.append(c)
+    if (
+        has_codec
+        and ways > 1
+        and int(dcn_ways) > 1
+        and ways % int(dcn_ways) == 0
+    ):
+        from atomo_tpu.topology.schedule import PLAN_NAMES
+
+        names = PLAN_NAMES if plan_names is None else tuple(plan_names)
+        for pname in names:
+            for k in ks:
+                out.append(
+                    {
+                        "aggregate": "hierarchical",
+                        "plan": pname,
+                        "overlap": "off",
+                        "superstep": k,
+                    }
+                )
     for c in out:
         c["name"] = candidate_name(c)
     return out
@@ -436,6 +474,7 @@ def predict_step_s(
     compute_s: float | None = None,
     tax_s: float | None = None,
     dispatch_s: float = 0.0,
+    fabric2=None,
 ) -> float:
     """Model one candidate's synchronous step time (seconds).
 
@@ -448,12 +487,39 @@ def predict_step_s(
     tax (encode + decode round trip) is split evenly across the two ends
     — the anchor measures only their sum. All the byte formulas are the
     honest-accounting ones above; the anchors are stated estimates the
-    probe ladder corrects."""
+    probe ladder corrects.
+
+    Hierarchical candidates (a ``plan`` knob) are priced PER TIER by
+    ``topology.schedule.predict_plan_step_s`` and require ``fabric2`` (a
+    :class:`~atomo_tpu.topology.fabric.TwoTierFabric`); on a two-tier
+    mesh the flat candidates' ``fabric_bw`` should be the OUTER tier's
+    bandwidth — the slowest link on their gradient path."""
     dense_bytes = float(dense_bytes)
     if compute_s is None:
         compute_s = estimate_compute_s(dense_bytes)
     ways = int(ways)
     k = max(int(cand.get("superstep", 1)), 1)
+    if cand.get("aggregate") == "hierarchical":
+        from atomo_tpu.topology.schedule import (
+            plan_from_name,
+            predict_plan_step_s,
+        )
+
+        if fabric2 is None:
+            raise ValueError(
+                "hierarchical candidates need fabric2 (a TwoTierFabric); "
+                "build one with topology.fabric.resolve_two_tier"
+            )
+        return predict_plan_step_s(
+            plan_from_name(cand.get("plan", "legacy")),
+            dense_bytes=dense_bytes,
+            payload_bytes=float(payload_bytes),
+            fabric=fabric2,
+            compute_s=compute_s,
+            tax_s=tax_s,
+            dispatch_s=dispatch_s,
+            superstep=k,
+        )
     if ways <= 1:
         # no exchange; the codec round trip still runs when armed (the
         # caller models the single-device compression-study step)
@@ -493,10 +559,12 @@ def rank_candidates(
     compute_s: float | None = None,
     tax_s: float | None = None,
     dispatch_s: float = 0.0,
+    fabric2=None,
 ) -> list[dict]:
     """Candidates + their predicted ms/step, best first (ties broken by
     name so the order — and therefore which candidates get probed — is
-    deterministic for a given context)."""
+    deterministic for a given context). ``fabric2`` prices any
+    hierarchical candidates per tier (see :func:`predict_step_s`)."""
     rows = []
     for c in cands:
         s = predict_step_s(
@@ -508,6 +576,7 @@ def rank_candidates(
             compute_s=compute_s,
             tax_s=tax_s,
             dispatch_s=dispatch_s,
+            fabric2=fabric2,
         )
         rows.append({**c, "predicted_ms_per_step": round(s * 1e3, 4)})
     rows.sort(key=lambda r: (r["predicted_ms_per_step"], r["name"]))

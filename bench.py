@@ -153,6 +153,23 @@ CONFIGS = {
     # (ATOMO_SCENARIO_BUDGET_S) skips-and-records instead of overrunning.
     10: dict(metric="scenario_matrix", kind="scenarios", batch=8, n_dev=4,
              ways=4, force_cpu_mesh=True),
+    # Config 11 (PR-8 topology tentpole): two_tier_matrix — planned
+    # hierarchical schedules on the forced (2x2) CPU mesh (dp=2 slow-
+    # fabric groups x ici=2 fast chips). Per plan: fenced measured
+    # ms/step through the SAME probe runner `--auto tune` uses, the
+    # two-tier comm model's predicted step time + PER-TIER predicted
+    # wire bytes vs the executed program's own byte accounting
+    # (measured_msg_bytes / runtime encode stats), and the per-plan
+    # aggregation-operator bit-parity assert against the canonical
+    # unfused decode-order oracle (topology.execute.two_level_mean_host)
+    # — the invariant that makes every plan trajectory-safe. Also runs a
+    # mini `tune()` with dcn_ways=2 so the row carries a probed decision
+    # artifact naming hierarchical candidates. Semantics + model-honesty
+    # evidence, not a chip-speed claim (CPU "fabric" has no tiers; the
+    # step-time calibration field says how far the model is). Baseline
+    # "none"; fast mode keeps two plans and a two-plan tune space.
+    11: dict(metric="two_tier_matrix", kind="twotier", batch=8, n_dev=4,
+             ways=4, dcn_ways=2, force_cpu_mesh=True),
 }
 
 # Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
@@ -1187,6 +1204,328 @@ def measure_scenarios(cfg: dict) -> dict:
     return out
 
 
+def two_tier_parity(mesh, codec, plan, grads_by_chip, step_key,
+                    n_outer: int, n_inner: int,
+                    bucket_size: int = 65536) -> bool:
+    """Per-plan twin of :func:`gather_vs_ring_parity`: the executed
+    two-level operator (topology.execute.planned_two_level_mean, outer
+    gather forced to the canonical unfused decode order) must be
+    BIT-identical to the canonical decode-order oracle in SPMD form
+    (two_level_canonical_mean: gather + unfused decode at every
+    compressed tier — the ring-vs-gather precedent, SPMD program against
+    SPMD program) over the same per-chip gradients and keys.
+    tests/test_topology.py is the full oracle; this is config 11's
+    in-row evidence."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from atomo_tpu.topology.execute import (
+        inner_codec_key,
+        outer_codec_key,
+        planned_two_level_mean,
+        two_level_canonical_mean,
+    )
+
+    axis, inner_axis = mesh.axis_names[0], mesh.axis_names[1]
+
+    def make_fn(canonical):
+        def fn(x):
+            o = jax.lax.axis_index(axis)
+            my = o * n_inner + jax.lax.axis_index(inner_axis)
+            grads = jax.lax.switch(
+                my,
+                [lambda c=c: grads_by_chip[c]
+                 for c in range(len(grads_by_chip))],
+            )
+            ki = inner_codec_key(step_key, my)
+            ko = outer_codec_key(step_key, o)
+            if canonical:
+                return two_level_canonical_mean(
+                    codec, plan, grads, ki, ko,
+                    axis=axis, inner_axis=inner_axis,
+                    n_inner=n_inner, n_outer=n_outer,
+                )
+            mean, _, _, _ = planned_two_level_mean(
+                codec, plan, grads, ki, ko,
+                axis=axis, inner_axis=inner_axis,
+                n_inner=n_inner, n_outer=n_outer,
+                ring_bucket_size=bucket_size, unfused_decode=True,
+            )
+            return mean
+
+        return fn
+
+    def run(fn):
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(P((axis, inner_axis)),), out_specs=P(),
+            check_vma=False,
+        ))(jnp.zeros((n_outer * n_inner,)))
+
+    got = run(make_fn(False))
+    want = run(make_fn(True))
+    return bool(all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(got)),
+            jax.tree_util.tree_leaves(jax.device_get(want)),
+        )
+    ))
+
+
+def measure_two_tier(cfg: dict) -> dict:
+    """Config-11: the two-tier topology matrix (plan-space evidence).
+
+    Every plan is measured by the SAME probe runner ``--auto tune`` uses
+    (tuning.probe.probe_candidate with ``dcn_ways`` — real two-tier step
+    builders, fenced dispatch loops). The row records, per plan: measured
+    vs predicted ms/step (the two-tier comm model, calibration warning
+    attached when they disagree >2x — on a CPU mesh they will, the row
+    says so instead of hiding it), PER-TIER predicted wire bytes vs the
+    executed program's own byte accounting, and the bit-parity assert
+    against the canonical decode-order oracle. A mini ``tune()`` with
+    ``dcn_ways`` lands a probed decision artifact naming hierarchical
+    candidates in-row."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import QsgdCodec, encode_tree
+    from atomo_tpu.models import get_model
+    from atomo_tpu.parallel import make_mesh
+    from atomo_tpu.topology.fabric import resolve_two_tier
+    from atomo_tpu.topology.schedule import (
+        PLAN_NAMES,
+        plan_from_name,
+        plan_wire_bytes,
+        predict_plan_step_s,
+    )
+    from atomo_tpu.training import create_state, make_optimizer
+    from atomo_tpu.tuning.autopilot import tune as autopilot_tune
+    from atomo_tpu.tuning.probe import (
+        byte_budget,
+        model_init_fn,
+        probe_candidate,
+    )
+    from atomo_tpu.utils.comm_model import (
+        calibration_warning,
+        ring_allgather_wire_bytes,
+        ring_allreduce_wire_bytes,
+        ring_stream_wire_bytes,
+    )
+
+    fast = os.environ.get("ATOMO_BENCH_FAST") == "1"
+    dev = jax.devices()[0]
+    n_mesh = min(int(cfg.get("n_dev", 4)), len(jax.devices()))
+    k_dcn = int(cfg.get("dcn_ways", 2))
+    batch = int(cfg.get("batch", 8))
+    steps = _env_int("ATOMO_BENCH_STEPS", 3 if fast else 5)
+    reps = 1 if fast else 2
+    shape = (28, 28, 1)
+    plans = ("psum+gather", "cring+ring") if fast else PLAN_NAMES
+
+    base = dict(
+        metric=cfg["metric"], unit="ms/step", value=None,
+        vs_baseline=None, baseline="none", byte_reduction=None, mfu=None,
+        flops_per_step=None, peak_tflops=None, platform=dev.platform,
+        device=dev.device_kind, ways=n_mesh, chips_measured=n_mesh,
+        timing="dispatch-loop-scalar-fenced",
+        config=dict(kind="twotier", batch=batch, n_dev=n_mesh,
+                    dcn_ways=k_dcn, steps=steps, plans=list(plans)),
+        note=(f"planned two-level schedules on a forced ({k_dcn}x"
+              f"{n_mesh // max(k_dcn, 1)}) {dev.platform} mesh; semantics "
+              "+ per-tier model-honesty evidence, not a chip-speed row "
+              "(a CPU mesh has no real tiers — the calibration fields "
+              "say how far the analytic model is here)"),
+    )
+    if n_mesh < 4 or k_dcn < 2 or n_mesh % k_dcn:
+        base.update(
+            measurement_valid=False,
+            invalid_reason=f"need a (dcn x ici) mesh; have {n_mesh} devices",
+        )
+        return base
+
+    out = dict(base, measurement_valid=True, invalid_reason=None)
+    n_inner = n_mesh // k_dcn
+    fabric2 = resolve_two_tier("auto", dcn_ways=k_dcn, n_dev=n_mesh)
+    out["fabric"] = fabric2.describe()
+    try:
+        model = get_model("lenet", 10)
+        opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+        codec = QsgdCodec(bits=8, bucket_size=512)
+        sample = jnp.zeros((1,) + shape, jnp.float32)
+        dense_b, payload_b = byte_budget(codec, model_init_fn(model, sample))
+        out["byte_reduction"] = round(dense_b / payload_b, 2)
+
+        # real per-chip gradient trees for the parity oracle + the
+        # runtime byte accounting (shaped like the params, distinct data)
+        params = jax.device_get(
+            create_state(model, opt, jax.random.PRNGKey(0),
+                         jnp.zeros((batch,) + shape)).params
+        )
+        grads_by_chip = [
+            jax.tree_util.tree_map(
+                lambda a, c=c: jax.random.normal(
+                    jax.random.fold_in(jax.random.PRNGKey(7), c),
+                    a.shape, jnp.float32,
+                ),
+                params,
+            )
+            for c in range(n_mesh)
+        ]
+        # payload accounting over the REAL gradient trees (vs the byte
+        # budget's model-init eval_shape) — the "measured" side of the
+        # inner-tier byte comparison
+        from atomo_tpu.codecs import tree_nbytes as _tree_nbytes
+
+        payload_rt = _tree_nbytes(jax.eval_shape(
+            lambda g: encode_tree(codec, jax.random.PRNGKey(1), g)[0],
+            grads_by_chip[0],
+        ))
+        mesh2 = make_mesh(n_mesh, axes=(("dcn", k_dcn), ("ici", n_inner)))
+        step_key = jax.random.PRNGKey(11)
+
+        rows = []
+        parities_ok = True
+        for pname in plans:
+            plan = plan_from_name(pname)
+            cand = {
+                "aggregate": "hierarchical", "plan": pname,
+                "overlap": "off", "superstep": 1, "name": f"hier[{pname}]",
+            }
+            probe = probe_candidate(
+                cand, model=model, optimizer=opt, codec=codec,
+                n_dev=n_mesh, sample_shape=shape, num_classes=10,
+                batch=batch, steps=steps, reps=reps, dcn_ways=k_dcn,
+            )
+            pred_s = predict_plan_step_s(
+                plan, dense_bytes=dense_b, payload_bytes=payload_b,
+                fabric=fabric2,
+            )
+            wires = plan_wire_bytes(
+                plan, dense_bytes=dense_b, payload_bytes=payload_b,
+                fabric=fabric2,
+            )
+            # measured per-tier wire bytes: the same honest-accounting
+            # formulas applied to the EXECUTED program's byte accounting
+            # (its msg_bytes metric on the slow tier; the runtime encode
+            # stats on the fast tier) — must agree with the eval_shape
+            # prediction or the model is lying about this program
+            msg_meas = probe.get("measured_msg_bytes")
+            dense_meas = probe.get("measured_dense_bytes", dense_b)
+            if plan.inner == "psum":
+                inner_meas = ring_allreduce_wire_bytes(dense_meas, n_inner)
+            else:
+                inner_meas = ring_stream_wire_bytes(
+                    payload_rt, dense_meas, n_inner
+                )
+            if plan.outer == "gather":
+                outer_meas = ring_allgather_wire_bytes(msg_meas, k_dcn)
+            elif plan.outer == "ring":
+                outer_meas = ring_stream_wire_bytes(
+                    msg_meas, dense_meas, k_dcn
+                )
+            else:  # dense fallback: msg_bytes IS the dense gradient
+                outer_meas = ring_allreduce_wire_bytes(msg_meas, k_dcn)
+            tiers = {
+                "inner": {
+                    "predicted_mb": round(wires["inner_bytes"] / 1e6, 4),
+                    "measured_mb": round(inner_meas / 1e6, 4),
+                    "predicted_ms": round(
+                        fabric2.tier_time_s(
+                            wires["inner_bytes"], "inner",
+                            wires["inner_hops"],
+                        ) * 1e3, 4,
+                    ),
+                },
+                "outer": {
+                    "predicted_mb": round(wires["outer_bytes"] / 1e6, 4),
+                    "measured_mb": round(outer_meas / 1e6, 4),
+                    "predicted_ms": round(
+                        fabric2.tier_time_s(
+                            wires["outer_bytes"], "outer",
+                            wires["outer_hops"],
+                        ) * 1e3, 4,
+                    ),
+                },
+            }
+            bytes_match = (
+                abs(tiers["inner"]["predicted_mb"]
+                    - tiers["inner"]["measured_mb"]) < 1e-3
+                and abs(tiers["outer"]["predicted_mb"]
+                        - tiers["outer"]["measured_mb"]) < 1e-3
+            )
+            if not bytes_match:
+                _mark_invalid(
+                    out,
+                    f"plan {pname}: comm-model per-tier wire bytes "
+                    "disagree with the executed program's accounting",
+                )
+            parity = two_tier_parity(
+                mesh2, codec, plan, grads_by_chip, step_key,
+                n_outer=k_dcn, n_inner=n_inner,
+            )
+            parities_ok &= parity
+            if not parity:
+                _mark_invalid(
+                    out,
+                    f"plan {pname}: executed operator is NOT bit-identical "
+                    "to the canonical decode-order oracle",
+                )
+            if not probe.get("sync_ok", True):
+                _mark_invalid(
+                    out, f"plan {pname}: fence scalar not finite"
+                )
+            rows.append({
+                "plan": pname,
+                "ms_per_step": probe["measured_ms_per_step"],
+                "predicted_ms_per_step": round(pred_s * 1e3, 4),
+                "calibration": calibration_warning(
+                    pred_s, probe["measured_ms_per_step"] / 1e3,
+                    label=f"plan {pname}",
+                ),
+                "tiers": tiers,
+                "tier_bytes_match": bytes_match,
+                "aggregation_bit_parity": parity,
+                "sync_ok": probe.get("sync_ok"),
+            })
+        out["plans"] = rows
+        out["aggregation_bit_parity"] = parities_ok
+        legacy = next((r for r in rows if r["plan"] == "psum+gather"), None)
+        if legacy is not None:
+            out["value"] = legacy["ms_per_step"]
+
+        # the probed autopilot decision on the same two-tier mesh: a very
+        # slow outer fabric makes the hierarchical candidates the
+        # predicted front-runners, so the probed set names them
+        tune_doc = autopilot_tune(
+            model=model, optimizer=opt, codec=codec,
+            model_init_fn=model_init_fn(model, sample), n_dev=n_mesh,
+            sample_shape=shape, num_classes=10, batch=batch,
+            fabric="ici:0.05", dcn_ways=k_dcn,
+            plan_names=plans if fast else None,
+            allow_psum=False, allow_overlap=False, allow_ring=False,
+            superstep_options=(1,), probe_top=2, probe_steps=steps,
+            probe_reps=1, log_fn=lambda m: print(m, file=sys.stderr),
+        )
+        probed = [r["name"] for r in tune_doc["rows"] if r.get("probed")]
+        hier_probed = [n for n in probed if n.startswith("hier[")]
+        out["tune_decision"] = {
+            "winner": tune_doc.get("winner"),
+            "why": tune_doc.get("why"),
+            "probed": probed,
+            "hierarchical_probed": hier_probed,
+        }
+        if not hier_probed:
+            _mark_invalid(
+                out, "mini-tune probed no hierarchical candidate"
+            )
+    except Exception as exc:  # noqa: BLE001 — a failed matrix is a failed row
+        _mark_invalid(out, f"two-tier matrix failed: {str(exc)[:200]}")
+    return out
+
+
 def measure_ours(cfg: dict) -> dict:
     import jax
     import jax.numpy as jnp
@@ -1205,6 +1544,8 @@ def measure_ours(cfg: dict) -> dict:
         return measure_overlap_compare(cfg)
     if cfg.get("kind") == "scenarios":
         return measure_scenarios(cfg)
+    if cfg.get("kind") == "twotier":
+        return measure_two_tier(cfg)
 
     model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# Bench smoke (~6 min): prove the bench entrypoint still emits parseable
-# evidence without burning the full-ladder window. Six checks:
+# Bench smoke (~7 min): prove the bench entrypoint still emits parseable
+# evidence without burning the full-ladder window. Seven checks:
 #
 #   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
 #      the driver's last-line JSON contract, PLUS the partial-artifact
@@ -28,6 +28,13 @@
 #      tune_decision.json that parses, names a winner, and records
 #      predicted AND measured ms/step for every probed candidate —
 #      the PR-7 probe-driven config selection.
+#
+#   7. the topology contract (<60 s, forced (2x2) CPU mesh): bench
+#      config 11 runs planned hierarchical schedules through the probe
+#      runner and must exit 0 with the in-row per-plan operator
+#      bit-parity assert TRUE, per-tier predicted-vs-measured wire
+#      bytes matching, and a probed mini-tune decision naming
+#      hierarchical candidates — the PR-8 two-tier plan space.
 #
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
@@ -64,7 +71,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/6]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/7]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -93,7 +100,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/6]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/7]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -130,7 +137,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/6]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/7]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -161,7 +168,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/6]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/7]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -188,7 +195,7 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/6]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/7]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
 EOF
 [ $? -ne 0 ] && exit 1
@@ -221,8 +228,53 @@ for r in probed:
     assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
     assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
 assert doc.get("why"), doc
-print(f"bench_smoke OK[6/6]: --auto tune picked {win['name']} "
+print(f"bench_smoke OK[6/7]: --auto tune picked {win['name']} "
       f"({win.get('measured_ms_per_step')} ms/step measured, "
       f"{len(probed)}/{len(doc['rows'])} candidates probed); "
       "decision artifact parses")
+EOF
+[ $? -ne 0 ] && exit 1
+
+# --- 7: config 11, two-tier planned-schedule contract --------------------
+out=$(timeout -k 5 150 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=340 \
+      ATOMO_BENCH_ARTIFACT="$art/c11.json" \
+      python bench.py --config 11 --no-baseline 2>/dev/null)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: config 11 exited rc=$rc (timeout or crash)"
+  exit 1
+fi
+printf '%s\n' "$out" > "$art/c11.out"
+python - "$art/c11.out" <<'EOF'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+assert lines, "bench_smoke FAIL: config 11 emitted no JSON"
+row = json.loads(lines[-1])
+assert row["metric"] == "two_tier_matrix", row
+assert row["measurement_valid"], row.get("invalid_reason")
+# the planned-schedule semantics contract: every probed plan's operator
+# is bit-identical to the canonical decode-order oracle, and the comm
+# model's per-tier wire bytes agree with the executed program's own
+# byte accounting
+assert row["aggregation_bit_parity"] is True, row
+plans = row.get("plans") or []
+assert plans, row
+for p in plans:
+    assert p["aggregation_bit_parity"] is True, p
+    assert p["tier_bytes_match"] is True, p
+    for tier in ("inner", "outer"):
+        t = p["tiers"][tier]
+        assert isinstance(t.get("predicted_mb"), (int, float)), p
+        assert isinstance(t.get("measured_mb"), (int, float)), p
+    assert isinstance(p.get("ms_per_step"), (int, float)), p
+    assert isinstance(p.get("predicted_ms_per_step"), (int, float)), p
+td = row.get("tune_decision") or {}
+assert td.get("hierarchical_probed"), row
+print(f"bench_smoke OK[7/7]: two-tier plans "
+      f"{[p['plan'] for p in plans]} measured with per-tier "
+      "predicted-vs-measured bytes matching, per-plan bit_parity=True; "
+      f"mini-tune probed {td['hierarchical_probed']} "
+      f"(winner {(td.get('winner') or {}).get('name')})")
 EOF

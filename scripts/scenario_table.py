@@ -58,8 +58,20 @@ def _budgets(network: str, shape) -> dict:
     }
 
 
-def model_only_recs(ways: int) -> dict:
-    """{network: {fabric: recommendation}} from the stated anchors."""
+def model_only_recs(ways: int, dcn_ways: int = 2) -> dict:
+    """{network: {fabric: recommendation}} from the stated anchors.
+
+    Besides the three single-fabric columns, each network gets a TWO-TIER
+    row (``ici:dcn 2-tier``): the topology planner's best plan per codec
+    over a ``(dcn_ways x ways/dcn_ways)`` mesh
+    (topology.schedule.recommend_two_tier — the same row shape, so one
+    renderer serves both). Caveats, stated: the two-tier numbers use the
+    SAME size-scaled single-chip anchors as the flat rows plus the
+    fabric module's per-hop latency estimates; they order plans, they do
+    not promise wall-clock — bench config 11 carries the measured
+    evidence and its calibration fields."""
+    from atomo_tpu.topology.fabric import resolve_two_tier
+    from atomo_tpu.topology.schedule import recommend_two_tier
     from atomo_tpu.utils.comm_model import (
         FABRICS,
         estimate_codec_tax_s,
@@ -86,6 +98,14 @@ def model_only_recs(ways: int) -> dict:
             )
             for label, bw in sorted(FABRICS.items())
         }
+        if 1 < dcn_ways <= ways and ways % dcn_ways == 0:
+            recs[net][f"ici:dcn 2-tier (K={dcn_ways})"] = recommend_two_tier(
+                codec_budgets=budgets,
+                measured_ms=measured,
+                fabric=resolve_two_tier(
+                    "auto", dcn_ways=dcn_ways, n_dev=ways
+                ),
+            )
     return recs
 
 
@@ -124,6 +144,9 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ways", type=int, default=8,
                     help="modeled mesh width for the fabric term")
+    ap.add_argument("--dcn-ways", type=int, default=2,
+                    help="slow-fabric groups for the two-tier column "
+                         "(0 disables it; must divide --ways)")
     ap.add_argument("--from-bench", type=str, default="",
                     help="read recommendations from a bench "
                          "scenario_matrix row / artifact instead of the "
@@ -147,8 +170,12 @@ def main() -> int:
         print(render(row["recommendations"], ways,
                      f"measured anchors, {args.from_bench}"))
         return 0
-    print(render(model_only_recs(args.ways), args.ways,
-                 "model-only anchors, artifacts/BENCH_ONCHIP_r3.md"))
+    print(render(model_only_recs(args.ways, dcn_ways=args.dcn_ways),
+                 args.ways,
+                 "model-only anchors, artifacts/BENCH_ONCHIP_r3.md; "
+                 "2-tier rows: topology planner over the same anchors + "
+                 "stated latency estimates — ordering only, measured "
+                 "evidence is bench config 11"))
     return 0
 
 
