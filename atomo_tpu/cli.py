@@ -221,9 +221,43 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                         "(0 = keep all)")
     t.add_argument("--chaos", type=str, default="", metavar="SPEC",
                    help="fault-injection spec for drills, e.g. "
-                        "'nan@3,kill@6,truncate@4,spike@5:3,crashloop@2' "
-                        "(see utils/chaos.py); defaults to the ATOMO_CHAOS "
-                        "env var")
+                        "'nan@3,kill@6,truncate@4,spike@5:3,crashloop@2,"
+                        "die@5:1' (die@S:R = replica R stops contributing "
+                        "from step S onward — the elastic membership "
+                        "drill; needs --grad-guard and a multi-device "
+                        "mesh; see utils/chaos.py); defaults to the "
+                        "ATOMO_CHAOS env var")
+    t.add_argument("--elastic", action="store_true", default=False,
+                   help="elastic world size: track membership epochs in "
+                        "train-dir/membership.json, carry a persistently "
+                        "guard-masked replica as an unbiased "
+                        "survivors-only mean (needs --grad-guard), and at "
+                        "the next checkpoint boundary SHRINK the world to "
+                        "the surviving roster — exit code 29 tells the "
+                        "--max-restarts supervisor to re-exec with "
+                        "--n-devices N-1 (a planned reshape, never "
+                        "charged against the restart budget) and "
+                        "re-shard the data stream deterministically. "
+                        "Bit-exact per membership epoch: the shrunken leg "
+                        "matches a fresh --n-devices N-1 run resumed "
+                        "from the same checkpoint (tested). Flat "
+                        "gather/ring/psum meshes only; conflicts with "
+                        "--zero1, --overlap delayed, --aggregate "
+                        "hierarchical, --phase-metrics")
+    t.add_argument("--elastic-patience", type=int, default=6, metavar="N",
+                   help="consecutive guard-masked steps before a replica "
+                        "is declared absent (one masked step is a "
+                        "transient screen hit, not a dead member)")
+    t.add_argument("--readmit-at", type=int, default=0, metavar="S",
+                   help="with --elastic: once past step S, a "
+                        "below-strength world re-grows to the full "
+                        "roster at the next checkpoint boundary "
+                        "(restart from the newest checkpoint, shard map "
+                        "re-derived; membership epoch bumped). 0 = no "
+                        "automatic re-admission. At most ONE automatic "
+                        "re-grow per job (counted in membership.json): a "
+                        "member that dies again after re-admission stays "
+                        "out — re-grow by hand")
     t.add_argument("--on-diverge", type=str, default="off",
                    choices=["off", "skip", "rewarm", "densify"],
                    help="arm the divergence doctor: a windowed robust "
@@ -518,6 +552,22 @@ def _diverged_exit(exc: Exception) -> int:
     return ROLLBACK_EXIT_CODE
 
 
+def _membership_exit(exc: Exception) -> int:
+    """Map a MembershipChange (elastic epoch boundary) to the exit code
+    the run-level supervisor triages as a planned reshape (re-exec at the
+    recorded world size, no restart budget charged)."""
+    from atomo_tpu.training.resilience import MEMBERSHIP_EXIT_CODE
+
+    print(
+        f"Elastic membership boundary: {exc}; exiting "
+        f"rc={MEMBERSHIP_EXIT_CODE} (membership-change — a supervisor "
+        "re-execs at the recorded world size; unsupervised runs restart "
+        f"manually with --n-devices {exc.world_size} --resume)",
+        flush=True,
+    )
+    return MEMBERSHIP_EXIT_CODE
+
+
 def _argv_preflight(args: argparse.Namespace) -> None:
     """Deterministic config conflicts knowable from argv alone, checked
     BEFORE the supervisor re-exec (and before the jax backend initializes
@@ -633,11 +683,110 @@ def _argv_preflight(args: argparse.Namespace) -> None:
         from atomo_tpu.utils.chaos import ChaosConfig
 
         try:
-            ChaosConfig.from_spec(spec)
+            _chaos_cfg = ChaosConfig.from_spec(spec)
         except ValueError as exc:
             # deterministic from argv/env: a typo'd fault spec must not
             # re-exec jax-booting children through the whole restart budget
             raise SystemExit(str(exc))
+        from atomo_tpu.utils.tracing import MEMBERSHIP_EPOCH_ENV
+
+        _epoch0 = int(os.environ.get(MEMBERSHIP_EPOCH_ENV, "0") or 0) == 0
+        if _chaos_cfg.die_faults and _epoch0:
+            # die@ fires only at membership epoch 0: past a reshape the
+            # fault is disarmed, and validating its replica index against
+            # the NEW (shrunken) world would kill the supervisor's own
+            # re-exec'd child with rc=2 mid-reshape — so every die check
+            # applies to epoch-0 children only.
+            # die@ models a member the GUARD carries: without the screen
+            # the persistent NaN poisons every replica's mean on step S
+            # and the drill proves nothing — deterministic, so fail here
+            if not (args.grad_guard or args.max_grad_norm > 0):
+                raise SystemExit(
+                    "chaos die@S:R models a replica that stops "
+                    "contributing and is carried by the guard's "
+                    "skip-and-rescale; arm --grad-guard (or "
+                    "--max-grad-norm)"
+                )
+            if args.n_devices == 1:
+                raise SystemExit(
+                    "chaos die@S:R targets one replica of a multi-device "
+                    "mesh; single-device training has no surviving "
+                    "replicas to continue on"
+                )
+            if args.n_devices >= 2:
+                # a typo'd replica index would silently inject NOTHING
+                # and the drill would "pass" having proven nothing —
+                # argv-knowable for an explicit mesh, so fail fast here
+                # (--n-devices 0 defers to the in-run check)
+                bad = [
+                    r for _, r in _chaos_cfg.die_faults
+                    if r >= args.n_devices
+                ]
+                if bad:
+                    raise SystemExit(
+                        f"chaos die@S:R targets replica(s) {sorted(bad)} "
+                        f"outside the {args.n_devices}-device mesh "
+                        "(replicas are 0-based); the fault would never "
+                        "fire and the drill would prove nothing"
+                    )
+    if getattr(args, "readmit_at", 0) and not getattr(args, "elastic", False):
+        raise SystemExit(
+            "--readmit-at re-admits a shrunken world's member and needs "
+            "--elastic"
+        )
+    if getattr(args, "elastic", False):
+        # the elastic compatibility matrix, argv-knowable half (the loop
+        # re-checks with the resolved mesh): every reject here is
+        # deterministic and must not burn the restart budget
+        if not args.train_dir:
+            raise SystemExit(
+                "--elastic needs a --train-dir: membership.json and the "
+                "shrink/grow restarts resume from checkpoints"
+            )
+        if not (args.grad_guard or args.max_grad_norm > 0):
+            raise SystemExit(
+                "--elastic needs --grad-guard: a dead member is carried "
+                "by the guard's skip-and-rescale until the shrink boundary"
+            )
+        if not (args.save_freq or args.eval_freq):
+            raise SystemExit(
+                "--elastic needs a checkpoint cadence (--save-freq or "
+                "--eval-freq > 0): membership transitions happen at "
+                "checkpoint boundaries"
+            )
+        if args.n_devices == 1:
+            raise SystemExit(
+                "--elastic needs a multi-device mesh: a single device "
+                "has no surviving roster to shrink to"
+            )
+        if args.zero1:
+            raise SystemExit(
+                "--elastic cannot compose with --zero1 (the sharded "
+                "optimizer layout is world-size-specific; a shrink "
+                "restart could not resume it)"
+            )
+        if args.overlap == "delayed":
+            raise SystemExit(
+                "--elastic cannot compose with --overlap delayed (the "
+                "in-flight carry is shaped by the world size; a shrink "
+                "restart could not resume it)"
+            )
+        if args.aggregate == "hierarchical" or plan_flag != "auto":
+            raise SystemExit(
+                "--elastic is flat-mesh only (gather/ring/psum): "
+                "hierarchical schedules drop whole inner groups, while "
+                "membership tracks single replicas — drop --aggregate "
+                "hierarchical / --plan"
+            )
+        if args.phase_metrics:
+            raise SystemExit(
+                "--elastic needs the fused step's ok_bits metric; "
+                "--phase-metrics has no membership wiring — drop one"
+            )
+        if args.elastic_patience < 1:
+            raise SystemExit(
+                f"--elastic-patience {args.elastic_patience}: must be >= 1"
+            )
     if args.on_diverge != "off":
         from atomo_tpu.training.resilience import (
             DetectorConfig,
@@ -761,14 +910,28 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             flush=True,
         )
         dcn_ways = 0
+    if dcn_ways and getattr(args, "elastic", False):
+        print(
+            "Autopilot: excluding hierarchical candidates (--elastic is "
+            "flat-mesh only — membership tracks single replicas, not "
+            "inner groups)",
+            flush=True,
+        )
+        dcn_ways = 0
     doc = None
     if args.resume:
         # a resumed run (including a supervised restart's appended
         # --resume) must NOT re-probe: probe timings vary run to run, and
         # a different winner would try to resume checkpoints written by a
         # different program family (e.g. delayed payload vs blocking).
-        # The decision artifact IS the stable choice — reuse it.
+        # The decision artifact IS the stable choice — reuse it, but ONLY
+        # when it was tuned for THIS world size: after an elastic
+        # shrink/grow the recorded winner (a ring plan sized for N, a
+        # superstep point picked from N-way timings) may be invalid for
+        # N-1 (decision_reusable), so a mismatch re-tunes out loud.
         import json as _json
+
+        from atomo_tpu.tuning.autopilot import decision_reusable
 
         path = decision_path(args.train_dir)
         try:
@@ -776,21 +939,33 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
                 prior = _json.load(f)
         except (OSError, ValueError):
             prior = None
-        if prior and prior.get("complete") and (
-            (prior.get("winner") or {}).get("knobs")
-        ):
+        reusable, why = decision_reusable(prior, n_dev=n_dev)
+        if reusable:
             doc = prior
             print(
                 f"Autopilot: resuming with the recorded decision from "
                 f"{path} (no re-probe; delete the file to re-tune)",
                 flush=True,
             )
+        elif prior is not None:
+            print(f"Autopilot: NOT reusing {path}: {why}", flush=True)
+            if args.train_dir:
+                from atomo_tpu.utils.tracing import IncidentLog
+
+                IncidentLog.for_train_dir(args.train_dir).append(
+                    "tune_decision",
+                    action="retune",
+                    reason=why,
+                    n_devices=n_dev,
+                )
     # delayed is excluded from the candidate space whenever a later stage
     # could not accept it: densify's dense fallback has no delayed form,
-    # and a zero1 run cannot resume the in-flight payload (PR-5 matrix)
+    # a zero1 run cannot resume the in-flight payload (PR-5 matrix), and
+    # an elastic shrink restart cannot resume the world-size-shaped carry
     allow_overlap = (
         codec is not None and n_dev > 1
         and args.on_diverge != "densify" and not zero1
+        and not getattr(args, "elastic", False)
     )
     compute_dtype = jnp.bfloat16 if args.bf16 else None
     try:
@@ -998,6 +1173,20 @@ def cmd_train(args: argparse.Namespace) -> int:
         )
         superstep = 1
     n_dev = args.n_devices or len(jax.devices())
+    if (
+        chaos is not None and chaos.config.die_faults
+        and not chaos.membership_epoch  # disarmed past a reshape
+    ):
+        # the argv-ambiguous half of the preflight range check
+        # (--n-devices 0 = all visible needs the resolved count)
+        bad = [r for _, r in chaos.config.die_faults if r >= n_dev]
+        if bad or n_dev <= 1:
+            raise SystemExit(
+                f"chaos die@S:R targets replica(s) "
+                f"{sorted(r for _, r in chaos.config.die_faults)} but this "
+                f"run resolved to a {n_dev}-device mesh (replicas are "
+                "0-based); the fault would never fire"
+            )
     tuner = None
     if args.auto == "tune":
         superstep, tuner = _run_autopilot(args, model, optimizer, codec,
@@ -1044,6 +1233,20 @@ def cmd_train(args: argparse.Namespace) -> int:
             "--overlap delayed needs a multi-device mesh: single-device "
             "training has no exchange to take off the critical path"
         )
+    elastic_cfg = None
+    if args.elastic:
+        if n_dev <= 1:
+            # the argv-ambiguous case (--n-devices 0 on a 1-device host)
+            raise SystemExit(
+                "--elastic needs a multi-device mesh: this host resolved "
+                "to 1 device, so there is no surviving roster to shrink to"
+            )
+        from atomo_tpu.elastic import ElasticConfig
+
+        elastic_cfg = ElasticConfig(
+            patience=args.elastic_patience,
+            readmit_at=args.readmit_at,
+        )
     if n_dev > 1:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
         from atomo_tpu.training import stepwise_shrink
@@ -1061,7 +1264,9 @@ def cmd_train(args: argparse.Namespace) -> int:
             _init_params = model_init_fn(model, sample)
             args.aggregate = _resolve_auto_aggregate(
                 args, codec, _init_params, n_dev,
-                allow_hierarchical=args.overlap != "delayed",
+                allow_hierarchical=(
+                    args.overlap != "delayed" and not args.elastic
+                ),
             )
             if args.overlap == "delayed" and args.aggregate not in (
                 "gather", "ring",
@@ -1144,6 +1349,8 @@ def cmd_train(args: argparse.Namespace) -> int:
                     f"{n_dev}-device mesh; aggregating all replicas"
                 )
                 k_agg = 0
+        from atomo_tpu.elastic.membership import MembershipChange
+
         try:
             distributed_train_loop(
                 model, optimizer, mesh, train_iter, test_iter,
@@ -1165,9 +1372,12 @@ def cmd_train(args: argparse.Namespace) -> int:
                 diverge=diverge,
                 tuner=tuner,
                 plan=plan,
+                elastic=elastic_cfg,
             )
         except DivergenceError as exc:
             return _diverged_exit(exc)
+        except MembershipChange as exc:
+            return _membership_exit(exc)
     else:
         from atomo_tpu.training import train_loop
 

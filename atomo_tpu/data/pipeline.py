@@ -112,6 +112,21 @@ class BatchIterator:
         in-process rollback-replay contract (see restream)."""
         return self._rng.get_state()
 
+    def rng_signature(self) -> int:
+        """CRC32 fingerprint of the current shuffle-RNG state — the
+        membership layer's JSON-able stand-in for persisting the full
+        :meth:`snapshot_rng` tuple. Two streams built from the same seed
+        with the same consumption history fingerprint identically, so a
+        membership epoch record can PROVE its data-shard map derivation
+        ("this stream, skipped N batches, split world-size ways") instead
+        of asserting it. Take it at the same point as snapshot_rng
+        (before :meth:`forever` advances the state)."""
+        import zlib
+
+        kind, keys, pos, has_gauss, cached = self._rng.get_state()
+        h = zlib.crc32(f"{kind}:{pos}:{has_gauss}".encode())
+        return zlib.crc32(np.asarray(keys).tobytes(), h)
+
     def restream(self, rng_state, skip: int = 0):
         """Fresh replay stream for an IN-PROCESS rollback: restore the
         shuffle RNG to ``rng_state`` (the :meth:`snapshot_rng` taken when

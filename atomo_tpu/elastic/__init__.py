@@ -1,0 +1,61 @@
+"""Elastic world size: membership epochs, shrink-and-continue,
+deterministic re-admission (ROADMAP open item 4).
+
+The self-healing ladder (PRs 1+5) topped out at "supervised restart from a
+healthy checkpoint" — one dead worker cost the whole job a restart. This
+subsystem adds the rung between rollback and restart: continue on N-1
+(the guard's skip-and-rescale already computes an unbiased mean over any
+survivor subset — the source paper's estimator math, applied persistently)
+and re-admit the member later, with every roster change a durable
+*membership epoch* record and a deterministic data re-shard.
+
+Layers (one module each):
+  membership   epoch records + membership.json + the supervisor-side argv
+               rewrite (``apply_world_to_argv``) + :class:`MembershipChange`
+  shrink       host-side absence detection (:class:`AbsenceTracker` over
+               the guarded step's ``ok_bits`` series) and the exact
+               surviving-roster mean (:func:`survivor_decode_mean` — ONE
+               division by the surviving count, bit-identical to the
+               canonical decode-order mean over the survivors alone)
+  coordinator  the run-side controller: adopt/observe/maybe_transition,
+               including layer 3 (re-grow at ``--readmit-at``)
+
+Determinism contract (stated honestly, tested in tests/test_elastic.py):
+trajectories are bit-exact WITHIN a membership epoch — a die@S shrink run
+matches a fresh ``--n-devices N-1`` run resumed from the same checkpoint
+leaf-for-leaf — and every transition re-shards the same seed-deterministic
+batch stream contiguously over the new roster (documented in each epoch's
+``shard_map``, not bit-continuous across the boundary: the per-replica
+batch slices change with the divisor, and the records say exactly how).
+"""
+
+from atomo_tpu.elastic.coordinator import ElasticConfig, ElasticCoordinator
+from atomo_tpu.elastic.membership import (
+    MEMBERSHIP_FILE_NAME,
+    MembershipChange,
+    MembershipEpoch,
+    MembershipLog,
+    apply_world_to_argv,
+    membership_path,
+)
+from atomo_tpu.elastic.shrink import (
+    AbsenceTracker,
+    mask_absent,
+    ok_bits_mask,
+    survivor_decode_mean,
+)
+
+__all__ = [
+    "MEMBERSHIP_FILE_NAME",
+    "AbsenceTracker",
+    "ElasticConfig",
+    "ElasticCoordinator",
+    "MembershipChange",
+    "MembershipEpoch",
+    "MembershipLog",
+    "apply_world_to_argv",
+    "mask_absent",
+    "membership_path",
+    "ok_bits_mask",
+    "survivor_decode_mean",
+]

@@ -1,0 +1,384 @@
+"""The run-side elastic controller — ties layers 1+2 together and owns
+layer 3 (re-grow).
+
+One :class:`ElasticCoordinator` per train loop. Its life cycle:
+
+  adopt      at loop start: load (or begin) the membership history, check
+             the epoch on disk matches the world this run was launched
+             with, record the epoch-0 ``membership`` incident on a fresh
+             run. A crash restart WITHIN an epoch adopts silently — the
+             epoch is a property of the roster, not the process.
+  observe    per step (or per superstep block): fold the guarded step's
+             ``ok_bits`` series through the :class:`AbsenceTracker`.
+             Between a member's death and the next checkpoint boundary
+             the run just keeps training — the in-graph guard is already
+             masking the dead member and computing the surviving-roster
+             mean (``survivor_decode_mean``), so absence costs nothing
+             but gradient variance (the unbiased-subset argument).
+  maybe_transition
+             at every periodic checkpoint boundary: if members are
+             persistently absent and the shrink is viable (the global
+             batch must divide the smaller world — an unviable shrink is
+             recorded and the member stays carried), append the next
+             epoch's record + incident and raise
+             :class:`~atomo_tpu.elastic.membership.MembershipChange`;
+             else if the run is below full strength and ``readmit_at``
+             has passed, append a grow epoch back to the FULL roster and
+             raise the same way. The exception reaches the CLI, which
+             exits MEMBERSHIP_EXIT_CODE; the supervisor re-execs at the
+             new world size without charging the crash budget.
+
+Re-grow (layer 3) is deliberately boundary-triggered, not mid-step: the
+re-admitted member starts from the newest checkpoint with the shard map
+re-derived (same stream, re-split over the larger roster), which is
+exactly the documented re-shard every epoch transition performs — there
+is no special-case "catch-up" path to get wrong.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from atomo_tpu.elastic.membership import (
+    MembershipChange,
+    MembershipEpoch,
+    MembershipLog,
+)
+from atomo_tpu.elastic.shrink import AbsenceTracker
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """``--elastic`` knobs.
+
+    patience:   consecutive guard-masked steps before a replica is
+                declared ABSENT (one masked step is rung-1 noise).
+    readmit_at: step at/after which a below-strength world re-grows to
+                the full roster at the next checkpoint boundary (0 = no
+                automatic re-admission; re-grow by relaunching with the
+                full ``--n-devices`` by hand).
+    max_regrows: lifetime cap on AUTOMATIC re-admissions (counted as
+                ``grow`` epochs in membership.json, so it survives
+                restarts). A genuinely still-dead host would otherwise
+                cycle shrink -> grow -> re-mask -> shrink forever —
+                every cycle a full re-exec + recompile that no restart
+                budget bounds (membership re-execs are deliberately
+                budget-free, and each one records a strictly newer
+                epoch, so the supervisor's runaway guard never fires).
+                Past the cap the world stays shrunken; re-grow by hand.
+    """
+
+    patience: int = 6
+    readmit_at: int = 0
+    max_regrows: int = 1
+
+    def __post_init__(self):
+        if self.patience < 1:
+            raise ValueError(
+                f"elastic patience must be >= 1, got {self.patience}"
+            )
+        if self.readmit_at < 0:
+            raise ValueError(
+                f"--readmit-at must be >= 0, got {self.readmit_at}"
+            )
+        if self.max_regrows < 0:
+            raise ValueError(
+                f"max_regrows must be >= 0, got {self.max_regrows}"
+            )
+
+
+class ElasticCoordinator:
+    """Host-side membership controller for one train loop (see module
+    docstring). ``batch_size`` is the GLOBAL batch the loop feeds —
+    shrink viability is batch divisibility over the smaller world.
+    ``max_steps`` suppresses transitions at or past the end of the run
+    (a reshape that would immediately exit cleanly is a wasted re-exec).
+    """
+
+    def __init__(
+        self,
+        cfg: ElasticConfig,
+        train_dir: Optional[str],
+        *,
+        n_dev: int,
+        batch_size: int,
+        max_steps: int = 0,
+        incidents=None,
+        log_fn=print,
+    ):
+        self.cfg = cfg
+        self.train_dir = train_dir
+        self.n_dev = int(n_dev)
+        self.batch_size = int(batch_size)
+        self.max_steps = int(max_steps)
+        self.incidents = incidents
+        self.log_fn = log_fn
+        self.log = MembershipLog.load(train_dir)
+        self.tracker = AbsenceTracker(self.n_dev, cfg.patience)
+        self.pending_dead: set[int] = set()
+        self._carry_logged = False
+        self.epoch: Optional[MembershipEpoch] = None
+        self._rng_crc = None  # run-start stream fingerprint (see adopt)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def _shard_map(self, start_step: int, world: int, rng_crc=None) -> dict:
+        """The deterministic data-shard derivation this epoch trains
+        under (membership.py module docstring): contiguous split of the
+        seed-deterministic batch stream, replayed past ``start_step``
+        consumed batches."""
+        sm = {
+            "kind": "contiguous",
+            "batch_size": self.batch_size,
+            "per_replica": self.batch_size // max(world, 1),
+            "skip": int(start_step),
+        }
+        if rng_crc is not None:
+            sm["rng_crc"] = int(rng_crc)
+        return sm
+
+    def _device_detail(self) -> dict:
+        try:
+            from atomo_tpu.parallel.launch import device_roster
+
+            return {"devices": device_roster(self.n_dev)}
+        except Exception:  # noqa: BLE001 — detail is best-effort context
+            return {}
+
+    def _incident(self, action: str, rec: MembershipEpoch, **extra) -> None:
+        if self.incidents is not None:
+            self.incidents.append(
+                "membership",
+                action=action,
+                step=rec.start_step,
+                epoch=rec.epoch,
+                world=rec.world_size,
+                roster=list(rec.roster),
+                **extra,
+            )
+
+    def adopt(self, start_step: int, rng_crc=None) -> MembershipEpoch:
+        """Bind this run to the membership history: begin epoch 0 on a
+        fresh run, adopt the recorded epoch when the world matches, or
+        record an ``operator_resize`` epoch when the operator relaunched
+        at a world size no transition planned (say it out loud — a
+        silent mismatch would make the per-epoch records lie).
+
+        ``rng_crc`` is the run-start shuffle-RNG fingerprint
+        (``BatchIterator.rng_signature`` taken BEFORE ``forever()``). It
+        is a pure function of the data seed, so every restart of the
+        same run reproduces it — it is kept and stamped into EVERY epoch
+        record this coordinator appends (including the shrink/grow
+        transitions planned later in the run), so each epoch's shard_map
+        pins the stream state its derivation replays from."""
+        self._rng_crc = rng_crc
+        cur = self.log.latest()
+        if cur is None:
+            rec = MembershipEpoch(
+                epoch=0,
+                world_size=self.n_dev,
+                roster=tuple(range(self.n_dev)),
+                start_step=start_step,
+                reason="init",
+                shard_map=self._shard_map(start_step, self.n_dev, rng_crc),
+                detail=self._device_detail(),
+            )
+            self.log.append(rec)
+            self._incident("begin", rec)
+            self.log_fn(
+                f"Elastic: membership epoch 0 begins (world {self.n_dev}, "
+                f"roster {list(rec.roster)})"
+            )
+        elif cur.world_size != self.n_dev:
+            full = self.log.full_world
+            if self.n_dev == full:
+                roster = tuple(range(full))
+            else:
+                roster = tuple(cur.roster[: self.n_dev]) if (
+                    self.n_dev < cur.world_size
+                ) else tuple(range(self.n_dev))
+            rec = MembershipEpoch(
+                epoch=cur.epoch + 1,
+                world_size=self.n_dev,
+                roster=roster,
+                start_step=start_step,
+                reason="operator_resize",
+                shard_map=self._shard_map(start_step, self.n_dev, rng_crc),
+                detail=self._device_detail(),
+            )
+            self.log.append(rec)
+            self._incident("resize", rec, from_world=cur.world_size)
+            self.log_fn(
+                f"Elastic: operator resize {cur.world_size} -> "
+                f"{self.n_dev}; membership epoch {rec.epoch} recorded"
+            )
+        else:
+            self.log_fn(
+                f"Elastic: membership epoch {cur.epoch} adopted "
+                f"(world {cur.world_size}) at step {start_step}"
+            )
+        self.epoch = self.log.latest()
+        # cross-check the supervisor's epoch env against the adopted
+        # record: the env is what epoch-keyed chaos (die@) reads, so a
+        # stale value means the drill faults key on the wrong epoch —
+        # say so in the log AND the incident stream instead of silently
+        # adopting (world size alone cannot distinguish epochs)
+        import os
+
+        from atomo_tpu.utils.tracing import MEMBERSHIP_EPOCH_ENV
+
+        env_epoch = int(os.environ.get(MEMBERSHIP_EPOCH_ENV, "0") or 0)
+        if env_epoch and env_epoch != self.epoch.epoch:
+            self.log_fn(
+                f"Elastic: WARNING {MEMBERSHIP_EPOCH_ENV}={env_epoch} "
+                f"disagrees with the adopted membership epoch "
+                f"{self.epoch.epoch} — epoch-keyed chaos (die@) will key "
+                "on the env value; fix the launcher or unset the var"
+            )
+            if self.incidents is not None:
+                self.incidents.append(
+                    "membership",
+                    action="epoch_env_mismatch",
+                    step=start_step,
+                    epoch=self.epoch.epoch,
+                    world=self.n_dev,
+                    env_epoch=env_epoch,
+                )
+        return self.epoch
+
+    # -- observation ----------------------------------------------------
+
+    def observe(self, first_step: int, metrics) -> None:
+        """Fold a fetched metrics dict's ``ok_bits`` (per-step scalar or a
+        superstep block's ``(K,)`` series) through the absence tracker."""
+        bits = metrics.get("ok_bits")
+        if bits is None:
+            return
+        for i, slot in self.tracker.observe_series(bits):
+            member = self.epoch.roster[slot] if self.epoch else slot
+            self.pending_dead.add(slot)
+            self.log_fn(
+                f"Elastic: replica {slot} (member {member}) absent "
+                f"for {self.cfg.patience} consecutive steps at step "
+                f"{first_step + i}; shrink planned for the next "
+                "checkpoint boundary (carried masked — the exact "
+                "surviving-roster mean — until then)"
+            )
+
+    # -- transitions ----------------------------------------------------
+
+    def maybe_transition(self, step: int) -> None:
+        """Call at every periodic checkpoint boundary (AFTER the save
+        landed — the next epoch resumes from it). Raises
+        :class:`MembershipChange` when a transition is due; plain return
+        otherwise."""
+        if self.epoch is None or (self.max_steps and step >= self.max_steps):
+            return
+        if self.pending_dead:
+            new_world = self.n_dev - len(self.pending_dead)
+            # viability must match what the RE-EXEC'D child will accept:
+            # elastic itself needs a multi-device mesh, so a shrink to 1
+            # survivor would hand the supervisor a child that dies on its
+            # own preflight (rc=2, give-up) — carry instead
+            if new_world < 2 or self.batch_size % new_world:
+                if not self._carry_logged:
+                    self._carry_logged = True
+                    why = (
+                        f"global batch {self.batch_size} does not divide "
+                        f"over {new_world} survivors"
+                        if new_world >= 2
+                        else f"{new_world} survivor(s) cannot form a "
+                        "multi-device elastic mesh"
+                    )
+                    self.log_fn(
+                        f"Elastic: cannot shrink to world {new_world} "
+                        f"({why}); carrying the absent member(s) masked "
+                        "for the rest of the run"
+                    )
+                    if self.incidents is not None:
+                        self.incidents.append(
+                            "membership",
+                            action="carry",
+                            step=step,
+                            epoch=self.epoch.epoch,
+                            world=self.n_dev,
+                            reason=why,
+                            dead=sorted(
+                                self.epoch.roster[s]
+                                for s in self.pending_dead
+                            ),
+                        )
+                return
+            dead_members = sorted(
+                self.epoch.roster[s] for s in self.pending_dead
+            )
+            roster = tuple(
+                m for m in self.epoch.roster if m not in dead_members
+            )
+            rec = MembershipEpoch(
+                epoch=self.epoch.epoch + 1,
+                world_size=new_world,
+                roster=roster,
+                start_step=step,
+                reason="shrink",
+                dead=tuple(dead_members),
+                shard_map=self._shard_map(step, new_world, self._rng_crc),
+            )
+            self.log.append(rec)
+            self._incident(
+                "shrink", rec, dead=dead_members, from_world=self.n_dev
+            )
+            self.log_fn(
+                f"Elastic: shrinking {self.n_dev} -> {new_world} at "
+                f"checkpoint step {step} (member(s) {dead_members} left; "
+                f"membership epoch {rec.epoch}); data stream re-shards "
+                "deterministically over the surviving roster"
+            )
+            raise MembershipChange("shrink", rec)
+        if (
+            self.cfg.readmit_at
+            and step >= self.cfg.readmit_at
+            and self.n_dev < self.log.full_world
+        ):
+            grows = sum(e.reason == "grow" for e in self.log.epochs)
+            if grows >= self.cfg.max_regrows:
+                # the flap guard (see ElasticConfig.max_regrows): a
+                # member that died AGAIN after re-admission stays out
+                if not self._carry_logged:
+                    self._carry_logged = True
+                    self.log_fn(
+                        f"Elastic: re-admission budget spent ({grows} "
+                        f"grow epoch(s) recorded, max_regrows="
+                        f"{self.cfg.max_regrows}); staying at world "
+                        f"{self.n_dev} — re-grow by relaunching with "
+                        "the full --n-devices by hand"
+                    )
+                    if self.incidents is not None:
+                        self.incidents.append(
+                            "membership",
+                            action="regrow_budget_spent",
+                            step=step,
+                            epoch=self.epoch.epoch,
+                            world=self.n_dev,
+                            regrows=grows,
+                        )
+                return
+            full = self.log.full_world
+            rec = MembershipEpoch(
+                epoch=self.epoch.epoch + 1,
+                world_size=full,
+                roster=tuple(range(full)),
+                start_step=step,
+                reason="grow",
+                shard_map=self._shard_map(step, full, self._rng_crc),
+            )
+            self.log.append(rec)
+            self._incident("grow", rec, from_world=self.n_dev)
+            self.log_fn(
+                f"Elastic: re-admitting to the full roster "
+                f"({self.n_dev} -> {full}) at checkpoint step {step} "
+                f"(membership epoch {rec.epoch}); restart resumes from "
+                "the newest checkpoint with the shard map re-derived"
+            )
+            raise MembershipChange("grow", rec)

@@ -1,0 +1,239 @@
+"""Membership epochs — layer 1 of the elastic-world subsystem.
+
+A *membership epoch* is a span of steps trained by one fixed roster of
+replicas. The whole elastic design rests on making epochs explicit and
+durable: the determinism contract is stated PER EPOCH (bit-exact
+trajectories within an epoch, a documented deterministic re-shard at every
+transition), and the post-mortem question "which replicas contributed to
+step S" must be answerable from disk — so every epoch is one record in
+``train_dir/membership.json`` (written with the same tmp+rename atomicity
+as every other evidence file) and one ``membership`` line in
+``incidents.jsonl``.
+
+Why the estimator math licenses this at all (PAPER.md): every codec is an
+unbiased estimator of the mean gradient, and the mean over ANY subset of
+replicas is still an unbiased estimate of the true gradient — just with
+more variance. The guard's skip-and-rescale already exploits that for a
+*transient* anomaly; a *persistently* absent replica is the same argument
+applied for longer, which is why the run can keep training on N-1 at all
+(Parallax, PAPERS.md 1808.02621, grounds rebalancing the data-parallel
+work across the changed world).
+
+The data-shard map is DERIVED, not stored: the batch stream is a pure
+function of (data seed, batches consumed) — ``BatchIterator.forever(skip)``
+replays it from any step — and the global batch splits contiguously over
+the roster order, so an epoch record only needs ``(batch_size, skip,
+rng_crc)`` to pin the exact per-replica sample assignment for every step
+it covers. ``rng_crc`` (``BatchIterator.rng_signature``) fingerprints the
+shuffle-RNG state the derivation starts from, so a post-mortem can verify
+the claim instead of trusting it.
+
+Epoch transitions happen only at checkpoint boundaries: the exiting run
+appends the NEXT epoch's record, logs the ``membership`` incident, and
+exits with :data:`~atomo_tpu.training.resilience.MEMBERSHIP_EXIT_CODE` so
+the supervisor re-execs at the new world size (``apply_world_to_argv``)
+WITHOUT charging the crash-restart budget — a planned reshape is not a
+crash.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from atomo_tpu.utils.tracing import write_json_atomic
+
+MEMBERSHIP_FILE_NAME = "membership.json"
+
+
+def membership_path(train_dir: str) -> str:
+    return os.path.join(train_dir, MEMBERSHIP_FILE_NAME)
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipEpoch:
+    """One epoch of the membership history.
+
+    epoch:      0-based transition counter (strictly increasing).
+    world_size: replicas training during this epoch.
+    roster:     the ORIGINAL member ids still present, in mesh order —
+                mesh replica ``i`` of this epoch is member ``roster[i]``,
+                so a shrunken world's replica numbering is always
+                translatable back to the full roster.
+    start_step: the checkpoint step the epoch begins at (0 = run start).
+    reason:     init | shrink | grow | operator_resize.
+    dead:       members that left at this transition (shrink only).
+    shard_map:  the deterministic data-shard derivation — see module
+                docstring; enough to reconstruct which samples replica i
+                consumed at any step of the epoch.
+    detail:     free-form context (device roster etc.), JSON-able.
+    """
+
+    epoch: int
+    world_size: int
+    roster: tuple[int, ...]
+    start_step: int = 0
+    reason: str = "init"
+    dead: tuple[int, ...] = ()
+    shard_map: dict = dataclasses.field(default_factory=dict)
+    detail: dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.world_size != len(self.roster):
+            raise ValueError(
+                f"membership epoch {self.epoch}: world_size "
+                f"{self.world_size} != roster length {len(self.roster)}"
+            )
+        if self.world_size < 1:
+            raise ValueError(
+                f"membership epoch {self.epoch}: world_size must be >= 1"
+            )
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": int(self.epoch),
+            "world_size": int(self.world_size),
+            "roster": [int(m) for m in self.roster],
+            "start_step": int(self.start_step),
+            "reason": self.reason,
+            "dead": [int(m) for m in self.dead],
+            "shard_map": dict(self.shard_map),
+            "detail": dict(self.detail),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MembershipEpoch":
+        return cls(
+            epoch=int(d["epoch"]),
+            world_size=int(d["world_size"]),
+            roster=tuple(int(m) for m in d["roster"]),
+            start_step=int(d.get("start_step", 0)),
+            reason=str(d.get("reason", "init")),
+            dead=tuple(int(m) for m in d.get("dead", ())),
+            shard_map=dict(d.get("shard_map", {})),
+            detail=dict(d.get("detail", {})),
+        )
+
+
+class MembershipLog:
+    """The ``membership.json`` file: the full epoch history, appended
+    atomically (tmp+rename — the write_json_atomic discipline every
+    evidence artifact in this repo shares), loadable after exactly the
+    failures the elastic subsystem drills."""
+
+    def __init__(self, path: Optional[str], epochs=None):
+        self.path = path
+        self.epochs: list[MembershipEpoch] = list(epochs or [])
+
+    @classmethod
+    def load(cls, train_dir: Optional[str]) -> "MembershipLog":
+        """Read train_dir/membership.json; missing/unreadable file = empty
+        history (a torn file must not crash the run that documents it —
+        the IncidentLog.append precedent)."""
+        path = membership_path(train_dir) if train_dir else None
+        epochs = []
+        if path and os.path.exists(path):
+            import json
+
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                epochs = [
+                    MembershipEpoch.from_dict(e)
+                    for e in doc.get("epochs", [])
+                ]
+            except (OSError, ValueError, KeyError) as exc:
+                import warnings
+
+                warnings.warn(
+                    f"membership log {path!r} unreadable ({exc}); "
+                    "treating as empty history"
+                )
+                epochs = []
+        return cls(path, epochs)
+
+    @property
+    def full_world(self) -> int:
+        """The ORIGINAL world size — epoch 0's. Re-admission grows back
+        toward this roster, never past it."""
+        return self.epochs[0].world_size if self.epochs else 0
+
+    def latest(self) -> Optional[MembershipEpoch]:
+        return self.epochs[-1] if self.epochs else None
+
+    def append(self, rec: MembershipEpoch) -> MembershipEpoch:
+        last = self.latest()
+        if last is not None and rec.epoch != last.epoch + 1:
+            raise ValueError(
+                f"membership epochs must be contiguous: appending epoch "
+                f"{rec.epoch} after {last.epoch}"
+            )
+        if last is None and rec.epoch != 0:
+            raise ValueError(
+                f"the first membership epoch must be 0, got {rec.epoch}"
+            )
+        self.epochs.append(rec)
+        self._write()
+        return rec
+
+    def _write(self) -> None:
+        if not self.path:
+            return
+        try:
+            write_json_atomic(
+                self.path,
+                {
+                    "kind": "membership",
+                    "full_world": self.full_world,
+                    "epochs": [e.to_dict() for e in self.epochs],
+                },
+            )
+        except OSError as exc:
+            import warnings
+
+            warnings.warn(f"membership log write failed: {exc}")
+
+
+class MembershipChange(RuntimeError):
+    """A membership epoch boundary was reached: the run must re-exec at a
+    different world size. The CLI translates this into
+    :data:`~atomo_tpu.training.resilience.MEMBERSHIP_EXIT_CODE` (the
+    supervisor's planned-reshape triage — restarts on it do NOT burn the
+    crash budget); the new epoch's record is already durable in
+    membership.json when this is raised."""
+
+    def __init__(self, kind: str, record: MembershipEpoch):
+        self.kind = kind  # "shrink" | "grow"
+        self.record = record
+        self.epoch = record.epoch
+        self.world_size = record.world_size
+        super().__init__(
+            f"{kind} to world size {record.world_size} at step "
+            f"{record.start_step} (membership epoch {record.epoch})"
+        )
+
+
+def apply_world_to_argv(argv, world_size: int) -> list[str]:
+    """Rewrite a train command's ``--n-devices`` to ``world_size`` (both
+    the ``--n-devices N`` and ``--n-devices=N`` spellings; appended when
+    absent — an ``--n-devices 0``/flagless command means "all visible",
+    which an elastic reshape must pin down explicitly). The supervisor's
+    half of a membership transition."""
+    out = list(argv)
+    handled = False
+    i = 0
+    while i < len(out):
+        tok = out[i]
+        if tok == "--n-devices" and i + 1 < len(out):
+            out[i + 1] = str(world_size)
+            handled = True
+            i += 2
+            continue
+        if tok.startswith("--n-devices="):
+            out[i] = f"--n-devices={world_size}"
+            handled = True
+        i += 1
+    if not handled:
+        out += ["--n-devices", str(world_size)]
+    return out

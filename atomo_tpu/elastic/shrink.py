@@ -1,0 +1,167 @@
+"""Shrink-and-continue — layer 2 of the elastic-world subsystem.
+
+Two pieces, one per half of the "replica stopped contributing" story:
+
+* :class:`AbsenceTracker` — the HOST side. The guarded step already
+  reports which replicas passed the screen (``metrics["ok_bits"]``, a
+  psum-ed bitmask added by ``make_distributed_train_step(track_ok_bits=
+  True)``); the tracker is a pure fold over that per-step series that
+  separates a transient anomaly (one masked step — rung 1's business)
+  from a PERSISTENTLY absent replica (the same bit low for ``patience``
+  consecutive steps — the membership layer's business). Same design rules
+  as the divergence detector: a sequential fold, so a superstep block's
+  ``(K,)`` series and a per-step series produce identical verdicts for
+  any partition.
+
+* :func:`survivor_decode_mean` — the DEVICE side. While a dead replica is
+  being *carried* (between its death and the next checkpoint boundary),
+  the guard masks its payload out of the aggregation. The pre-elastic
+  rescale (``decode_mean_tree`` = sum/N, then ``rescale_by_survivors`` =
+  ×N/kept) is mathematically the survivors' mean but ROUNDS TWICE — its
+  last mantissa bits differ from any mean computed with one division.
+  This operator is the bit-exact statement of "mean over the surviving
+  roster": per-replica canonical decode, a SEQUENTIAL roster-order fold
+  of the rows, ONE division by the surviving count. Pinning the fold
+  order is what makes the bit-identity claim well-defined AND true: a
+  masked slot decodes to exactly zero (the ``_mask_gathered`` invariant)
+  and ``x + 0.0`` is exact in IEEE, so the N-row masked fold produces
+  the SAME bits as the (N-1)-row fold over the survivors alone — whereas
+  an ``jnp.sum``/``jnp.mean`` reduction changes its association tree
+  with the row count ((a+0)+(c+d) vs (a+c)+d) and drifts in the last
+  mantissa bit (measured; the reassociation class this repo documents
+  for fused SVD decode and scan-vs-standalone). The ring's elastic
+  segment reduction uses the same pinned fold, so the gather and ring
+  carried-world operators and the survivors-only reference are all
+  bit-identical BY CONSTRUCTION (tested per codec in
+  tests/test_elastic.py); the unpinned ``decode_mean_tree(fused=False)``
+  agrees to the documented last-bit drift class. The carried-world
+  operator and the shrunken-world operator are then the SAME function of
+  the surviving payloads — the shrink boundary changes the data shards,
+  never the aggregation arithmetic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def ok_bits_mask(bits: float, world_size: int) -> int:
+    """Decode a step's ``ok_bits`` metric (psum of ok * 2^replica, exact
+    in float32 for the <= 24-replica meshes this targets) into an int
+    bitmask of the replicas that passed the screen."""
+    full = (1 << world_size) - 1
+    return int(round(float(bits))) & full
+
+
+class AbsenceTracker:
+    """Pure fold over the per-step ``ok_bits`` series: replica ``i`` is
+    declared ABSENT once its bit has been low for ``patience`` consecutive
+    steps. One masked step is rung-1 noise (a transient screen hit); a
+    sustained run of them is a dead member. Feeding the same series one
+    step at a time or in blocks of any partition produces identical
+    verdicts (the detector-fold contract)."""
+
+    def __init__(self, world_size: int, patience: int):
+        if world_size < 1:
+            raise ValueError(f"world_size must be >= 1, got {world_size}")
+        if patience < 1:
+            raise ValueError(f"absence patience must be >= 1, got {patience}")
+        self.world_size = world_size
+        self.patience = patience
+        self._misses = [0] * world_size
+
+    def observe(self, bits) -> set[int]:
+        """Fold one step's ok_bits; returns the replica slots that JUST
+        crossed the patience threshold this step (empty most steps)."""
+        mask = ok_bits_mask(bits, self.world_size)
+        newly = set()
+        for i in range(self.world_size):
+            if mask & (1 << i):
+                self._misses[i] = 0
+            else:
+                self._misses[i] += 1
+                if self._misses[i] == self.patience:
+                    newly.add(i)
+        return newly
+
+    def observe_series(self, series) -> list[tuple[int, int]]:
+        """Fold a block's ``(K,)`` ok_bits series (or one scalar); returns
+        ``[(in_block_index, slot), ...]`` for every slot that crossed the
+        threshold, in fold order — the block entry point the coordinator
+        consumes (the index lets it name the exact step in its log/record
+        without re-implementing the fold)."""
+        import numpy as np
+
+        events: list[tuple[int, int]] = []
+        for i, v in enumerate(np.asarray(series).reshape(-1)):
+            for slot in sorted(self.observe(v)):
+                events.append((i, slot))
+        return events
+
+
+def mask_absent(gathered, okg):
+    """Zero the gathered payload slots of absent replicas (leading axis =
+    replica) — the elastic name for parallel.replicated's
+    ``_mask_gathered``, delegated so there is exactly ONE masking
+    implementation: the survivor mean's "a masked payload decodes to
+    exact zeros" invariant must be the SAME arithmetic the frozen guarded
+    gather path applies (``where``, never multiply — NaN x 0 is still
+    NaN), and two copies would let a fix to one silently break the
+    other's bit-identity claim. Lazy import: replicated lazily imports
+    this module inside its traced step, so the cycle never closes at
+    module load."""
+    from atomo_tpu.parallel.replicated import _mask_gathered
+
+    return _mask_gathered(gathered, okg)
+
+
+def roster_fold_sum(rows):
+    """Sequential left-fold sum of a ``(N, ...)`` row stack in roster
+    order — THE pinned reduction every elastic mean uses (module
+    docstring: pinning the association tree is what makes "a zero row is
+    an exact identity" compose into bit-identity across row counts).
+    ``N`` is a trace-time constant, so the unrolled adds cost what one
+    reduce costs; XLA does not reassociate fp adds."""
+    acc = rows[0]
+    for i in range(1, rows.shape[0]):
+        acc = acc + rows[i]
+    return acc
+
+
+def survivor_decode_mean(codec, gathered, okg, grads_like, kept=None):
+    """Decode-mean over the SURVIVING roster, computed from the full
+    gathered slot array: mask absent slots, per-replica canonical decode
+    (the ring/gather parity order — vmap of ``codec.decode``), a
+    roster-order :func:`roster_fold_sum` over the replica axis, ONE
+    division by the surviving count.
+
+    Bit-identity contract (the elastic acceptance test): for any absent
+    subset this equals the same pinned fold over the SURVIVORS' rows
+    alone — the mean a shrunken world computes over those payloads — bit
+    for bit, for every codec; the unpinned ``decode_mean_tree(codec, ...,
+    fused=False)`` agrees to the documented last-mantissa-bit
+    reassociation drift. The fused SVD decode_mean is deliberately NOT
+    used here: it reassociates over the flattened (replica, atom) axis,
+    and the elastic contract is exactness, not MXU throughput, for the
+    handful of steps a dead replica is carried.
+
+    ``kept`` defaults to ``sum(okg)``; pass it when the caller already
+    computed the surviving count (one fewer reduction in the traced step).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    masked = mask_absent(gathered, okg)
+    if kept is None:
+        kept = jnp.sum(okg)
+    denom = jnp.maximum(kept, 1.0)
+    leaves, treedef = jax.tree_util.tree_flatten(grads_like)
+    p_leaves = treedef.flatten_up_to(masked)
+    out = []
+    for p, g in zip(p_leaves, leaves):
+        dec = jax.vmap(
+            lambda q, s=tuple(g.shape), d=g.dtype: codec.decode(q, s, d)
+        )(p)
+        s = roster_fold_sum(dec)
+        out.append(s / denom.astype(s.dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)

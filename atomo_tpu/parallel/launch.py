@@ -112,6 +112,26 @@ def global_mesh(axes: Sequence[tuple[str, int]] = ()) -> "jax.sharding.Mesh":
     return make_mesh(axes=tuple(axes), devices=jax.devices())
 
 
+def device_roster(n: int = 0) -> list[dict]:
+    """JSON-able description of the first ``n`` visible devices (0 = all):
+    id, platform, owning process. The elastic membership layer attaches
+    this to epoch records so a post-mortem can name the PHYSICAL members
+    behind the logical roster slots — on a real fleet "replica 1 left"
+    means a specific chip on a specific host, and the incident should say
+    which."""
+    devs = jax.devices()
+    if n:
+        devs = devs[:n]
+    return [
+        {
+            "id": int(d.id),
+            "platform": str(getattr(d, "platform", "unknown")),
+            "process": int(getattr(d, "process_index", 0)),
+        }
+        for d in devs
+    ]
+
+
 class HealthMonitor:
     """Step-heartbeat failure detector (capability the reference lacks).
 

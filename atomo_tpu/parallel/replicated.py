@@ -249,6 +249,7 @@ def _ring_stream_mean(
     sel=None,
     n_contrib: int,
     bucket_size: int = 0,
+    survivor_exact: bool = False,
 ):
     """Ring-streamed decode-mean: rotate encoded payloads around ``axis``
     with ``jax.lax.ppermute`` while each chip folds every arriving payload's
@@ -359,7 +360,21 @@ def _ring_stream_mean(
     # stage now has exactly n_contrib rows (N, or the k_agg-selected
     # subset): one canonical elementwise mean, the gather path's reduction
     assert stage.shape[0] == n_contrib, (stage.shape, n_contrib)
-    seg_mean = jnp.mean(stage, axis=0)
+    if survivor_exact and guard_on:
+        # elastic mode: the pinned roster-order fold of the masked rows,
+        # ONE division by the surviving count (a zero row is an exact
+        # identity of the sequential fold, so this is bit-identical to
+        # the same fold over the survivors alone — the mean a shrunken
+        # world computes; see elastic.shrink). The caller must NOT
+        # rescale.
+        from atomo_tpu.elastic.shrink import roster_fold_sum
+
+        kept_r = jnp.sum(ok_stage)
+        seg_mean = roster_fold_sum(stage) / jnp.maximum(
+            kept_r, 1.0
+        ).astype(stage.dtype)
+    else:
+        seg_mean = jnp.mean(stage, axis=0)
     full = jax.lax.all_gather(seg_mean, axis, tiled=True)
     mean_tree = unravel(full[:d_flat])
     return mean_tree, (ok_stage if guard_on else None)
@@ -419,10 +434,33 @@ def make_distributed_train_step(
     overlap: str = "off",
     remedy=None,
     track_grad_norm: bool = False,
+    track_ok_bits: bool = False,
+    survivor_exact: bool = False,
     plan=None,
     _oracle_parts: bool = False,
 ):
     """Build the jitted SPMD train step over ``mesh``.
+
+    ``track_ok_bits`` (elastic membership mode; requires ``guard``, flat
+    aggregation, blocking overlap) adds ``metrics["ok_bits"]`` — the psum
+    of ``ok * 2**replica``, i.e. a bitmask of the replicas whose raw
+    gradient passed the screen this step (exact in float32 for <= 24
+    replicas). The elastic coordinator folds this series host-side to
+    tell a transient screen hit from a PERSISTENTLY absent member.
+    ``survivor_exact`` switches the guarded gather/ring masked mean from
+    the historical sum/N x N/kept rescale to the elastic operator
+    (elastic.shrink.survivor_decode_mean): per-replica canonical decode,
+    a SEQUENTIAL roster-order fold, ONE division by the surviving count —
+    bit-identical to the same fold over the surviving roster alone, i.e.
+    the mean a genuinely shrunken world computes over those payloads
+    (psum/dense masked_mean already divides once and needs no switch;
+    the ring's elastic segment reduction uses the same pinned fold, so
+    gather and ring agree bitwise too). survivor_exact is its own
+    program family: vs the unpinned jnp.mean reduction it drifts in the
+    last mantissa bit (the documented reassociation class), so elastic
+    trajectories compare elastic-to-elastic — which the acceptance drill
+    does. Both flags default OFF and then add no ops — the compiled
+    programs are byte-identical to before.
 
     ``plan`` (topology.schedule.AggregationPlan, hierarchical mode only)
     selects the two-level schedule: inner primitive over the fast fabric
@@ -651,6 +689,25 @@ def make_distributed_train_step(
         )
     if _oracle_parts and overlap != "delayed":
         raise ValueError("_oracle_parts only applies to overlap='delayed'")
+    if track_ok_bits:
+        if guard is None:
+            raise ValueError(
+                "track_ok_bits reports the guard's per-replica screen "
+                "verdicts; arm guard= (the elastic membership layer has "
+                "nothing to observe without the screen)"
+            )
+        if hierarchical or overlap == "delayed":
+            raise ValueError(
+                "track_ok_bits needs flat blocking aggregation: "
+                "hierarchical mode drops whole inner groups (membership "
+                "tracks single replicas) and the delayed carry is shaped "
+                "by the world size"
+            )
+    if survivor_exact and hierarchical:
+        raise ValueError(
+            "survivor_exact only applies to flat aggregation (the "
+            "hierarchical guard's drop unit is an inner group)"
+        )
 
     batch_axes = (axis, inner_axis) if hierarchical else axis
     metric_axes = batch_axes
@@ -857,14 +914,29 @@ def make_distributed_train_step(
                 with named_phase("decode_mean"):
                     if guard is not None:
                         kept = jnp.sum(okg)
-                        mean_grads = rescale_by_survivors(
-                            decode_mean_tree(
-                                codec, _mask_gathered(gathered, okg), grads,
-                                n_contrib, fused=not unfused_decode,
-                            ),
-                            n_contrib,
-                            kept,
-                        )
+                        if survivor_exact:
+                            from atomo_tpu.elastic.shrink import (
+                                survivor_decode_mean,
+                            )
+
+                            # elastic: ONE division by the surviving
+                            # count — bit-identical to the canonical
+                            # decode-order mean over the surviving roster
+                            # alone, i.e. the operator a genuinely
+                            # shrunken world runs on the same payloads
+                            mean_grads = survivor_decode_mean(
+                                codec, gathered, okg, grads, kept=kept
+                            )
+                        else:
+                            mean_grads = rescale_by_survivors(
+                                decode_mean_tree(
+                                    codec, _mask_gathered(gathered, okg),
+                                    grads, n_contrib,
+                                    fused=not unfused_decode,
+                                ),
+                                n_contrib,
+                                kept,
+                            )
                     else:
                         mean_grads = decode_mean_tree(
                             codec, gathered, grads, n_contrib,
@@ -880,14 +952,16 @@ def make_distributed_train_step(
                         axis=axis, n_dev=n_dev, my=my,
                         ok=ok, sel=sel, n_contrib=n_contrib,
                         bucket_size=ring_bucket_size,
+                        survivor_exact=survivor_exact,
                     )
                 if guard is not None:
                     # ok_stage comes back sel-subset already (the helper
                     # applies num_aggregate to flags and slices together)
                     kept = jnp.sum(ok_stage)
-                    mean_grads = rescale_by_survivors(
-                        mean_grads, n_contrib, kept
-                    )
+                    if not survivor_exact:
+                        mean_grads = rescale_by_survivors(
+                            mean_grads, n_contrib, kept
+                        )
             elif aggregate == "psum":
                 decoded = decode_tree(codec, payloads, grads)
                 if guard is not None:
@@ -959,6 +1033,18 @@ def make_distributed_train_step(
                 "skipped": 1.0 - ok_step.astype(jnp.float32),
                 "dropped": n_contrib - kept,
             }
+            if track_ok_bits:
+                # bitmask of screen-passing replicas (exact in f32 for
+                # the <= 24-replica meshes elastic targets): the host
+                # series the membership layer folds to tell a transient
+                # screen hit from a persistently absent member
+                metrics["ok_bits"] = jax.lax.psum(
+                    ok.astype(jnp.float32)
+                    * jnp.exp2(
+                        jax.lax.axis_index(axis).astype(jnp.float32)
+                    ),
+                    metric_axes,
+                )
         if gnorm is not None:
             if guard is None:
                 metrics["grad_norm"] = jax.lax.pmean(gnorm, metric_axes)
@@ -1501,6 +1587,7 @@ def distributed_train_loop(
     diverge=None,
     tuner=None,
     plan=None,
+    elastic=None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -1566,7 +1653,21 @@ def distributed_train_loop(
     the step program is rebuilt (at the doctor's current chaos
     generation, when armed); the decision — switch or keep — lands in
     ``incidents.jsonl``. Not supported with ``--phase-metrics`` (no
-    fused step to re-pick)."""
+    fused step to re-pick).
+
+    ``elastic`` (elastic.ElasticConfig) arms membership tracking: the
+    step is built with ``track_ok_bits`` + ``survivor_exact`` (requires
+    ``guard``), an :class:`~atomo_tpu.elastic.coordinator
+    .ElasticCoordinator` adopts/creates the membership epoch in
+    ``train_dir/membership.json``, folds the per-step ``ok_bits`` series,
+    and at a periodic checkpoint boundary raises
+    :class:`~atomo_tpu.elastic.membership.MembershipChange` to shrink to
+    the surviving roster (or re-grow at ``readmit_at``) — the CLI maps it
+    to MEMBERSHIP_EXIT_CODE and the supervisor re-execs at the new world
+    size without charging the restart budget. Needs a checkpoint cadence
+    and a flat blocking aggregate; rejects zero1 / delayed / hierarchical
+    / phase_metrics (the world-size-shaped state those modes carry cannot
+    be resumed across a reshape)."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import (
         SUPERVISED_ENV,
@@ -1608,6 +1709,42 @@ def distributed_train_loop(
             "the online re-tuner rebuilds the fused step; --phase-metrics "
             "has no fused step to re-pick — drop one"
         )
+    if elastic is not None:
+        if guard is None:
+            raise ValueError(
+                "--elastic needs --grad-guard: a dead member is carried "
+                "by the guard's skip-and-rescale until the shrink boundary"
+            )
+        if not train_dir:
+            raise ValueError(
+                "--elastic needs a train_dir (membership.json and the "
+                "shrink/grow restarts resume from checkpoints)"
+            )
+        if not save_freq:
+            raise ValueError(
+                "--elastic needs a checkpoint cadence (save_freq > 0): "
+                "membership transitions happen at checkpoint boundaries"
+            )
+        if zero1 or overlap == "delayed" or aggregate == "hierarchical":
+            raise ValueError(
+                "--elastic cannot compose with --zero1, --overlap "
+                "delayed, or --aggregate hierarchical: those modes carry "
+                "world-size-shaped state (sharded optimizer slices, the "
+                "in-flight payload, inner-group drop units) that a "
+                "shrink restart cannot resume"
+            )
+        if phase_metrics:
+            raise ValueError(
+                "--elastic needs the fused step's ok_bits metric; "
+                "--phase-metrics has no membership wiring — drop one"
+            )
+        if jax.process_count() > 1:
+            raise ValueError(
+                "--elastic is single-process for now: a multi-host "
+                "reshape needs every process to agree on the re-exec "
+                "(the coordinator/supervisor handshake); on one host the "
+                "supervisor re-execs the whole world atomically"
+            )
     if diverge is not None:
         reason = diverge_conflict(
             diverge.remedy,
@@ -1849,6 +1986,8 @@ def distributed_train_loop(
                 superstep=superstep, ring_bucket_size=ring_bucket_size,
                 overlap="off" if densify else overlap,
                 remedy=remedy_cfg, track_grad_norm=diverge is not None,
+                track_ok_bits=elastic is not None,
+                survivor_exact=elastic is not None,
                 plan=plan,
             )
 
@@ -1868,16 +2007,33 @@ def distributed_train_loop(
     # precede forever() (which advances the shuffle RNG) and is a
     # doctor-only iterator requirement — disarmed loops keep the old
     # iterator contract.
+    incidents = None
+    if train_dir and (
+        diverge is not None or tuner is not None or elastic is not None
+        or os.environ.get(SUPERVISED_ENV) == "1"
+    ):
+        incidents = IncidentLog.for_train_dir(train_dir)
+    elastic_rig = None
+    if elastic is not None:
+        from atomo_tpu.elastic.coordinator import ElasticCoordinator
+
+        # adopt (or begin) the membership epoch BEFORE forever() advances
+        # the shuffle RNG: the epoch record fingerprints the stream state
+        # its shard map derives from
+        elastic_rig = ElasticCoordinator(
+            elastic,
+            train_dir,
+            n_dev=mesh.shape["dp"],
+            batch_size=train_iter.batch_size,
+            max_steps=max_steps,
+            incidents=incidents,
+            log_fn=log_fn,
+        )
+        elastic_rig.adopt(start_step, rng_crc=train_iter.rng_signature())
     rng_snapshot = train_iter.snapshot_rng() if diverge is not None else None
     stream = train_iter.forever(skip=start_step)
     n_train = len(train_iter.dataset)
     rig = None
-    incidents = None
-    if train_dir and (
-        diverge is not None or tuner is not None
-        or os.environ.get(SUPERVISED_ENV) == "1"
-    ):
-        incidents = IncidentLog.for_train_dir(train_dir)
     if tuner is not None:
         tuner.bind(incidents=incidents, log_fn=log_fn)
     if diverge is not None:
@@ -1952,6 +2108,7 @@ def distributed_train_loop(
                 compress_ckpt, monitor, profile_dir, batch_axes,
                 guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
                 rig=rig, incidents=incidents, tuner=tuner, retune=retune,
+                elastic_rig=elastic_rig,
             )
         else:
             state = _distributed_steps(
@@ -1961,6 +2118,7 @@ def distributed_train_loop(
                 profile_dir, profile_steps, batch_axes,
                 guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
                 rig=rig, incidents=incidents, tuner=tuner, retune=retune,
+                elastic_rig=elastic_rig,
             )
     return state
 
@@ -2022,7 +2180,7 @@ def _distributed_steps(
     save_freq, train_dir, compress_ckpt, monitor, lr_fn=None,
     profile_dir=None, profile_steps=3, batch_axes="dp",
     guard=None, chaos=None, keep_ckpts=0, rig=None, incidents=None,
-    tuner=None, retune=None,
+    tuner=None, retune=None, elastic_rig=None,
 ):
     import time as _time
 
@@ -2081,6 +2239,10 @@ def _distributed_steps(
             new_fn = rig.maybe_end_densify(step)
             if new_fn is not None:
                 step_fn = new_fn
+        if elastic_rig is not None:
+            # one ok_bits scalar fetch per step — the membership layer's
+            # surveillance price, same class as the doctor's loss fetch
+            elastic_rig.observe(step, metrics)
         if tuner is not None:
             # the step is async-dispatched: fence on the loss scalar before
             # stamping, or the series would time enqueue, not execution
@@ -2153,6 +2315,11 @@ def _distributed_steps(
                 new_fn = retune(step)
                 if new_fn is not None:
                     step_fn = new_fn
+            if elastic_rig is not None:
+                # membership transitions snap to the same boundaries: the
+                # save just landed IS the next epoch's start checkpoint.
+                # Raises MembershipChange (the CLI exits rc=29) when due.
+                elastic_rig.maybe_transition(step)
         if tuner is not None:
             # restamp after the boundary work (eval/save/re-probe): those
             # spans are cadence costs, not step time — folding them in
@@ -2223,7 +2390,7 @@ def _distributed_superstep_steps(
     timer, n_train, start_step, max_steps, superstep, log_every, log_fn,
     eval_freq, save_freq, train_dir, compress_ckpt, monitor,
     profile_dir=None, batch_axes="dp", guard=None, chaos=None, keep_ckpts=0,
-    rig=None, incidents=None, tuner=None, retune=None,
+    rig=None, incidents=None, tuner=None, retune=None, elastic_rig=None,
 ):
     """distributed_train_loop's fused block path: one SPMD dispatch per K
     steps, one metric fetch per block, next block's shard_superbatch
@@ -2298,6 +2465,11 @@ def _distributed_superstep_steps(
             new_fn = rig.maybe_end_densify(s)
             if new_fn is not None:
                 step_fn = new_fn
+        if elastic_rig is not None:
+            # the block's (K,) ok_bits series folds at its one fetch —
+            # identical verdicts for any partition (the tracker's
+            # sequential-fold contract)
+            elastic_rig.observe(b0 + 1, m)
         if tuner is not None:
             # the block's wall as kb equal per-step shares (device_get
             # above already fenced the dispatch): feeding ONE mean per
@@ -2342,6 +2514,11 @@ def _distributed_superstep_steps(
                 new_fn = retune(s)
                 if new_fn is not None:
                     step_fn = new_fn
+            if elastic_rig is not None:
+                # boundary-snapped like retune: the save just written is
+                # the next epoch's start checkpoint (raises on a due
+                # shrink/grow — see the per-step loop)
+                elastic_rig.maybe_transition(s)
         if tuner is not None:
             # restamp after boundary work (eval/save/re-probe): cadence
             # costs must not enter the drift baseline

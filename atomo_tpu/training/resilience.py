@@ -87,6 +87,12 @@ ROLLBACK_EXIT_CODE = 23
 # so it gives up at once instead of burning the restart budget on
 # jax-booting re-execs of the same reject
 CONFIG_EXIT_CODE = 2
+# the elastic membership boundary: the child recorded the NEXT epoch in
+# train_dir/membership.json (a shrink to the surviving roster, or a
+# re-grow back to the full one) and exits so the supervisor can re-exec
+# it at the new world size. A PLANNED reshape, not a crash — it is never
+# charged against the restart budget
+MEMBERSHIP_EXIT_CODE = 29
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1099,31 +1105,48 @@ def run_supervised(
                            errors and the CLI's in-run rejects that need
                            the resolved mesh/codec): give up immediately —
                            every restart would die identically.
+      MEMBERSHIP_EXIT_CODE elastic membership boundary: the child recorded
+                           the next epoch in train_dir/membership.json; the
+                           supervisor rewrites ``--n-devices`` to the new
+                           world size (elastic.apply_world_to_argv), hands
+                           the epoch id to children via
+                           ATOMO_MEMBERSHIP_EPOCH, and re-execs WITHOUT
+                           charging the restart budget — a planned reshape
+                           is not a crash. A membership exit whose plan is
+                           missing or not newer than the last adopted one
+                           is triaged as a crash (the runaway-reshape
+                           guard).
       anything else        crash — restart against the budget.
 
-    Restarts append ``resume_flag`` to the command (once), wait a
-    decorrelated-jittered backoff (base ``backoff_base`` s, capped at
-    ``backoff_max`` s), and burn one unit of the ``max_restarts`` budget;
-    exhaustion returns the child's last exit code. Every decision is one
+    Crash/rollback restarts append ``resume_flag`` to the command (once),
+    wait a decorrelated-jittered backoff (base ``backoff_base`` s, capped
+    at ``backoff_max`` s), and burn one unit of the ``max_restarts``
+    budget; exhaustion returns the child's last exit code. Membership
+    re-execs resume immediately, budget untouched. Every decision is one
     record in ``train_dir/incidents.jsonl``.
     """
     import subprocess
 
-    from atomo_tpu.utils.tracing import IncidentLog
+    from atomo_tpu.utils.tracing import MEMBERSHIP_EPOCH_ENV, IncidentLog
 
     incidents = (
         IncidentLog.for_train_dir(train_dir) if train_dir else None
     )
     rng = rng if rng is not None else random.Random()
     base_env = dict(os.environ if env is None else env)
-    attempt = 0
+    cmd = list(cmd)
+    extra_env: dict = {}
+    attempt = 0  # every child run, incl. membership re-execs (ATTEMPT_ENV)
+    budget_used = 0  # crash/rollback restarts only — the actual budget
+    last_epoch: Optional[int] = None
     prev = max(backoff_base, 1e-3)
     while True:
         run_cmd = list(cmd)
         if attempt > 0 and resume_flag and resume_flag not in run_cmd:
             run_cmd.append(resume_flag)
         child_env = {
-            **base_env, SUPERVISED_ENV: "1", ATTEMPT_ENV: str(attempt),
+            **base_env, **extra_env,
+            SUPERVISED_ENV: "1", ATTEMPT_ENV: str(attempt),
         }
         t0 = time.time()
         rc = subprocess.call(run_cmd, env=child_env)
@@ -1135,6 +1158,46 @@ def run_supervised(
                 )
             log_fn(f"Supervisor: clean exit (attempt {attempt})")
             return 0
+        if rc == MEMBERSHIP_EXIT_CODE and train_dir:
+            plan = None
+            try:
+                from atomo_tpu.elastic.membership import MembershipLog
+
+                plan = MembershipLog.load(train_dir).latest()
+            except Exception:  # noqa: BLE001 — unreadable plan = crash triage
+                plan = None
+            if plan is not None and (
+                last_epoch is None or plan.epoch > last_epoch
+            ):
+                from atomo_tpu.elastic.membership import apply_world_to_argv
+
+                last_epoch = plan.epoch
+                cmd = apply_world_to_argv(cmd, plan.world_size)
+                extra_env[MEMBERSHIP_EPOCH_ENV] = str(plan.epoch)
+                if incidents is not None:
+                    incidents.append(
+                        "membership_change",
+                        action=f"reshape->{plan.world_size}",
+                        attempt=attempt,
+                        rc=rc,
+                        epoch=plan.epoch,
+                        world=plan.world_size,
+                        reason=plan.reason,
+                        run_s=wall,
+                    )
+                log_fn(
+                    f"Supervisor: membership epoch {plan.epoch} "
+                    f"({plan.reason}); re-exec with --n-devices "
+                    f"{plan.world_size} (planned reshape — restart "
+                    "budget untouched)"
+                )
+                attempt += 1
+                continue
+            log_fn(
+                f"Supervisor: attempt {attempt} exited rc={rc} "
+                "(membership-change) but membership.json holds no newer "
+                "epoch; triaging as a crash"
+            )
         if rc == CONFIG_EXIT_CODE:
             # deterministic: every restart would die on the same reject
             if incidents is not None:
@@ -1160,7 +1223,7 @@ def run_supervised(
 
             target = latest_healthy_step(train_dir) or 0
             prune_after(train_dir, target)
-        if attempt >= max_restarts:
+        if budget_used >= max_restarts:
             if incidents is not None:
                 incidents.append(
                     "budget_exhausted",
@@ -1190,7 +1253,8 @@ def run_supervised(
         log_fn(
             f"Supervisor: attempt {attempt} exited rc={rc} ({cause}); "
             f"restarting in {delay:.2f}s "
-            f"({max_restarts - attempt} restart(s) left)"
+            f"({max_restarts - budget_used} restart(s) left)"
         )
         sleep(delay)
         attempt += 1
+        budget_used += 1

@@ -390,6 +390,35 @@ def decision_path(train_dir: str) -> str:
     return os.path.join(train_dir, TUNE_DECISION_NAME)
 
 
+def decision_reusable(doc, *, n_dev: int) -> tuple[bool, str]:
+    """Can a ``--resume`` reuse this recorded tune decision?
+
+    A resumed run must NOT re-probe (probe timings vary run to run, and a
+    different winner could try to resume checkpoints written by a
+    different program family) — but reuse has a validity condition the
+    unconditional PR-7 path missed: the decision is a function of the
+    WORLD SIZE (``meta.n_devices``). After an elastic shrink/grow (or a
+    manual relaunch at a different ``--n-devices``) the recorded winner
+    may be sized for a mesh that no longer exists — a ring plan for N
+    chips, a superstep/bucket point picked from N-way probe timings — so
+    a mismatch re-tunes instead of silently applying a stale config.
+    Returns ``(reusable, reason)``; the reason is logged either way and
+    lands in incidents.jsonl on the re-tune path. A PURE function of the
+    document (tested), like choose_winner."""
+    if not doc or not doc.get("complete"):
+        return False, "decision artifact is missing or incomplete"
+    if not ((doc.get("winner") or {}).get("knobs")):
+        return False, "decision artifact names no winner"
+    rec = (doc.get("meta") or {}).get("n_devices")
+    if rec != n_dev:
+        return False, (
+            f"decision was tuned for n_devices={rec} but this run has "
+            f"{n_dev} (elastic shrink/grow or a manual resize) — the "
+            "recorded winner may be invalid for this world; re-tuning"
+        )
+    return True, f"recorded decision matches this world size ({n_dev})"
+
+
 class OnlineRetuner:
     """Rung 0.5 of the resilience ladder: step-time drift -> re-probe.
 

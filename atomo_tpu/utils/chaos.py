@@ -18,6 +18,17 @@ Fault kinds
               norm-screen-passing divergence pressure: the per-step guard
               sees nothing wrong, only the windowed divergence detector
               can catch the trend                           (in-graph)
+  die@S:R     replica R stops contributing from step S ONWARD (default
+              R=0): its gradient is persistently non-finite, so only the
+              guard (which masks it every step — arm --grad-guard) and
+              the elastic membership layer (which sees the same bit low
+              in the ok_bits series and plans the shrink) ever notice;
+              the loss/metric series stays finite and the run completes.
+              Keyed on the membership epoch (ATOMO_MEMBERSHIP_EPOCH):
+              fires only at epoch 0, so a shrunken or re-grown world's
+              member comes back healthy. Unlike every step-targeted
+              fault it IGNORES doctor generations — a dead host stays
+              dead across rollbacks                         (in-graph)
   slow@S:SEC  host sleeps SEC seconds before step S         (host)
   kill@S      process dies (os._exit) before step S runs    (host)
   crashloop@M the process dies at loop start on the first M runs and
@@ -62,7 +73,7 @@ import sys
 import time
 from typing import Optional
 
-from atomo_tpu.utils.tracing import ATTEMPT_ENV
+from atomo_tpu.utils.tracing import ATTEMPT_ENV, MEMBERSHIP_EPOCH_ENV
 
 GRAD_FAULTS = {"nan": 1, "inf": 2, "explode": 3}
 CKPT_FAULTS = ("truncate", "bitflip", "badmagic")
@@ -86,6 +97,7 @@ class ChaosConfig:
     kill_steps: tuple[int, ...] = ()
     ckpt_faults: tuple[tuple[int, str], ...] = ()
     spike_faults: tuple[tuple[int, int], ...] = ()  # (start_step, window)
+    die_faults: tuple[tuple[int, int], ...] = ()  # (start_step, replica)
     spike_scale: float = 8.0  # finite: passes grad_ok's finiteness screen
     crashloop: int = 0  # first M runs die at loop start; run M+1 succeeds
     explode_scale: float = 1e12
@@ -122,7 +134,7 @@ class ChaosConfig:
             seed = int(env.get("ATOMO_CHAOS_SEED", "0"))
         if spike_scale is None:
             spike_scale = float(env.get("ATOMO_CHAOS_SPIKE_SCALE", "8.0"))
-        grad, slow, kill, ckpt, spike = [], [], [], [], []
+        grad, slow, kill, ckpt, spike, die = [], [], [], [], [], []
         crashloop = 0
         for raw in spec.split(","):
             tok = raw.strip().lower()
@@ -133,7 +145,7 @@ class ChaosConfig:
                 raise ValueError(
                     f"bad chaos token {tok!r}; expected kind@step[*][:arg] "
                     f"with kind in "
-                    f"{sorted(GRAD_FAULTS) + ['spike', 'slow', 'kill', 'crashloop'] + list(CKPT_FAULTS)}"
+                    f"{sorted(GRAD_FAULTS) + ['spike', 'die', 'slow', 'kill', 'crashloop'] + list(CKPT_FAULTS)}"
                 )
             kind, step = m.group("kind"), int(m.group("step"))
             arg = m.group("arg")
@@ -144,6 +156,14 @@ class ChaosConfig:
                 if window < 1:
                     raise ValueError(f"spike window must be >= 1, got {window}")
                 spike.append((step, window))
+            elif kind == "die":
+                # the :R slot carries the replica index (default 0)
+                rep = int(float(arg)) if arg else 0
+                if rep < 0:
+                    raise ValueError(
+                        f"die replica must be >= 0, got {rep}"
+                    )
+                die.append((step, rep))
             elif kind == "slow":
                 slow.append((step, float(arg) if arg else 0.25))
             elif kind == "kill":
@@ -161,6 +181,7 @@ class ChaosConfig:
             kill_steps=tuple(kill),
             ckpt_faults=tuple(ckpt),
             spike_faults=tuple(spike),
+            die_faults=tuple(die),
             spike_scale=spike_scale,
             crashloop=crashloop,
             seed=seed,
@@ -179,7 +200,8 @@ class ChaosConfig:
     def enabled(self) -> bool:
         return bool(
             self.grad_faults or self.slow_steps or self.kill_steps
-            or self.ckpt_faults or self.spike_faults or self.crashloop
+            or self.ckpt_faults or self.spike_faults or self.die_faults
+            or self.crashloop
         )
 
 
@@ -192,16 +214,35 @@ class ChaosInjector:
     generation > 0, so a rolled-back run replays the faulted step range
     clean — and the rebuilt step program is identical to a chaos-free one
     (the fault hooks emit no ops). ``crashloop`` ignores generations (it
-    is keyed on the supervised run attempt, not a step)."""
+    is keyed on the supervised run attempt, not a step). ``die`` ignores
+    them too — a dead host stays dead across doctor rollbacks — and is
+    instead keyed on ``membership_epoch`` (default: the supervisor's
+    ATOMO_MEMBERSHIP_EPOCH env, 0 when unset): it fires only at epoch 0,
+    so a shrunken world's replay and a re-admitted member are clean."""
 
-    def __init__(self, config: ChaosConfig, generation: int = 0):
+    def __init__(
+        self,
+        config: ChaosConfig,
+        generation: int = 0,
+        membership_epoch: Optional[int] = None,
+    ):
         self.config = config
         self.generation = generation
+        if membership_epoch is None:
+            membership_epoch = int(
+                os.environ.get(MEMBERSHIP_EPOCH_ENV, "0") or "0"
+            )
+        self.membership_epoch = membership_epoch
 
     def with_generation(self, generation: int) -> "ChaosInjector":
         """The injector the doctor rebuilds step programs with after a
-        rollback: same plan, step-targeted faults disarmed."""
-        return ChaosInjector(self.config, generation=generation)
+        rollback: same plan, step-targeted faults disarmed (``die`` stays
+        armed — it is epoch-keyed, not generation-keyed)."""
+        return ChaosInjector(
+            self.config,
+            generation=generation,
+            membership_epoch=self.membership_epoch,
+        )
 
     @classmethod
     def from_env(cls, environ=None) -> Optional["ChaosInjector"]:
@@ -236,10 +277,12 @@ class ChaosInjector:
         always hit every replica: a sustained finite amplification models
         a globally diverging trajectory, the condition only the windowed
         detector (not the per-step screen) can see. No-op past
-        generation 0 (see class docstring)."""
+        generation 0 (see class docstring) — except ``die``, which is
+        epoch-keyed and survives generation bumps (applied first)."""
         import jax
         import jax.numpy as jnp
 
+        grads = self._inject_die(grads, step, replica)
         if self.generation:
             return grads
         grads = self._inject_spike(grads, step)
@@ -271,6 +314,35 @@ class ChaosInjector:
         )
         return jax.tree_util.tree_map(
             lambda g: g * mul.astype(g.dtype) + add.astype(g.dtype), grads
+        )
+
+    def _inject_die(self, grads, step, replica):
+        """die@S:R — replica R's gradient is non-finite (NaN) from step S
+        ONWARD, modelling a member that stopped contributing: only the
+        guard's screen (which masks it every step) and the membership
+        layer's ok_bits series ever see it. Fires only at membership
+        epoch 0 and only on the targeted replica; a no-op emits no ops,
+        so a shrunken/re-grown world's program is identical to a
+        chaos-free one. ``replica`` None (single-host steps have no
+        replica axis) disarms it — the CLI preflight rejects die@ on a
+        single-device config out loud instead."""
+        import jax
+        import jax.numpy as jnp
+
+        if (
+            not self.config.die_faults
+            or self.membership_epoch
+            or replica is None
+        ):
+            return grads
+        step_t = jnp.asarray(step, jnp.int32)
+        rep = jnp.asarray(replica, jnp.int32)
+        active = jnp.bool_(False)
+        for start, target in self.config.die_faults:
+            active |= (step_t >= start) & (rep == target)
+        add = jnp.where(active, jnp.float32(jnp.nan), jnp.float32(0.0))
+        return jax.tree_util.tree_map(
+            lambda g: g + add.astype(g.dtype), grads
         )
 
     def _inject_spike(self, grads, step):

@@ -38,6 +38,12 @@ from typing import Iterator, Optional
 # it. Defined in this stdlib-only module because utils cannot import
 # training, and sharing one name keeps setter and reader from drifting.
 ATTEMPT_ENV = "ATOMO_RUN_ATTEMPT"
+# Elastic-membership protocol (same placement rationale): the supervisor
+# sets this on children re-exec'd across a membership transition to the
+# new epoch id; utils.chaos keys die@S:R on it (a dead member's fault
+# fires only at epoch 0 — the re-admitted member comes back healthy) and
+# the elastic coordinator cross-checks it against membership.json.
+MEMBERSHIP_EPOCH_ENV = "ATOMO_MEMBERSHIP_EPOCH"
 
 
 @contextlib.contextmanager
@@ -247,6 +253,15 @@ class IncidentLog:
                 bits.append(f"target={r['target']}")
             if "attempt" in r:
                 bits.append(f"attempt={r['attempt']}")
+            # membership / elastic-triage context (PR-9): the epoch and
+            # world size ARE the record for a membership line — dropping
+            # them would reduce a reshape to an unexplained "-> shrink"
+            if "epoch" in r:
+                bits.append(f"epoch={r['epoch']}")
+            if "world" in r:
+                bits.append(f"world={r['world']}")
+            if "rc" in r:
+                bits.append(f"rc={r['rc']}")
             if r.get("action"):
                 bits.append(f"-> {r['action']}")
             lines.append("  " + " ".join(bits))

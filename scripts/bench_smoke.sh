@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Bench smoke (~7 min): prove the bench entrypoint still emits parseable
-# evidence without burning the full-ladder window. Seven checks:
+# evidence without burning the full-ladder window. Eight checks:
 #
 #   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
 #      the driver's last-line JSON contract, PLUS the partial-artifact
@@ -35,6 +35,16 @@
 #      bit-parity assert TRUE, per-tier predicted-vs-measured wire
 #      bytes matching, and a probed mini-tune decision naming
 #      hierarchical candidates — the PR-8 two-tier plan space.
+#
+#   8. the elastic contract (<60 s, forced 4-device CPU mesh): a chaos
+#      die@3:1 run under --elastic must carry the dead replica masked,
+#      shrink to 3 devices at a checkpoint boundary WITHOUT burning a
+#      restart-budget slot, finish at the same step count as an
+#      uninterrupted run, and leave a parseable incidents.jsonl with
+#      membership records plus a membership.json epoch history — the
+#      PR-9 shrink-and-continue rung. (No ATOMO_COMPILE_CACHE here:
+#      sharing one cache dir across the re-exec'd different-world-size
+#      children corrupted executions on this backend — measured.)
 #
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
@@ -71,7 +81,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/7]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/8]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -100,7 +110,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/7]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/8]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -137,7 +147,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/7]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/8]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -168,7 +178,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/7]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/8]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -195,7 +205,7 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/7]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/8]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
 EOF
 [ $? -ne 0 ] && exit 1
@@ -228,7 +238,7 @@ for r in probed:
     assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
     assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
 assert doc.get("why"), doc
-print(f"bench_smoke OK[6/7]: --auto tune picked {win['name']} "
+print(f"bench_smoke OK[6/8]: --auto tune picked {win['name']} "
       f"({win.get('measured_ms_per_step')} ms/step measured, "
       f"{len(probed)}/{len(doc['rows'])} candidates probed); "
       "decision artifact parses")
@@ -272,9 +282,56 @@ for p in plans:
     assert isinstance(p.get("predicted_ms_per_step"), (int, float)), p
 td = row.get("tune_decision") or {}
 assert td.get("hierarchical_probed"), row
-print(f"bench_smoke OK[7/7]: two-tier plans "
+print(f"bench_smoke OK[7/8]: two-tier plans "
       f"{[p['plan'] for p in plans]} measured with per-tier "
       "predicted-vs-measured bytes matching, per-plan bit_parity=True; "
       f"mini-tune probed {td['hierarchical_probed']} "
       f"(winner {(td.get('winner') or {}).get('name')})")
+EOF
+[ $? -ne 0 ] && exit 1
+
+# --- 8: elastic shrink-and-continue drill --------------------------------
+el="$art/elastic"
+out=$(timeout -k 5 60 env JAX_PLATFORMS=cpu ATOMO_COMPILE_CACHE= \
+      XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+      python -m atomo_tpu.cli train --synthetic --dataset mnist \
+      --network lenet --batch-size 12 --max-steps 8 --eval-freq 0 \
+      --save-freq 2 --log-interval 1 --n-devices 4 --code qsgd \
+      --quantization-level 8 --aggregate gather --grad-guard --elastic \
+      --elastic-patience 2 --chaos die@3:1 --max-restarts 1 \
+      --restart-backoff 0.05 --train-dir "$el" 2>&1)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: elastic die@3:1 drill exited rc=$rc"
+  printf '%s\n' "$out" | tail -5
+  exit 1
+fi
+python - "$el" <<'EOF'
+import json, os, sys
+
+d = sys.argv[1]
+# membership epoch history: 0 (world 4) -> 1 (world 3, member 1 left)
+mem = json.load(open(os.path.join(d, "membership.json")))
+worlds = [(e["epoch"], e["world_size"], e["reason"]) for e in mem["epochs"]]
+assert worlds == [(0, 4, "init"), (1, 3, "shrink")], worlds
+assert mem["epochs"][1]["dead"] == [1], mem["epochs"][1]
+# incidents.jsonl parses and carries the membership records; the reshape
+# was a planned transition — no crash, no budget slot burned
+recs = [json.loads(l) for l in open(os.path.join(d, "incidents.jsonl"))]
+memrec = [r for r in recs if r["cause"] == "membership"]
+assert len(memrec) >= 1, recs
+assert [r["action"] for r in memrec] == ["begin", "shrink"], memrec
+reshape = [r for r in recs if r["cause"] == "membership_change"]
+assert len(reshape) == 1 and reshape[0]["world"] == 3, recs
+assert not any(r["cause"] in ("crash", "budget_exhausted") for r in recs), recs
+assert recs[-1]["cause"] == "clean_exit", recs
+# final step count matches the uninterrupted run (max-steps 8)
+sys.path.insert(0, ".")
+from atomo_tpu.training.checkpoint import latest_valid_step
+
+assert latest_valid_step(d) == 8, latest_valid_step(d)
+print("bench_smoke OK[8/8]: die@3:1 shrank 4 -> 3 at a checkpoint "
+      "boundary (planned reshape, restart budget untouched), finished at "
+      f"step {latest_valid_step(d)} with membership epochs "
+      f"{[w[0] for w in worlds]} recorded")
 EOF

@@ -16,6 +16,12 @@ Semantics (training.resilience.run_supervised):
     spent)                       -> prune the checkpoint timeline back to
     the newest HEALTHY step so --resume cannot land on diverged weights,
     then restart against the budget
+  * child exit 29 (MEMBERSHIP_EXIT_CODE: an elastic membership epoch
+    boundary)                    -> re-exec with --n-devices rewritten to
+    the world size recorded in train-dir/membership.json and the epoch id
+    in ATOMO_MEMBERSHIP_EPOCH — a planned reshape, never charged against
+    the restart budget (requires --train-dir; a 29 without a newer
+    recorded epoch is triaged as a crash)
   * any other nonzero exit       -> crash; restart against the budget
 Restarts wait a decorrelated-jittered backoff and burn one unit of the
 budget; exhaustion exits with the child's last code. When --train-dir is
