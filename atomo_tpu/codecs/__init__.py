@@ -11,7 +11,9 @@ from atomo_tpu.codecs.base import (  # noqa: F401
     CodecStats,
     decode_mean_tree,
     decode_tree,
+    encode_leaf_subset,
     encode_tree,
+    encode_tree_streamed,
     payload_nbytes,
     tree_nbytes,
 )

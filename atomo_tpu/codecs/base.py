@@ -91,25 +91,90 @@ def encode_tree(
     serialize. Identical results to the unbucketed path (same per-leaf keys).
     """
     leaves, treedef = jax.tree_util.tree_flatten(grads)
+    # ONE copy of the shape-group/vmap/per-leaf-key logic (both
+    # branches): the whole-tree encode is the single-bucket case of the
+    # streamed per-bucket encoder (identical trace — the bit/byte-
+    # identity contracts of both paths rest on this being one
+    # implementation)
+    payloads = encode_leaf_subset(
+        codec, key, leaves, list(range(len(leaves))), bucketed=bucketed
+    )
+    stats = CodecStats(
+        dense_bytes=sum(l.size * l.dtype.itemsize for l in leaves),
+        payload_bytes=sum(payload_nbytes(p) for p in payloads),
+    )
+    return jax.tree_util.tree_unflatten(treedef, payloads), stats
+
+
+def encode_leaf_subset(
+    codec: Codec, key: PRNGKey, leaves, idxs, bucketed: bool = True
+) -> list:
+    """Encode the leaves named by GLOBAL indices ``idxs`` — one layer
+    bucket of ``--stream-encode``'s plan (parallel.common.plan_layer_buckets).
+
+    Key discipline is IDENTICAL to :func:`encode_tree`: leaf ``i`` encodes
+    with ``fold_in(key, i)`` where ``i`` is the leaf's canonical index in
+    the FULL tree, not its position in this bucket — so the estimator's
+    sampling stream is a function of (key, leaf) alone and any bucket
+    partition produces bit-identical payloads (the plan is a layout knob,
+    never a semantics knob). ``bucketed=True`` applies the same
+    shape-group vmapping as ``encode_tree`` WITHIN the subset (vmap is a
+    batching transform, bit-identical to the per-leaf path — the tested
+    encode_tree claim), so the fused streamed program equals the eager
+    per-bucket oracle equals the monolithic encode, bit for bit.
+
+    Returns the payload list in ``idxs`` order.
+    """
+    out: list = [None] * len(idxs)
+    if not bucketed:
+        for j, i in enumerate(idxs):
+            out[j] = codec.encode(jax.random.fold_in(key, i), leaves[i])
+        return out
+    groups: dict = {}
+    for j, i in enumerate(idxs):
+        leaf = leaves[i]
+        groups.setdefault((tuple(leaf.shape), str(leaf.dtype)), []).append(j)
+    for local in groups.values():
+        keys = jnp.stack([jax.random.fold_in(key, idxs[j]) for j in local])
+        if len(local) == 1:
+            out[local[0]] = codec.encode(keys[0], leaves[idxs[local[0]]])
+            continue
+        stacked = jnp.stack([leaves[idxs[j]] for j in local])
+        batch = jax.vmap(codec.encode)(keys, stacked)
+        for p, j in enumerate(local):
+            out[j] = jax.tree.map(lambda a, p=p: a[p], batch)
+    return out
+
+
+def encode_tree_streamed(
+    codec: Codec, key: PRNGKey, grads: Any, plan
+) -> tuple[Any, CodecStats]:
+    """Per-layer-bucket encode of a gradient pytree (``--stream-encode``).
+
+    Semantically ``encode_tree`` (same per-leaf folded keys, same payload
+    tree, bit-identical — tested per codec for every bucket size), but the
+    DATAFLOW is restructured: each bucket's encode ops depend only on that
+    bucket's gradient leaves, where ``encode_tree(bucketed=True)`` stacks
+    same-shaped leaves across the WHOLE tree (an early conv kernel and a
+    late one ride one vmap, so no encode can start until backprop finishes
+    both ends). With buckets planned reverse-topological
+    (parallel.common.plan_layer_buckets), XLA's latency-hiding scheduler
+    can run bucket 0's encode — the last layers, whose gradients backprop
+    completes first — underneath backprop of the earlier layers feeding
+    bucket 1, and (under ring aggregation) start bucket 0's first
+    ``ppermute`` hops before backward finishes.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if plan.n_leaves != len(leaves):
+        raise ValueError(
+            f"bucket plan covers {plan.n_leaves} leaves but the gradient "
+            f"tree has {len(leaves)} — plan and tree must come from the "
+            "same structure"
+        )
     payloads: list = [None] * len(leaves)
-    if bucketed:
-        groups: dict = {}
-        for i, leaf in enumerate(leaves):
-            groups.setdefault((tuple(leaf.shape), str(leaf.dtype)), []).append(i)
-        for idxs in groups.values():
-            keys = jnp.stack([jax.random.fold_in(key, i) for i in idxs])
-            if len(idxs) == 1:
-                payloads[idxs[0]] = codec.encode(keys[0], leaves[idxs[0]])
-                continue
-            stacked = jnp.stack([leaves[i] for i in idxs])
-            batch = jax.vmap(codec.encode)(keys, stacked)
-            for j, i in enumerate(idxs):
-                payloads[i] = jax.tree.map(lambda a, j=j: a[j], batch)
-    else:
-        payloads = [
-            codec.encode(jax.random.fold_in(key, i), leaf)
-            for i, leaf in enumerate(leaves)
-        ]
+    for idxs in plan.buckets:
+        for j, p in zip(idxs, encode_leaf_subset(codec, key, leaves, idxs)):
+            payloads[j] = p
     stats = CodecStats(
         dense_bytes=sum(l.size * l.dtype.itemsize for l in leaves),
         payload_bytes=sum(payload_nbytes(p) for p in payloads),

@@ -238,6 +238,96 @@ def pallas_quantize_pack(
     return words[:n_buckets], scales[:n_buckets, 0]
 
 
+def _pack_codes_kernel(codes_ref, words_ref, *, bits: int, vpw: int):
+    """One grid step: a block of planar code tiles (B_blk, vpw, n_words)
+    int32 -> packed uint32 words (B_blk, n_words). The bare bit-pack stage
+    of _finish_quantize, split out so the BUCKETED pack/unpack behind
+    ``--stream-encode``'s layer-bucket boundary can run fused without the
+    quantizer (the codec's jnp ``pack_bucketed`` is the bit-parity
+    oracle). Same Mosaic dtype discipline: int32 fields (small,
+    non-negative — exact), bitcast to uint32 only at the output."""
+    bpv = bits + 1
+    codes = codes_ref[:]
+    acc = codes[:, 0, :]
+    for j in range(1, vpw):
+        acc = acc | (codes[:, j, :] << (j * bpv))
+    words_ref[:] = jax.lax.bitcast_convert_type(acc, jnp.uint32)
+
+
+def _unpack_codes_kernel(words_ref, out_ref, *, bits: int, vpw: int):
+    """Inverse of :func:`_pack_codes_kernel`: words -> planar int32 codes
+    (arithmetic >> then & mask == logical shift for these fields)."""
+    bpv = bits + 1
+    words = jax.lax.bitcast_convert_type(words_ref[:], jnp.int32)
+    mask = (1 << bpv) - 1
+    for j in range(vpw):
+        out_ref[:, j, :] = (words >> (j * bpv)) & mask
+
+
+@partial(jax.jit, static_argnames=("bits", "interpret", "block"))
+def pallas_pack_bucketed(
+    codes: jax.Array, *, bits: int, interpret: bool = False, block: int = 8
+):
+    """Fused bucketed bit-pack: (n_buckets, bucket_p) codes ->
+    (n_buckets, bucket_p/vpw) uint32 words, bit-identical to the jnp
+    ``codecs.qsgd.pack_bucketed`` (the oracle; planar field layout —
+    bucket position p = j*n_words + w sits in word w at bit j*(1+bits)).
+    ``bucket_p`` must be a whole number of vals-per-word, exactly as the
+    jnp path requires. One VMEM-resident pass: the codes are read from
+    HBM once and only the ~1/vpw-sized words go back out."""
+    vpw = 32 // (bits + 1)
+    nb, bucket_p = codes.shape
+    if bucket_p % vpw:
+        raise ValueError(
+            f"bucket_p {bucket_p} must be a multiple of vals-per-word "
+            f"{vpw} (pad with zero codes first — the pack_bucketed "
+            "contract)"
+        )
+    n_words = bucket_p // vpw
+    blocks = -(-nb // block)
+    pad_b = blocks * block
+    # int32 in-kernel (Mosaic has no u32 ops); code fields are < 2^(1+bits)
+    planar = (
+        jnp.zeros((pad_b, bucket_p), jnp.int32)
+        .at[:nb]
+        .set(codes.astype(jnp.int32))
+        .reshape(pad_b, vpw, n_words)
+    )
+    words = pl.pallas_call(
+        partial(_pack_codes_kernel, bits=bits, vpw=vpw),
+        out_shape=jax.ShapeDtypeStruct((pad_b, n_words), jnp.uint32),
+        grid=(blocks,),
+        in_specs=[pl.BlockSpec((block, vpw, n_words), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((block, n_words), lambda i: (i, 0)),
+        interpret=_interpret_mode(interpret),
+    )(planar)
+    return words[:nb]
+
+
+@partial(jax.jit, static_argnames=("bits", "interpret", "block"))
+def pallas_unpack_bucketed(
+    words: jax.Array, *, bits: int, interpret: bool = False, block: int = 8
+):
+    """Fused inverse of :func:`pallas_pack_bucketed`: (nb, wpb) uint32 ->
+    (nb, wpb*vpw) uint32 codes, bit-identical to the jnp
+    ``codecs.qsgd.unpack_bucketed`` oracle."""
+    vpw = 32 // (bits + 1)
+    nb, n_words = words.shape
+    blocks = -(-nb // block)
+    pad_b = blocks * block
+    w = jnp.zeros((pad_b, n_words), jnp.uint32).at[:nb].set(words)
+    codes = pl.pallas_call(
+        partial(_unpack_codes_kernel, bits=bits, vpw=vpw),
+        out_shape=jax.ShapeDtypeStruct((pad_b, vpw, n_words), jnp.int32),
+        grid=(blocks,),
+        in_specs=[pl.BlockSpec((block, n_words), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block, vpw, n_words), lambda i: (i, 0, 0)),
+        interpret=_interpret_mode(interpret),
+    )(w)
+    # fields are < 2^(1+bits): the int32 detour is exact (module docstring)
+    return codes.reshape(pad_b, vpw * n_words)[:nb].astype(jnp.uint32)
+
+
 @partial(jax.jit, static_argnames=("bits", "bucket_size", "n", "interpret", "block"))
 def pallas_unpack_dequantize(
     words: jax.Array,

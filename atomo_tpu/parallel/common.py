@@ -105,6 +105,66 @@ def unpack_tree_buckets(bufs, spec: PackSpec):
     return jax.tree_util.tree_unflatten(spec.treedef, leaves)
 
 
+class LayerBucketPlan(NamedTuple):
+    """Ordered layer-axis partition of a gradient pytree — the unit of
+    ``--stream-encode``'s backward-interleaved pipeline (see
+    :func:`plan_layer_buckets`).
+
+    ``buckets[b]`` is a tuple of GLOBAL leaf indices (into the tree's
+    canonical flatten order); bucket 0 holds the LAST-flattened leaves —
+    the last-computed layers, whose gradients backprop finishes first —
+    so bucket order is the order payloads become ready. Every leaf
+    appears in exactly one bucket. A pure trace-time object (Python ints
+    only), so the plan is a LAYOUT knob: which leaves share one encode
+    dispatch, never what any leaf's encode computes.
+    """
+
+    n_leaves: int
+    buckets: tuple  # ((leaf_idx, ...), ...) reverse-topological
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+
+def plan_layer_buckets(tree: Any, bucket_bytes: int = 0) -> LayerBucketPlan:
+    """Partition a gradient pytree into size-bounded LAYER buckets,
+    reverse-topological (DDP-style), for backward-interleaved encode.
+
+    The existing :func:`pack_tree_buckets` buckets along the RING axis
+    (dtype-grouped rotation buffers); this plans along the LAYER axis:
+    leaves are walked in REVERSE canonical flatten order — flax flattens
+    params in module definition order, so the last-flattened leaves
+    belong to the last layers, whose gradients are the FIRST outputs
+    backprop completes — and greedily packed into buckets of at most
+    ``bucket_bytes`` dense bytes (every bucket holds >= 1 leaf, so an
+    oversized leaf becomes its own bucket). ``bucket_bytes <= 0`` yields
+    one bucket holding the whole tree (reverse order).
+
+    Deterministic: a pure function of the tree's leaf shapes/dtypes (the
+    same plan on every chip, every trace). The plan carries GLOBAL leaf
+    indices so per-leaf codec keys fold from the leaf's canonical index
+    regardless of the partition — which is what makes any
+    ``bucket_bytes`` choice produce bit-identical payloads (the
+    estimator never sees the layout knob; tested in
+    tests/test_stream_encode.py).
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    buckets: list[tuple[int, ...]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    for i in reversed(range(len(leaves))):
+        nbytes = int(leaves[i].size) * jnp.dtype(leaves[i].dtype).itemsize
+        if bucket_bytes > 0 and cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(tuple(cur))
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(tuple(cur))
+    return LayerBucketPlan(n_leaves=len(leaves), buckets=tuple(buckets))
+
+
 def dense_init(key, shape, in_axis: int = 0):
     """Plain normal scaled by 1/sqrt(fan_in) of the contracted axis
     (lecun-style variance, untruncated — NOT bit-identical to flax's

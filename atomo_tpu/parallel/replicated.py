@@ -56,11 +56,16 @@ from atomo_tpu.codecs import (
     decode_mean_tree,
     decode_tree,
     encode_tree,
+    encode_tree_streamed,
     payload_nbytes,
     tree_nbytes,
 )
 from atomo_tpu.data.pipeline import augment_batch
-from atomo_tpu.parallel.common import pack_tree_buckets, unpack_tree_buckets
+from atomo_tpu.parallel.common import (
+    pack_tree_buckets,
+    plan_layer_buckets,
+    unpack_tree_buckets,
+)
 from atomo_tpu.parallel.mesh import replicated
 from atomo_tpu.utils.tracing import named_phase
 from atomo_tpu.training.resilience import (
@@ -380,6 +385,66 @@ def _ring_stream_mean(
     return mean_tree, (ok_stage if guard_on else None)
 
 
+def _ring_stream_mean_layered(
+    codec,
+    payloads,
+    grads,
+    plan,
+    *,
+    axis: str,
+    n_dev: int,
+    my,
+    ok=None,
+    sel=None,
+    n_contrib: int,
+    bucket_size: int = 0,
+    survivor_exact: bool = False,
+):
+    """``--stream-encode`` form of :func:`_ring_stream_mean`: one
+    independent mini-ring PER LAYER BUCKET of the plan, so bucket b's
+    rotation (its first ``ppermute`` hops included) is dataflow-dependent
+    only on bucket b's payloads — which under streamed encode depend only
+    on bucket b's gradient leaves. The wire starts moving the moment the
+    last layers' encode lands, underneath backprop of the earlier layers.
+
+    The aggregation OPERATOR is untouched: each bucket's ring is the same
+    canonical-order staged mean ``_ring_stream_mean`` computes, restricted
+    to that bucket's flat span, and decode-then-mean is elementwise per
+    flat element — so the concatenation over buckets is bit-identical to
+    the monolithic ring (and therefore to gather's canonical decode
+    order) for ANY bucket partition. The guard flag rotates alongside
+    EVERY bucket's ring (per-bucket ok granularity: each bucket masks its
+    arriving contribution by the source's health before staging); the
+    flags are one scalar per source, so every bucket stages the identical
+    (N,) health vector — the first bucket's is returned. ``sel`` /
+    ``survivor_exact`` apply per bucket with the same arithmetic.
+
+    Cost accounting (honest): n_buckets x (N-1) ppermutes and n_buckets
+    segment all_gathers instead of one of each — the same total bytes
+    (comm_model.ring_stream_wire_bytes is unchanged), sliced finer so the
+    schedule can pipeline them under compute.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    p_leaves = treedef.flatten_up_to(payloads)
+    out: list = [None] * len(leaves)
+    ok_stage = None
+    for idxs in plan.buckets:
+        mean_b, ok_b = _ring_stream_mean(
+            codec,
+            [p_leaves[i] for i in idxs],
+            [leaves[i] for i in idxs],
+            axis=axis, n_dev=n_dev, my=my,
+            ok=ok, sel=sel, n_contrib=n_contrib,
+            bucket_size=bucket_size,
+            survivor_exact=survivor_exact,
+        )
+        for i, m in zip(idxs, mean_b):
+            out[i] = m
+        if ok_stage is None:
+            ok_stage = ok_b
+    return jax.tree_util.tree_unflatten(treedef, out), ok_stage
+
+
 def _healthy_mean(x, ok, kept_chips, metric_axes):
     """Mean of a per-chip scalar over healthy chips only (guard mode): the
     anomalous replica's loss/precision may be NaN and a plain pmean would
@@ -432,6 +497,8 @@ def make_distributed_train_step(
     ring_bucket_size: int = 65536,
     unfused_decode: bool = False,
     overlap: str = "off",
+    stream_encode: bool = False,
+    stream_bucket_bytes: int = 4 << 20,
     remedy=None,
     track_grad_norm: bool = False,
     track_ok_bits: bool = False,
@@ -547,6 +614,30 @@ def make_distributed_train_step(
     dense/N-sized slices (comm_model.ring_stream_wire_bytes keeps the
     accounting honest); ``--aggregate auto`` picks ring when the gathered
     buffer would outgrow a dense gradient (N >= byte reduction).
+
+    ``stream_encode`` (``--stream-encode``; needs a codec with
+    ``aggregate`` 'gather' or 'ring') builds the backward-interleaved
+    layer-streamed encode: the gradient tree is partitioned DDP-style
+    into size-bounded layer buckets (``stream_bucket_bytes`` dense bytes
+    each, reverse-topological — parallel.common.plan_layer_buckets, the
+    layer-axis complement of the ring's dtype-grouped rotation buckets)
+    and each bucket's encode is dataflow-dependent ONLY on that bucket's
+    gradient leaves, so XLA's latency-hiding scheduler runs bucket b's
+    encode (and, under ring, its first ``ppermute`` hops — each bucket
+    gets its own mini-ring) underneath backprop of the layers feeding
+    bucket b+1: encode leaves the exposed critical path down to the last
+    bucket's tail (utils.comm_model.overlap_report's pipeline
+    accounting). Per-leaf codec keys fold from the GLOBAL leaf index, so
+    the bucket plan is a LAYOUT knob: payloads — and therefore
+    trajectories — are bit-identical to the monolithic encode for ANY
+    bucket size, the streamed program equals the eager per-bucket oracle
+    (encode each bucket standalone, concatenate) bit-for-bit, and
+    ``stream_encode=False`` (default) is the prior program
+    byte-for-byte. Composes with superstep/zero1/guard/chaos/
+    num_aggregate and with ``overlap='delayed'`` (produce-side encode
+    streams; the carried consume chain stays monolithic — it is already
+    off the critical path). Hierarchical/planned schedules are rejected
+    (the boundary re-encode is not bucket-aware yet).
 
     ``unfused_decode`` (gather mode only) forces the canonical
     vmap-decode + mean reduction even for codecs with a fused decode_mean
@@ -689,6 +780,18 @@ def make_distributed_train_step(
         )
     if _oracle_parts and overlap != "delayed":
         raise ValueError("_oracle_parts only applies to overlap='delayed'")
+    if stream_encode and (
+        codec is None or aggregate not in ("gather", "ring")
+    ):
+        raise ValueError(
+            "stream_encode needs a compressing codec with "
+            "aggregate='gather' or 'ring': the layer-bucket pipeline "
+            "restructures the ENCODED exchange — dense psum has no encode "
+            "to stream, and the two-level hierarchical schedules "
+            "(legacy plan and the topology re-encoded plans alike) "
+            "re-encode at the fabric boundary, which is not bucket-aware "
+            "yet — rejected honestly rather than silently degraded"
+        )
     if track_ok_bits:
         if guard is None:
             raise ValueError(
@@ -878,8 +981,23 @@ def make_distributed_train_step(
                 # propagate NaN/Inf into payloads, so post-encode checks
                 # could not tell an anomalous gradient from codec overflow
                 ok = grad_ok(grads, guard.max_grad_norm)
+            # stream_encode: per-layer-bucket encode (reverse-topological
+            # plan, global-leaf-index keys) — bit-identical payloads whose
+            # DATAFLOW lets each bucket's encode run under backprop of the
+            # layers feeding the next bucket. The plan is trace-time
+            # (shapes only); off keeps the monolithic call byte-for-byte.
+            lplan = (
+                plan_layer_buckets(grads, stream_bucket_bytes)
+                if stream_encode
+                else None
+            )
             with named_phase("encode"):
-                payloads, stats = encode_tree(codec, k_codec, grads)
+                if stream_encode:
+                    payloads, stats = encode_tree_streamed(
+                        codec, k_codec, grads, lplan
+                    )
+                else:
+                    payloads, stats = encode_tree(codec, k_codec, grads)
             msg_bytes = stats.payload_bytes
             # deterministic rotating subset (num_aggregate) — identical on
             # every chip, so replicas stay bit-equal
@@ -945,15 +1063,27 @@ def make_distributed_train_step(
             elif aggregate == "ring":
                 # the streaming form of gather: ppermute rotation, decode
                 # overlapped with transfer, no O(N·payload) buffer — see
-                # _ring_stream_mean for the determinism design
+                # _ring_stream_mean for the determinism design. Under
+                # stream_encode each layer bucket gets its own mini-ring
+                # so the first hops depend only on that bucket's encode
+                # (the wire starts before backward finishes).
                 with named_phase("ring_exchange_decode"):
-                    mean_grads, ok_stage = _ring_stream_mean(
-                        codec, payloads, grads,
-                        axis=axis, n_dev=n_dev, my=my,
-                        ok=ok, sel=sel, n_contrib=n_contrib,
-                        bucket_size=ring_bucket_size,
-                        survivor_exact=survivor_exact,
-                    )
+                    if stream_encode:
+                        mean_grads, ok_stage = _ring_stream_mean_layered(
+                            codec, payloads, grads, lplan,
+                            axis=axis, n_dev=n_dev, my=my,
+                            ok=ok, sel=sel, n_contrib=n_contrib,
+                            bucket_size=ring_bucket_size,
+                            survivor_exact=survivor_exact,
+                        )
+                    else:
+                        mean_grads, ok_stage = _ring_stream_mean(
+                            codec, payloads, grads,
+                            axis=axis, n_dev=n_dev, my=my,
+                            ok=ok, sel=sel, n_contrib=n_contrib,
+                            bucket_size=ring_bucket_size,
+                            survivor_exact=survivor_exact,
+                        )
                 if guard is not None:
                     # ok_stage comes back sel-subset already (the helper
                     # applies num_aggregate to flags and slices together)
@@ -1089,8 +1219,20 @@ def make_distributed_train_step(
                 if guard is not None
                 else None
             )
+            # stream_encode in delayed mode restructures the PRODUCE side
+            # only: per-bucket encode overlaps this step's backprop (same
+            # bit-identical payloads). The consume side stays monolithic —
+            # the carried exchange is already dataflow-independent of this
+            # step's compute (the whole point of delayed), so slicing it
+            # finer buys no pipeline and would only multiply collectives.
             with named_phase("encode"):
-                payloads, stats = encode_tree(codec, k_codec, grads)
+                if stream_encode:
+                    payloads, stats = encode_tree_streamed(
+                        codec, k_codec, grads,
+                        plan_layer_buckets(grads, stream_bucket_bytes),
+                    )
+                else:
+                    payloads, stats = encode_tree(codec, k_codec, grads)
             if guard is not None:
                 kept_chips = jax.lax.psum(ok_t.astype(jnp.float32), axis)
                 pm = {
@@ -1386,6 +1528,8 @@ def make_delayed_oracle_steps(
     chaos=None,
     ring_bucket_size: int = 65536,
     unfused_decode: bool = False,
+    stream_encode: bool = False,
+    stream_bucket_bytes: int = 4 << 20,
 ):
     """The two-program EAGER oracle for ``overlap='delayed'``.
 
@@ -1410,6 +1554,8 @@ def make_delayed_oracle_steps(
         zero1_specs=zero1_specs, grad_accum=grad_accum, guard=guard,
         chaos=chaos, ring_bucket_size=ring_bucket_size,
         unfused_decode=unfused_decode, overlap="delayed",
+        stream_encode=stream_encode,
+        stream_bucket_bytes=stream_bucket_bytes,
         _oracle_parts=True,
     )
 
@@ -1584,6 +1730,8 @@ def distributed_train_loop(
     superstep: int = 1,
     ring_bucket_size: int = 65536,
     overlap: str = "off",
+    stream_encode: bool = False,
+    stream_bucket_bytes: int = 4 << 20,
     diverge=None,
     tuner=None,
     plan=None,
@@ -1643,6 +1791,11 @@ def distributed_train_loop(
     schedule for ``aggregate='hierarchical'`` — inner psum/cring,
     boundary re-encode, outer gather/ring/dense (see
     make_distributed_train_step); None keeps the legacy plan.
+
+    ``stream_encode`` (``--stream-encode``) runs the backward-interleaved
+    layer-streamed encode (see make_distributed_train_step): bit-identical
+    trajectories for any ``stream_bucket_bytes``, gather/ring only; the
+    doctor's densify window runs monolithic (dense psum has no encode).
 
     ``tuner`` (tuning.autopilot.OnlineRetuner) arms the performance
     ladder's rung 0.5: the loop feeds it the per-step wall-time series
@@ -1709,6 +1862,21 @@ def distributed_train_loop(
             "the online re-tuner rebuilds the fused step; --phase-metrics "
             "has no fused step to re-pick — drop one"
         )
+    if stream_encode:
+        if codec is None or aggregate not in ("gather", "ring"):
+            raise ValueError(
+                "--stream-encode needs a compressing codec with "
+                "--aggregate gather or ring (psum has no encode to "
+                "stream; the hierarchical boundary re-encode is not "
+                "bucket-aware yet — rejected rather than silently "
+                "degraded)"
+            )
+        if phase_metrics:
+            raise ValueError(
+                "--phase-metrics times a monolithic encode phase program "
+                "and cannot describe the bucket-streamed schedule; drop "
+                "one of the flags"
+            )
     if elastic is not None:
         if guard is None:
             raise ValueError(
@@ -1985,6 +2153,10 @@ def distributed_train_loop(
                 inner_axis=inner_axis, guard=guard, chaos=chaos_now,
                 superstep=superstep, ring_bucket_size=ring_bucket_size,
                 overlap="off" if densify else overlap,
+                # densify swaps to dense psum aggregation, which has no
+                # encode to stream — the window runs monolithic
+                stream_encode=False if densify else stream_encode,
+                stream_bucket_bytes=stream_bucket_bytes,
                 remedy=remedy_cfg, track_grad_norm=diverge is not None,
                 track_ok_bits=elastic is not None,
                 survivor_exact=elastic is not None,

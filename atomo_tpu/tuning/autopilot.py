@@ -1,8 +1,9 @@
 """Performance autopilot — ``--auto tune``: probe-driven config selection.
 
-The framework exposes ~6 orthogonal performance knobs (codec+rank,
-``--aggregate``, ``--superstep K``, ``--overlap``, ``--zero1``, ring
-bucket size) and an honest comm model — but a user gets static defaults,
+The framework exposes ~7 orthogonal performance knobs (codec+rank,
+``--aggregate``, ``--superstep K``, ``--overlap``, ``--stream-encode``,
+``--zero1``, ring bucket size) and an honest comm model — but a user
+gets static defaults,
 and the PR-4 measured result (the delayed-overlap win is load-dependent
 skew absorption) proves the best config is not static. This module closes
 the loop, SparCML/Parallax-style (pick the representation/collective per
@@ -106,7 +107,7 @@ def winner_knobs(row: dict) -> dict:
     return {
         k: row[k]
         for k in ("aggregate", "overlap", "superstep", "ring_bucket_size",
-                  "plan")
+                  "plan", "stream_encode", "stream_bucket_bytes")
         if k in row
     }
 
@@ -174,6 +175,9 @@ def tune(
     allow_ring: bool = True,
     allow_psum: bool = True,
     allow_overlap: bool = True,
+    allow_stream: bool = False,
+    stream_bucket_bytes: int = 4 << 20,
+    stream_buckets: int = 0,
     superstep_options=(1, 8),
     bucket_options=(65536,),
     dcn_ways: int = 0,
@@ -260,6 +264,9 @@ def tune(
         allow_ring=allow_ring,
         allow_psum=allow_psum,
         allow_overlap=allow_overlap,
+        allow_stream=allow_stream,
+        stream_bucket_bytes=stream_bucket_bytes,
+        stream_buckets=stream_buckets,
         superstep_options=superstep_options,
         bucket_options=bucket_options,
         dcn_ways=int(dcn_ways) if two_tier else 0,
@@ -311,7 +318,8 @@ def tune(
             k: v
             for k, v in cand.items()
             if k in ("aggregate", "overlap", "superstep",
-                     "ring_bucket_size", "plan", "name")
+                     "ring_bucket_size", "plan", "name",
+                     "stream_encode", "stream_bucket_bytes")
         }
         try:
             row = probe_candidate(

@@ -271,6 +271,10 @@ def probe_candidate(
             compute_dtype=compute_dtype, zero1_specs=zero1_specs,
             grad_accum=grad_accum, superstep=k, overlap=overlap,
             ring_bucket_size=cand.get("ring_bucket_size", ring_bucket_size),
+            stream_encode=cand.get("stream_encode") == "on",
+            stream_bucket_bytes=int(
+                cand.get("stream_bucket_bytes", 4 << 20)
+            ),
             inner_axis=inner_axis, plan=plan,
         )
         if overlap == "delayed":

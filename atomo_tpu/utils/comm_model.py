@@ -238,6 +238,33 @@ def gather_buffer_bytes(payload_bytes: float, ways: int) -> float:
     return float(payload_bytes) * ways
 
 
+def stream_bucket_count(dense_bytes: float, bucket_bytes: float) -> int:
+    """Layer-bucket count of a ``--stream-encode`` plan, ESTIMATED from
+    byte totals under uniform packing. An estimate, not the real plan:
+    the planner never splits a leaf, so a single leaf above the bound
+    (an LM embedding) makes the real count — and the real exposed tail —
+    much smaller than this ratio suggests. Callers that can see the
+    gradient tree should pass the REAL ``plan_layer_buckets(...).n_buckets``
+    through the candidate's ``stream_buckets`` knob instead (the CLI
+    autopilot does); this fallback only orders probe ladders, and the
+    calibration warning catches it when it misleads.
+    ``bucket_bytes <= 0`` is the single-bucket plan."""
+    if bucket_bytes <= 0:
+        return 1
+    return max(1, int(math.ceil(float(dense_bytes) / float(bucket_bytes))))
+
+
+def stream_exposed_encode_s(encode_s: float, n_buckets: int) -> float:
+    """Encode seconds still ON the critical path under ``--stream-encode``:
+    the pipeline TAIL. With the gradient tree in n reverse-topological
+    buckets, bucket b's encode runs under backprop of the layers feeding
+    bucket b+1 — only the LAST bucket's encode (~1/n of the total,
+    uniform-bucket model) has no backprop left to hide under. n = 1 (or
+    stream off) keeps the whole encode exposed — exactly the pre-stream
+    accounting ``overlap_report`` used to state."""
+    return max(float(encode_s), 0.0) / max(int(n_buckets), 1)
+
+
 def overlap_hidden_comm_s(comm_s: float, compute_s: float) -> float:
     """Seconds of the exchange+decode chain that ``--overlap delayed``
     hides underneath fwd/bwd+update: overlap hides min(comm, compute) —
@@ -263,6 +290,9 @@ def overlap_report(
     compute_s: float,
     decode_s: float = 0.0,
     aggregate: str = "gather",
+    encode_s: float = 0.0,
+    stream_encode: bool = False,
+    stream_buckets: int = 1,
 ) -> dict:
     """Model what ``--overlap delayed`` buys at N ``ways`` over a fabric.
 
@@ -273,8 +303,16 @@ def overlap_report(
     delayed step = compute + exposed(chain), where overlap hides
     min(chain, compute) — BOTH numbers are reported, per the honesty rule
     that a hidden cost is still a cost (it returns the moment compute
-    shrinks below it). Encode is NOT in the chain: it consumes this
-    step's gradient, so it stays on the critical path in either mode.
+    shrinks below it).
+
+    Encode (``encode_s``, measured — pass 0 to omit it as before) is NOT
+    in the delayed chain: it consumes THIS step's gradient. Without
+    ``--stream-encode`` it is therefore fully exposed in either mode.
+    With ``stream_encode`` the layer-bucket pipeline hides all but the
+    TAIL under backprop — exposed encode becomes
+    :func:`stream_exposed_encode_s` (``encode_s / stream_buckets``) and
+    the report states the pipeline accounting explicitly: the hidden
+    share is a cost backprop absorbs, not a cost that vanished.
     """
     if aggregate == "ring":
         wire = ring_stream_wire_bytes(payload_bytes, dense_bytes, ways)
@@ -283,6 +321,11 @@ def overlap_report(
     comm_s = wire / float(fabric_bw) + max(float(decode_s), 0.0)
     hidden = overlap_hidden_comm_s(comm_s, compute_s)
     exposed = overlap_exposed_comm_s(comm_s, compute_s)
+    enc = max(float(encode_s), 0.0)
+    enc_exposed = (
+        stream_exposed_encode_s(enc, stream_buckets) if stream_encode
+        else enc
+    )
     return {
         "aggregate": aggregate,
         "ways": ways,
@@ -291,13 +334,24 @@ def overlap_report(
         "compute_ms": round(float(compute_s) * 1e3, 3),
         "hidden_ms": round(hidden * 1e3, 3),
         "exposed_ms": round(exposed * 1e3, 3),
-        "blocking_step_ms": round((compute_s + comm_s) * 1e3, 3),
-        "delayed_step_ms": round((compute_s + exposed) * 1e3, 3),
+        "encode_ms": round(enc * 1e3, 3),
+        "encode_exposed_ms": round(enc_exposed * 1e3, 3),
+        "encode_hidden_ms": round((enc - enc_exposed) * 1e3, 3),
+        "stream_encode": bool(stream_encode),
+        "stream_buckets": int(stream_buckets) if stream_encode else 1,
+        "blocking_step_ms": round(
+            (compute_s + comm_s + enc_exposed) * 1e3, 3
+        ),
+        "delayed_step_ms": round(
+            (compute_s + exposed + enc_exposed) * 1e3, 3
+        ),
         "assumptions": (
             "delayed overlaps exchange+decode with fwd/bwd+update; hides "
-            "min(comm, compute), exposes the excess; encode stays on the "
-            "critical path (it consumes this step's gradient) — see "
-            "atomo_tpu/utils/comm_model.py"
+            "min(comm, compute), exposes the excess; encode consumes this "
+            "step's gradient — fully exposed without --stream-encode, and "
+            "with it the layer-bucket pipeline hides all but the tail "
+            "(exposed encode = max(0, encode_tail) = encode/n_buckets, "
+            "uniform-bucket model) — see atomo_tpu/utils/comm_model.py"
         ),
     }
 
@@ -378,6 +432,8 @@ def candidate_name(cand: dict) -> str:
     elif cand.get("aggregate"):
         bits.append(cand["aggregate"])
         bits.append(cand.get("overlap", "off"))
+    if cand.get("stream_encode") == "on":
+        bits.append("se")  # backward-interleaved layer-streamed encode
     bits.append(f"k{cand.get('superstep', 1)}")
     if cand.get("aggregate") == "ring":
         bits.append(f"b{cand.get('ring_bucket_size', 65536)}")
@@ -391,6 +447,9 @@ def enumerate_candidates(
     allow_ring: bool = True,
     allow_psum: bool = True,
     allow_overlap: bool = True,
+    allow_stream: bool = False,
+    stream_bucket_bytes: int = 4 << 20,
+    stream_buckets: int = 0,
     superstep_options=(1, 8),
     bucket_options=(65536,),
     dcn_ways: int = 0,
@@ -409,7 +468,15 @@ def enumerate_candidates(
     topology.schedule plan (``plan_names`` narrows the plan space) —
     the PR-8 lift of the autopilot's hierarchical exclusion. They carry
     no delayed form (the two-level schedules are blocking) and require a
-    codec (the plans compress at least one tier)."""
+    codec (the plans compress at least one tier).
+
+    ``allow_stream`` emits a ``--stream-encode on`` variant of every
+    compressed gather/ring candidate (suffix ``+se``; the hierarchical
+    plans are excluded — their boundary re-encode is not bucket-aware).
+    The knob is trajectory-neutral (bit-identical payloads for any
+    bucket plan), so stream candidates are pure schedule points;
+    ``stream_bucket_bytes`` rides along so prediction and probe price
+    the plan the run would execute."""
     ks = sorted({max(int(k), 1) for k in superstep_options})
     out: list[dict] = []
     if ways <= 1:
@@ -433,13 +500,32 @@ def enumerate_candidates(
                 if agg == "ring"
                 else [None]
             )
+            streams = [None]
+            if allow_stream and agg in ("gather", "ring"):
+                streams.append(int(stream_bucket_bytes))
             for ov in overlaps:
                 for k in ks:
                     for b in buckets:
-                        c = {"aggregate": agg, "overlap": ov, "superstep": k}
-                        if b is not None:
-                            c["ring_bucket_size"] = b
-                        out.append(c)
+                        for sb in streams:
+                            c = {
+                                "aggregate": agg,
+                                "overlap": ov,
+                                "superstep": k,
+                            }
+                            if b is not None:
+                                c["ring_bucket_size"] = b
+                            if sb is not None:
+                                c["stream_encode"] = "on"
+                                c["stream_bucket_bytes"] = sb
+                                if stream_buckets > 0:
+                                    # the REAL plan's bucket count when
+                                    # the caller could see the gradient
+                                    # tree — predict_step_s prefers it
+                                    # over the byte-ratio estimate
+                                    c["stream_buckets"] = int(
+                                        stream_buckets
+                                    )
+                            out.append(c)
     if (
         has_codec
         and ways > 1
@@ -485,7 +571,11 @@ def predict_step_s(
     the critical path, it consumes this step's gradient), and
     ``--superstep K`` divides the per-dispatch host cost by K. The codec
     tax (encode + decode round trip) is split evenly across the two ends
-    — the anchor measures only their sum. All the byte formulas are the
+    — the anchor measures only their sum. A ``--stream-encode on``
+    candidate replaces the encode term with its pipeline TAIL
+    (:func:`stream_exposed_encode_s` over the bucket count implied by the
+    candidate's ``stream_bucket_bytes``): the rest of the encode runs
+    under backprop. All the byte formulas are the
     honest-accounting ones above; the anchors are stated estimates the
     probe ladder corrects.
 
@@ -535,6 +625,16 @@ def predict_step_s(
     if tax_s is None:
         tax_s = estimate_codec_tax_s(dense_bytes)
     encode_s = decode_s = tax_s / 2.0
+    if cand.get("stream_encode") == "on" and agg in ("gather", "ring"):
+        # layer-streamed encode: only the last bucket's tail stays
+        # exposed. Prefer the candidate's REAL plan bucket count
+        # (stream_buckets, attached by callers that can see the gradient
+        # tree) over the uniform-packing byte estimate, which overstates
+        # granularity when a single leaf exceeds the bound
+        n_b = int(cand.get("stream_buckets", 0)) or stream_bucket_count(
+            dense_bytes, cand.get("stream_bucket_bytes", 4 << 20)
+        )
+        encode_s = stream_exposed_encode_s(encode_s, n_b)
     if agg == "psum":
         # codec semantics over a dense wire: the round trip runs per-chip,
         # the exchange is the dense all-reduce
@@ -592,6 +692,7 @@ def recommend_for_scenario(
     dense_key: str = "dense",
     dispatch_s: float = 0.0,
     allow_overlap: bool = True,
+    allow_stream: bool = False,
 ) -> dict:
     """Per-scenario recommended config: measured single-chip anchors +
     the analytic fabric term (exactly crossover_report's construction,
@@ -620,6 +721,11 @@ def recommend_for_scenario(
         cands = enumerate_candidates(
             has_codec=bool(has_codec), ways=ways,
             allow_overlap=allow_overlap,
+            # stream-encode candidates (+se) are opt-in here so the
+            # published tables' candidate space only widens when the
+            # caller asks (scenario_table.py --stream; bench config 10
+            # keeps its historical space)
+            allow_stream=allow_stream,
         )
         top = rank_candidates(
             cands,

@@ -29,8 +29,9 @@ scalar is non-finite or a computed MFU falls outside (0, 1).
 By default the WHOLE ladder runs (the five BASELINE.md configs plus the LM
 config 6, the shipped-loop superstep config 7, and the forced-CPU-mesh
 semantics compares: ring-vs-gather config 8, overlap-vs-blocking
-config 9, and the autopilot scenario matrix config 10): one JSON row per
-config
+config 9, the autopilot scenario matrix config 10, the two-tier plan
+matrix config 11, and the stream-encode exposure config 12): one JSON
+row per config
 as it completes, then ONE final aggregate line — the headline config-2 row
 with a "configs" list embedding every row (VERDICT r2 next-round #4; the
 driver parses the last line). The parent enforces a global wall-clock
@@ -170,6 +171,22 @@ CONFIGS = {
     # "none"; fast mode keeps two plans and a two-plan tune space.
     11: dict(metric="two_tier_matrix", kind="twotier", batch=8, n_dev=4,
              ways=4, dcn_ways=2, force_cpu_mesh=True),
+    # Config 12 (PR-10 stream-encode tentpole): stream_encode_exposure —
+    # the backward-interleaved layer-streamed encode on the forced 4-dev
+    # CPU mesh. Per-phase encode exposed-vs-hidden ms: the monolithic
+    # encode program vs the per-bucket streamed one, with the pipeline
+    # accounting comm_model.stream_exposed_encode_s states (only the
+    # last bucket's tail stays on the critical path), full fenced step
+    # times for --stream-encode off vs on (ring — the mode whose first
+    # hops also pipeline), and the in-row bit-parity asserts: streamed
+    # payloads == monolithic payloads and the streamed step's params ==
+    # the off step's, bit for bit (the layout-knob contract). Semantics +
+    # schedule micro-compare like configs 8-9, not a chip-speed claim;
+    # headline TPU rows stay measurement_valid: false per ROADMAP — this
+    # CPU-mesh evidence is the bar. Baseline "none".
+    12: dict(metric="stream_encode_exposure", kind="streamenc",
+             network="lenet", batch=16, n_dev=4, ways=4,
+             stream_bucket_bytes=1 << 18, force_cpu_mesh=True),
 }
 
 # Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
@@ -961,6 +978,256 @@ def measure_overlap_compare(cfg: dict) -> dict:
     return out
 
 
+def measure_stream_encode(cfg: dict) -> dict:
+    """Config-12: ``--stream-encode`` exposed-encode evidence on the
+    forced multi-device CPU mesh.
+
+    Three layers of evidence in one row: (1) the per-phase encode
+    programs — monolithic ``encode_tree`` vs the per-layer-bucket
+    ``encode_tree_streamed`` — timed with the fence discipline, and the
+    exposed-encode ms each schedule leaves on the critical path per the
+    comm model's pipeline accounting (monolithic: all of it; streamed:
+    the last bucket's tail, ``stream_exposed_encode_s``); (2) fenced
+    full-step times for ``--stream-encode`` off vs on under ring
+    aggregation (the mode whose first ppermute hops pipeline too);
+    (3) the in-row bit-parity asserts that make the knob trajectory-safe:
+    streamed payloads are bit-identical to monolithic payloads, and the
+    streamed step's params bit-match the off step's after the timed
+    dispatch loop. A semantics + schedule micro-compare (configs 8-9
+    class), not a chip-speed claim."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from atomo_tpu.codecs import (
+        QsgdCodec,
+        encode_tree,
+        encode_tree_streamed,
+    )
+    from atomo_tpu.models import get_model
+    from atomo_tpu.parallel import (
+        make_distributed_train_step,
+        make_mesh,
+        replicate_state,
+        shard_batch,
+    )
+    from atomo_tpu.parallel.common import plan_layer_buckets
+    from atomo_tpu.training import create_state, make_optimizer
+    from atomo_tpu.training.trainer import cross_entropy_loss
+    from atomo_tpu.utils.comm_model import stream_exposed_encode_s
+    from atomo_tpu.utils.tracing import fence_tree as fence
+
+    fast = os.environ.get("ATOMO_BENCH_FAST") == "1"
+    dev = jax.devices()[0]
+    n_dev = min(int(cfg.get("n_dev", 4)), len(jax.devices()))
+    sb = int(cfg.get("stream_bucket_bytes", 1 << 18))
+    base = dict(
+        metric=cfg["metric"], unit="ms/step", value=None,
+        byte_reduction=None, mfu=None, flops_per_step=None,
+        peak_tflops=None, platform=dev.platform, device=dev.device_kind,
+        ways=n_dev, chips_measured=n_dev,
+        timing="dispatch-loop-scalar-fenced",
+        config=dict(kind="streamenc", network=cfg["network"],
+                    batch=cfg["batch"], n_dev=n_dev,
+                    stream_bucket_bytes=sb),
+        note=("semantics + schedule micro-compare of --stream-encode on "
+              f"vs off on a {n_dev}-device {dev.platform} mesh; not a "
+              "chip-speed row"),
+    )
+    if n_dev < 2:
+        base.update(measurement_valid=False,
+                    invalid_reason="single device: no exchange whose "
+                                   "encode is on the critical path")
+        return base
+
+    mesh = make_mesh(n_dev)
+    model = get_model(cfg["network"], 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    images = jax.random.uniform(rng, (cfg["batch"], 28, 28, 1), jnp.float32)
+    labels = jax.random.randint(rng, (cfg["batch"],), 0, 10)
+    state0 = create_state(model, opt, rng, images)
+    host0 = jax.device_get(state0)
+    key = jax.random.PRNGKey(1)
+    si, sl = shard_batch(mesh, images, labels)
+    codec = QsgdCodec(bits=8, bucket_size=512)
+    reps = 20
+    if fast:
+        reps = _env_int("ATOMO_BENCH_STEPS", reps)
+    best_of = 1 if fast else 3
+
+    def fresh():
+        return replicate_state(
+            mesh, jax.tree_util.tree_map(jnp.asarray, host0)
+        )
+
+    out = dict(base, measurement_valid=True, invalid_reason=None)
+    try:
+        # --- full steps, ring aggregation, stream off vs on ------------
+        step_times = {}
+        stepped = {}
+        for label, stream in (("off", False), ("stream", True)):
+            step = make_distributed_train_step(
+                model, opt, mesh, codec, aggregate="ring",
+                stream_encode=stream, stream_bucket_bytes=sb,
+            )
+            st = fresh()
+            m = None
+            for _ in range(3):
+                st, m = step(st, key, si, sl)
+            s = fence(m["loss"])
+            if not math.isfinite(s):
+                raise RuntimeError(f"{label} warmup loss not finite")
+            best = float("inf")
+            for _ in range(best_of):
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    st, m = step(st, key, si, sl)
+                s = fence(m["loss"])
+                best = min(best, (time.perf_counter() - t0) / reps)
+                if not math.isfinite(s):
+                    raise RuntimeError(f"{label} fence scalar not finite")
+            step_times[label] = best
+            stepped[label] = jax.device_get(st)
+        out["value"] = round(step_times["stream"] * 1e3, 3)
+        out["off_ms_per_step"] = round(step_times["off"] * 1e3, 3)
+        # config 9's overlap_speedup convention: >1 = streaming is faster
+        out["stream_speedup"] = round(
+            step_times["off"] / step_times["stream"], 3
+        )
+        # the layout-knob contract, full-trajectory form: after identical
+        # dispatch loops the two programs hold identical bits
+        out["step_param_bit_parity"] = bool(all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(stepped["off"].params),
+                jax.tree_util.tree_leaves(stepped["stream"].params),
+            )
+        ))
+        if not out["step_param_bit_parity"]:
+            _mark_invalid(
+                out,
+                "streamed step params are NOT bit-identical to the off "
+                "step's (the stream-encode layout-knob contract)",
+            )
+
+        # --- per-phase encode programs over a fixed gradient tree ------
+        grads = jax.tree_util.tree_map(
+            lambda a: jax.random.normal(
+                jax.random.PRNGKey(7), a.shape, jnp.float32
+            ),
+            host0.params,
+        )
+        plan = plan_layer_buckets(grads, sb)
+        n_buckets = plan.n_buckets
+
+        def sm(fn, in_specs, out_specs):
+            return jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            ))
+
+        def timed_calls(fn, *args):
+            o = fn(*args)
+            s = fence(o)
+            best = float("inf")
+            for _ in range(best_of):
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    o = fn(*args)
+                s = fence(o)
+                best = min(best, (time.perf_counter() - t0) / reps)
+            if not math.isfinite(s):
+                raise RuntimeError("phase fence scalar not finite")
+            return best, o
+
+        def comp(params, stats, im, lb):
+            def loss_fn(p):
+                variables = {"params": p}
+                if jax.tree_util.tree_leaves(stats):
+                    variables["batch_stats"] = stats
+                out_ = model.apply(
+                    variables, im, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(0)},
+                    mutable=["batch_stats"]
+                    if jax.tree_util.tree_leaves(stats) else [],
+                )
+                return cross_entropy_loss(out_[0], lb)
+
+            g = jax.grad(loss_fn)(params)
+            return jax.tree_util.tree_map(lambda a: a[None], g)
+
+        comp_fn = sm(comp, (P(), P(), P("dp"), P("dp")), P("dp"))
+        dt_comp, _ = timed_calls(comp_fn, host0.params, host0.batch_stats,
+                                 si, sl)
+
+        def enc_mono(g):
+            my = jax.lax.axis_index("dp")
+            p, _ = encode_tree(codec, jax.random.fold_in(key, my), g)
+            return jax.tree_util.tree_map(lambda a: a[None], p)
+
+        def enc_stream(g):
+            my = jax.lax.axis_index("dp")
+            p, _ = encode_tree_streamed(
+                codec, jax.random.fold_in(key, my), g, plan
+            )
+            return jax.tree_util.tree_map(lambda a: a[None], p)
+
+        dt_mono, p_mono = timed_calls(sm(enc_mono, (P(),), P("dp")), grads)
+        dt_stream, p_stream = timed_calls(
+            sm(enc_stream, (P(),), P("dp")), grads
+        )
+        out["payload_bit_parity"] = bool(all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(jax.device_get(p_mono)),
+                jax.tree_util.tree_leaves(jax.device_get(p_stream)),
+            )
+        ))
+        if not out["payload_bit_parity"]:
+            _mark_invalid(
+                out,
+                "streamed payloads are NOT bit-identical to the "
+                "monolithic encode (the global-leaf-key contract)",
+            )
+        exposed_off = dt_mono  # monolithic: the whole encode is the tail
+        exposed_stream = stream_exposed_encode_s(dt_stream, n_buckets)
+        out["phases"] = {
+            "compute_ms": round(dt_comp * 1e3, 3),
+            "encode_monolithic_ms": round(dt_mono * 1e3, 3),
+            "encode_streamed_ms": round(dt_stream * 1e3, 3),
+            "n_buckets": n_buckets,
+            "encode_exposed_off_ms": round(exposed_off * 1e3, 3),
+            "encode_exposed_stream_ms": round(exposed_stream * 1e3, 3),
+            "encode_hidden_stream_ms": round(
+                (dt_stream - exposed_stream) * 1e3, 3
+            ),
+            "note": ("pipeline accounting: streamed encode's buckets run "
+                     "under backprop of the layers feeding the next "
+                     "bucket; only the last bucket's tail (~encode/"
+                     "n_buckets, uniform model) stays exposed — "
+                     "comm_model.stream_exposed_encode_s. HONESTY: the "
+                     "exposed/hidden split is MODEL arithmetic over "
+                     "measured standalone phase times (it can only fail "
+                     "if streaming made encode >= n_buckets x slower); "
+                     "the end-to-end MEASURED overlap signal is the "
+                     "full-step stream_speedup above"),
+        }
+        out["exposed_encode_reduced"] = bool(exposed_stream < exposed_off)
+        if not out["exposed_encode_reduced"]:
+            _mark_invalid(
+                out,
+                "streamed exposed-encode tail not below the monolithic "
+                "exposed encode (single-bucket plan or a degenerate "
+                "timing)",
+            )
+    except Exception as exc:  # noqa: BLE001 — a failed compare is a failed row
+        _mark_invalid(out, f"stream-encode compare failed: {str(exc)[:200]}")
+    return out
+
+
 def gather_vs_ring_parity(mesh, codec, grads, key, n_dev: int,
                           bucket_size: int = 65536) -> bool:
     """The PR-3 aggregation-operator contract, as one reusable check:
@@ -1546,6 +1813,8 @@ def measure_ours(cfg: dict) -> dict:
         return measure_scenarios(cfg)
     if cfg.get("kind") == "twotier":
         return measure_two_tier(cfg)
+    if cfg.get("kind") == "streamenc":
+        return measure_stream_encode(cfg)
 
     model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)

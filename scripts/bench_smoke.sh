@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Bench smoke (~7 min): prove the bench entrypoint still emits parseable
-# evidence without burning the full-ladder window. Eight checks:
+# evidence without burning the full-ladder window. Nine checks:
 #
 #   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
 #      the driver's last-line JSON contract, PLUS the partial-artifact
@@ -46,6 +46,13 @@
 #      sharing one cache dir across the re-exec'd different-world-size
 #      children corrupted executions on this backend — measured.)
 #
+#   9. the stream-encode contract (<60 s, forced 4-device CPU mesh):
+#      bench config 12 must exit 0 with the per-phase encode
+#      exposed-vs-hidden fields present, the streamed exposed-encode
+#      tail REDUCED vs --stream-encode off in the same row, and both
+#      in-row bit-parity asserts (payloads and step params) TRUE — the
+#      PR-10 backward-interleaved layer-streamed encode.
+#
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
 cd "$(dirname "$0")/.." || exit 2
@@ -81,7 +88,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/8]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/9]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -110,7 +117,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/8]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/9]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -147,7 +154,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/8]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/9]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -178,7 +185,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/8]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/9]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -205,7 +212,7 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/8]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/9]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
 EOF
 [ $? -ne 0 ] && exit 1
@@ -238,7 +245,7 @@ for r in probed:
     assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
     assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
 assert doc.get("why"), doc
-print(f"bench_smoke OK[6/8]: --auto tune picked {win['name']} "
+print(f"bench_smoke OK[6/9]: --auto tune picked {win['name']} "
       f"({win.get('measured_ms_per_step')} ms/step measured, "
       f"{len(probed)}/{len(doc['rows'])} candidates probed); "
       "decision artifact parses")
@@ -282,7 +289,7 @@ for p in plans:
     assert isinstance(p.get("predicted_ms_per_step"), (int, float)), p
 td = row.get("tune_decision") or {}
 assert td.get("hierarchical_probed"), row
-print(f"bench_smoke OK[7/8]: two-tier plans "
+print(f"bench_smoke OK[7/9]: two-tier plans "
       f"{[p['plan'] for p in plans]} measured with per-tier "
       "predicted-vs-measured bytes matching, per-plan bit_parity=True; "
       f"mini-tune probed {td['hierarchical_probed']} "
@@ -330,8 +337,46 @@ sys.path.insert(0, ".")
 from atomo_tpu.training.checkpoint import latest_valid_step
 
 assert latest_valid_step(d) == 8, latest_valid_step(d)
-print("bench_smoke OK[8/8]: die@3:1 shrank 4 -> 3 at a checkpoint "
+print("bench_smoke OK[8/9]: die@3:1 shrank 4 -> 3 at a checkpoint "
       "boundary (planned reshape, restart budget untouched), finished at "
       f"step {latest_valid_step(d)} with membership epochs "
       f"{[w[0] for w in worlds]} recorded")
 EOF
+
+# --- 9: config 12, stream-encode exposure contract -----------------------
+out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+      ATOMO_BENCH_ARTIFACT="$art/c12.json" \
+      python bench.py --config 12 --no-baseline 2>/dev/null)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: config 12 exited rc=$rc (timeout or crash)"
+  exit 1
+fi
+printf '%s\n' "$out" > "$art/c12.out"
+python - "$art/c12.out" <<'EOF9'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+assert lines, "bench_smoke FAIL: config 12 emitted no JSON"
+row = json.loads(lines[-1])
+assert row["metric"] == "stream_encode_exposure", row
+assert row["measurement_valid"], row.get("invalid_reason")
+# the layout-knob contracts are semantics, not timing: they must hold
+# even on a contended host
+assert row["payload_bit_parity"] is True, row
+assert row["step_param_bit_parity"] is True, row
+assert row["exposed_encode_reduced"] is True, row
+ph = row.get("phases") or {}
+for k in ("compute_ms", "encode_monolithic_ms", "encode_streamed_ms",
+          "encode_exposed_off_ms", "encode_exposed_stream_ms",
+          "encode_hidden_stream_ms"):
+    assert isinstance(ph.get(k), (int, float)), (k, row)
+assert int(ph.get("n_buckets", 0)) > 1, row
+print(f"bench_smoke OK[9/9]: stream {row['value']} vs off "
+      f"{row['off_ms_per_step']} ms/step; exposed encode "
+      f"{ph['encode_exposed_stream_ms']} (stream, {ph['n_buckets']} "
+      f"buckets) vs {ph['encode_exposed_off_ms']} (off) ms; "
+      f"payload+param bit_parity=True")
+EOF9
+[ $? -ne 0 ] && exit 1

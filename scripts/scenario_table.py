@@ -58,7 +58,8 @@ def _budgets(network: str, shape) -> dict:
     }
 
 
-def model_only_recs(ways: int, dcn_ways: int = 2) -> dict:
+def model_only_recs(ways: int, dcn_ways: int = 2,
+                    allow_stream: bool = False) -> dict:
     """{network: {fabric: recommendation}} from the stated anchors.
 
     Besides the three single-fabric columns, each network gets a TWO-TIER
@@ -95,6 +96,7 @@ def model_only_recs(ways: int, dcn_ways: int = 2) -> dict:
                 measured_ms=measured,
                 ways=ways,
                 fabric_bw=bw,
+                allow_stream=allow_stream,
             )
             for label, bw in sorted(FABRICS.items())
         }
@@ -147,6 +149,14 @@ def main() -> int:
     ap.add_argument("--dcn-ways", type=int, default=2,
                     help="slow-fabric groups for the two-tier column "
                          "(0 disables it; must divide --ways)")
+    ap.add_argument("--stream", action="store_true", default=False,
+                    help="include --stream-encode on (+se) candidates in "
+                         "the model-only recommendation space: encode's "
+                         "predicted exposure drops to its pipeline tail "
+                         "(comm_model.stream_exposed_encode_s). Off by "
+                         "default so the published table's historical "
+                         "candidate space is stable; bench config 12 "
+                         "carries the measured streamed-encode evidence")
     ap.add_argument("--from-bench", type=str, default="",
                     help="read recommendations from a bench "
                          "scenario_matrix row / artifact instead of the "
@@ -170,7 +180,8 @@ def main() -> int:
         print(render(row["recommendations"], ways,
                      f"measured anchors, {args.from_bench}"))
         return 0
-    print(render(model_only_recs(args.ways, dcn_ways=args.dcn_ways),
+    print(render(model_only_recs(args.ways, dcn_ways=args.dcn_ways,
+                                 allow_stream=args.stream),
                  args.ways,
                  "model-only anchors, artifacts/BENCH_ONCHIP_r3.md; "
                  "2-tier rows: topology planner over the same anchors + "
