@@ -348,6 +348,29 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                         "works at any step, boundary or not). 0 (default) "
                         "= auto: 8 on TPU, 1 elsewhere; 1 = the per-step "
                         "loop exactly as before")
+    t.add_argument("--obs-record", action="store_true", default=False,
+                   help="arm the flight recorder: one JSON line per "
+                        "training step appended to train-dir/"
+                        "metrics.jsonl (loss, step wall ms, guard "
+                        "verdicts, wire bytes, the aggregate mode in "
+                        "effect, membership epoch, chaos generation, "
+                        "drift state, rolling predicted-vs-measured "
+                        "calibration), pruned in lockstep with the "
+                        "checkpoint timeline on rollback/resume. Off "
+                        "(default): zero new device ops, byte-identical "
+                        "programs and stdout. Read it back with the "
+                        "`report` verb")
+    t.add_argument("--obs-quality", action="store_true", default=False,
+                   help="in-graph estimator-quality probes: per-layer "
+                        "||decode(encode(g))-g||^2 and relative variance "
+                        "proxy inside the fused step (the ATOMO "
+                        "estimator's variance, observable at last — the "
+                        "feed for adaptive variance budgets). Needs a "
+                        "compressing --code with flat gather/ring/psum "
+                        "aggregation; off = byte-identical programs, on "
+                        "= bit-identical trajectories (the probe only "
+                        "adds metric outputs). Costs one extra decode + "
+                        "one f32 reduction per layer per step")
     t.add_argument("--phase-metrics", action="store_true", default=False,
                    help="split the step into separately-jitted phases and "
                         "log real Comp/Encode/Comm (+ master Gather/Decode) "
@@ -757,6 +780,35 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "and cannot describe the bucket-streamed schedule; drop "
                 "one of the flags"
             )
+    if getattr(args, "obs_record", False) and not args.train_dir:
+        raise SystemExit(
+            "--obs-record appends per-step telemetry to "
+            "train-dir/metrics.jsonl and needs a --train-dir"
+        )
+    if getattr(args, "obs_quality", False):
+        if args.code.lower() in DENSE_CODES:
+            raise SystemExit(
+                "--obs-quality probes the codec's estimator error; dense "
+                "training (--code sgd) has no estimator to probe"
+            )
+        if args.phase_metrics:
+            raise SystemExit(
+                "--obs-quality probes the fused step's encode in-graph; "
+                "--phase-metrics has no fused step — drop one"
+            )
+        if args.overlap == "delayed":
+            raise SystemExit(
+                "--obs-quality does not compose with --overlap delayed: "
+                "the carried payload describes the PREVIOUS step, so a "
+                "per-step per-layer error column would be off by one — "
+                "rejected honestly rather than silently mis-attributed"
+            )
+        if args.aggregate == "hierarchical" or plan_flag != "auto":
+            raise SystemExit(
+                "--obs-quality needs flat gather/ring/psum aggregation: "
+                "the hierarchical boundary re-encode composes two "
+                "estimators per layer and is not probe-aware yet"
+            )
     import os
 
     chaos_specs = [args.chaos] if args.chaos else []
@@ -1025,6 +1077,14 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             flush=True,
         )
         dcn_ways = 0
+    if dcn_ways and getattr(args, "obs_quality", False):
+        print(
+            "Autopilot: excluding hierarchical candidates (--obs-quality "
+            "probes flat exchanges only — the boundary re-encode is not "
+            "probe-aware)",
+            flush=True,
+        )
+        dcn_ways = 0
     doc = None
     if args.resume:
         # a resumed run (including a supervised restart's appended
@@ -1067,12 +1127,14 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
                 )
     # delayed is excluded from the candidate space whenever a later stage
     # could not accept it: densify's dense fallback has no delayed form,
-    # a zero1 run cannot resume the in-flight payload (PR-5 matrix), and
-    # an elastic shrink restart cannot resume the world-size-shaped carry
+    # a zero1 run cannot resume the in-flight payload (PR-5 matrix), an
+    # elastic shrink restart cannot resume the world-size-shaped carry,
+    # and the --obs-quality probes reject the stale-by-one payload
     allow_overlap = (
         codec is not None and n_dev > 1
         and args.on_diverge != "densify" and not zero1
         and not getattr(args, "elastic", False)
+        and not getattr(args, "obs_quality", False)
     )
     compute_dtype = jnp.bfloat16 if args.bf16 else None
     try:
@@ -1379,6 +1441,27 @@ def cmd_train(args: argparse.Namespace) -> int:
             patience=args.elastic_patience,
             readmit_at=args.readmit_at,
         )
+    recorder = None
+    if args.obs_record:
+        from atomo_tpu.obs.recorder import (
+            FlightRecorder,
+            resolve_predicted_ms,
+        )
+
+        # built AFTER the autopilot so the calibration column can anchor
+        # on the winner's predicted ms/step (tune_decision.json). Gated
+        # on THIS run having tuned (--auto tune — a fresh probe, or a
+        # decision_reusable-vetted resume): a stale decision file left in
+        # the dir by some earlier differently-configured run must not
+        # fabricate a calibration series for a program it never priced
+        recorder = FlightRecorder.for_train_dir(
+            args.train_dir,
+            predicted_ms=(
+                resolve_predicted_ms(args.train_dir)
+                if args.auto == "tune"
+                else None
+            ),
+        )
     if n_dev > 1:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
         from atomo_tpu.training import stepwise_shrink
@@ -1417,6 +1500,14 @@ def cmd_train(args: argparse.Namespace) -> int:
                     f"{args.aggregate!r} for this deployment; pass "
                     "--aggregate gather or ring explicitly to keep the "
                     "bucket-streamed encode, or drop --stream-encode"
+                )
+            if args.obs_quality and args.aggregate == "hierarchical":
+                raise SystemExit(
+                    "--obs-quality: --aggregate auto resolved to "
+                    "hierarchical for this deployment (the boundary "
+                    "re-encode is not probe-aware); pass --aggregate "
+                    "gather or ring explicitly to keep the quality "
+                    "probes, or drop --obs-quality"
                 )
             if (
                 args.num_aggregate is not None
@@ -1516,6 +1607,8 @@ def cmd_train(args: argparse.Namespace) -> int:
                 tuner=tuner,
                 plan=plan,
                 elastic=elastic_cfg,
+                track_quality=args.obs_quality,
+                recorder=recorder,
             )
         except DivergenceError as exc:
             return _diverged_exit(exc)
@@ -1557,6 +1650,8 @@ def cmd_train(args: argparse.Namespace) -> int:
                 guard=guard, chaos=chaos, health_timeout=args.health_timeout,
                 keep_ckpts=args.keep_ckpts, superstep=superstep,
                 diverge=diverge, tuner=tuner,
+                track_quality=args.obs_quality,
+                recorder=recorder,
             )
         except DivergenceError as exc:
             return _diverged_exit(exc)
@@ -1966,6 +2061,35 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_report(args: argparse.Namespace) -> int:
+    """``report``: join the run's artifacts — metrics.jsonl (flight
+    recorder) + incidents.jsonl + membership.json + tune_decision.json —
+    into one time-ordered run_report.json with cross-artifact
+    consistency checks, and print the human post-mortem. "What happened
+    to this run" as one command. Pure host-side file reads: no jax, no
+    devices, safe on a box that cannot reach the accelerator."""
+    import os
+
+    from atomo_tpu.obs.report import (
+        build_report,
+        report_path,
+        summarize_report,
+    )
+    from atomo_tpu.utils.tracing import write_json_atomic
+
+    if not args.train_dir or not os.path.isdir(args.train_dir):
+        raise SystemExit(
+            f"report: train dir {args.train_dir!r} does not exist"
+        )
+    doc = build_report(args.train_dir)
+    write_json_atomic(report_path(args.train_dir), doc)
+    print(summarize_report(doc), flush=True)
+    print(f"run report -> {report_path(args.train_dir)}", flush=True)
+    if args.strict and not doc["consistent"]:
+        return 3
+    return 0
+
+
 def cmd_tune(args: argparse.Namespace) -> int:
     import os
 
@@ -2095,6 +2219,20 @@ def build_parser() -> argparse.ArgumentParser:
     p_lm.add_argument("--bucket-size", type=int, default=512)
     p_lm.set_defaults(fn=cmd_lm)
 
+    p_rep = sub.add_parser(
+        "report",
+        help="join metrics.jsonl + incidents.jsonl + membership.json + "
+             "tune_decision.json into run_report.json and print the "
+             "post-mortem timeline (cross-artifact consistency checks)",
+    )
+    p_rep.add_argument("--train-dir", type=str, default="output/models/",
+                       metavar="N", help="the run's artifact directory")
+    p_rep.add_argument("--strict", action="store_true", default=False,
+                       help="exit rc=3 when a consistency check fails "
+                            "(default: report and exit 0 — the report "
+                            "itself is the product)")
+    p_rep.set_defaults(fn=cmd_report)
+
     p_tune = sub.add_parser("tune", help="LR grid search (src/tune.sh parity)")
     _add_fit_args(p_tune)
     p_tune.add_argument("--grid", type=str, default="",
@@ -2135,7 +2273,7 @@ def main(argv=None) -> int:
     # restarts skip recompiling identical XLA programs; no-op otherwise
     enable_compile_cache()
     argv = list(sys.argv[1:] if argv is None else argv)
-    known = {"train", "evaluate", "tune", "lm", "-h", "--help"}
+    known = {"train", "evaluate", "tune", "lm", "report", "-h", "--help"}
     if argv and argv[0] not in known:
         argv = ["train"] + argv  # bare flags behave like the reference CLI
     elif not argv:

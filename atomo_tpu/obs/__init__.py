@@ -1,0 +1,27 @@
+"""Observability subsystem — the flight recorder (PR 11).
+
+Three layers over the evidence artifacts PRs 5-10 established:
+
+  * :mod:`~atomo_tpu.obs.recorder` — ``FlightRecorder``: one JSON line
+    per training step into ``train_dir/metrics.jsonl`` (the IncidentLog
+    append/torn-line discipline), carrying the per-step signal that used
+    to exist only as ephemeral stdout text — loss, step wall, guard
+    verdicts, wire bytes, the aggregate mode actually in effect — plus a
+    rolling predicted-vs-measured calibration column.
+  * :mod:`~atomo_tpu.obs.quality` — opt-in in-graph estimator-quality
+    probes (``--obs-quality``): per-layer compression error of the
+    codec's unbiased estimator inside the fused step, the data feed the
+    adaptive variance-budget work (ROADMAP open item 5) consumes.
+  * :mod:`~atomo_tpu.obs.report` — join metrics.jsonl + incidents.jsonl
+    + membership.json + tune_decision.json into one time-ordered
+    ``run_report.json`` with cross-artifact consistency checks (the
+    ``report`` CLI verb).
+"""
+
+from atomo_tpu.obs.recorder import (  # noqa: F401
+    METRICS_FILE_NAME,
+    FlightRecorder,
+    emit_worker_line,
+    metrics_path,
+    prune_metrics_after,
+)

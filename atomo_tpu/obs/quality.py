@@ -1,0 +1,114 @@
+"""In-graph estimator-quality probes (``--obs-quality``).
+
+ATOMO's defining quantity is the sparsified estimator's VARIANCE (Wang et
+al., 1806.04090: the atom allocation minimizes estimator variance under a
+byte budget) — and until this module it was not observable at all. The
+probe adds, inside the fused step, the per-layer compression error of the
+codec's unbiased estimator:
+
+  * ``q_err2[l]`` — ``||decode(encode(g_l)) - g_l||^2`` in f32, the
+    squared estimator error of layer ``l``'s OWN encode this step. Its
+    expectation over codec keys IS the estimator variance (the encode is
+    unbiased, so E||ĝ-g||^2 = tr Var[ĝ]), which makes the recorded
+    series a per-layer variance estimate averaged over steps.
+  * ``q_rel[l]`` — ``q_err2[l] / ||g_l||^2``, the scale-free relative
+    variance proxy that makes layers comparable (the quantity the
+    adaptive variance-budget reallocation of ROADMAP open item 5 will
+    minimize across layers).
+
+The per-layer BYTE split (what the budget buys per layer) is static at
+trace time — :func:`quality_meta` records it once as a ``meta`` line in
+metrics.jsonl rather than per step.
+
+Cost contract: the probe reuses the existing shape-group vmapping of
+codecs/base.py (``decode_tree(bucketed=True)`` — one vmapped decode per
+same-shape leaf group; the decode it adds is the SAME arithmetic the
+step's own decode path runs, so XLA dedups what it can) plus one f32
+reduction per leaf. Off (the default) adds zero ops: the step programs
+are byte-identical to before (lowered-HLO text tested, the stream-encode
+precedent), and armed-vs-off trajectories are bit-identical (the probe
+only ADDS metric outputs — tested).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from atomo_tpu.codecs import decode_tree
+
+
+def quality_probe(codec, payloads, grads) -> dict:
+    """Traced per-layer estimator-error telemetry for one encode.
+
+    ``payloads`` is the encode of ``grads`` (this replica's own, BEFORE
+    any exchange); returns ``{"q_err2": (L,), "q_rel": (L,)}`` f32
+    arrays over the gradient tree's L leaves in canonical flatten order
+    (the same order quality_meta names them in). ``q_rel`` floors the
+    denominator at f32-tiny so a zero-gradient layer reads 0/tiny = 0
+    error, not NaN."""
+    decoded = decode_tree(codec, payloads, grads)
+    g_leaves = jax.tree_util.tree_leaves(grads)
+    d_leaves = jax.tree_util.tree_leaves(decoded)
+    err2 = []
+    g2 = []
+    for g, d in zip(g_leaves, d_leaves):
+        gf = g.astype(jnp.float32)
+        df = d.astype(jnp.float32)
+        diff = df - gf
+        err2.append(jnp.sum(diff * diff))
+        g2.append(jnp.sum(gf * gf))
+    q_err2 = jnp.stack(err2)
+    q_g2 = jnp.stack(g2)
+    return {
+        "q_err2": q_err2,
+        "q_rel": q_err2 / jnp.maximum(q_g2, jnp.float32(1e-30)),
+    }
+
+
+def quality_meta(codec, tree: Any, stream_bucket_bytes: Optional[int] = None) -> dict:
+    """The static half of the quality telemetry: the per-layer kept-byte
+    split — layer name, shape, dense bytes, payload bytes — computed at
+    zero cost with ``jax.eval_shape`` (nothing materializes; the
+    _zero_carry_host precedent). Recorded once as a ``meta`` line so the
+    per-step records stay small; keyed by the same canonical leaf order
+    ``q_err2``/``q_rel`` index."""
+    import numpy as np
+
+    from atomo_tpu.codecs import encode_tree
+
+    shapes = jax.eval_shape(
+        lambda p: encode_tree(codec, jax.random.PRNGKey(0), p)[0], tree
+    )
+    flat_paths, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    p_leaves = treedef.flatten_up_to(shapes)
+    layers = []
+    for (path, leaf), p in zip(flat_paths, p_leaves):
+        dense = int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+        pay = int(
+            sum(
+                int(np.prod(s.shape)) * np.dtype(s.dtype).itemsize
+                for s in jax.tree_util.tree_leaves(p)
+            )
+        )
+        layers.append(
+            {
+                "name": jax.tree_util.keystr(path),
+                "shape": [int(d) for d in leaf.shape],
+                "dense_bytes": dense,
+                "payload_bytes": pay,
+            }
+        )
+    out = {
+        "what": "obs_quality",
+        "codec": getattr(codec, "name", str(codec)),
+        "n_layers": len(layers),
+        "dense_bytes": int(sum(l["dense_bytes"] for l in layers)),
+        "payload_bytes": int(sum(l["payload_bytes"] for l in layers)),
+        "layers": layers,
+    }
+    if stream_bucket_bytes is not None:
+        out["stream_bucket_bytes"] = int(stream_bucket_bytes)
+    return out

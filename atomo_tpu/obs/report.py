@@ -1,0 +1,439 @@
+"""Run-timeline report — join every run artifact into one story.
+
+"What happened to this run" used to be a four-file archaeology dig:
+metrics.jsonl (the flight recorder's per-step stream), incidents.jsonl
+(the robustness stack's decisions), membership.json (elastic epochs) and
+tune_decision.json (the autopilot's config choice) each tell a slice.
+:func:`build_report` joins them into one time-ordered
+``train_dir/run_report.json`` — metric records compressed into contiguous
+SEGMENTS (split where the step sequence, aggregate mode, membership epoch
+or chaos generation changes), incidents and membership epochs interleaved
+at their steps — and runs cross-artifact CONSISTENCY checks, so the
+artifacts audit each other instead of being trusted independently:
+
+  * ``membership_incidents_agree`` — every epoch in membership.json has
+    the matching ``membership`` incident (begin/shrink/grow) with the
+    same world size.
+  * ``metrics_monotone`` — the step sequence in metrics.jsonl is
+    strictly increasing: every rollback/supervisor prune actually cut
+    the diverged tail before the replay re-recorded it (a surviving
+    tail shows up as a step regression in file order).
+  * ``retunes_visible`` — after every ``retune->MODE`` incident the
+    recorded ``aggregate`` column switches to MODE (and not before the
+    incident's step).
+  * ``membership_column_agrees`` — each step record's membership epoch
+    matches the epoch whose span covers that step per membership.json.
+
+A check whose source artifact is absent is SKIPPED (reported, not
+failed): a run without elastic has no membership to agree with.
+:func:`summarize_report` renders the human post-mortem (incident lines
+via utils.tracing.format_incident — one formatter with
+IncidentLog.summarize, so the two surfaces cannot drift).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from atomo_tpu.obs.recorder import FlightRecorder, metrics_path
+from atomo_tpu.utils.tracing import (
+    INCIDENT_LOG_NAME,
+    IncidentLog,
+    format_incident,
+)
+
+REPORT_FILE_NAME = "run_report.json"
+
+_EPOCH_REASON_ACTION = {"init": "begin", "shrink": "shrink", "grow": "grow"}
+
+
+def report_path(train_dir: str) -> str:
+    return os.path.join(train_dir, REPORT_FILE_NAME)
+
+
+def _segments(steps: list[dict]) -> list[dict]:
+    """Compress the per-step records into contiguous segments: a new
+    segment starts on a step regression/gap or when a context column
+    (aggregate / membership epoch / generation) changes — exactly the
+    boundaries a reader of the timeline cares about."""
+    segs: list[dict] = []
+    cur: Optional[dict] = None
+
+    def ctx(r):
+        return (r.get("aggregate"), r.get("epoch"), r.get("generation"))
+
+    for r in steps:
+        s = int(r.get("step", 0))
+        fresh = (
+            cur is None
+            or s != cur["last_step"] + 1
+            or ctx(r) != cur["_ctx"]
+        )
+        if fresh:
+            if cur is not None:
+                segs.append(cur)
+            cur = {
+                "kind": "metrics",
+                "first_step": s,
+                "last_step": s,
+                "n": 0,
+                "loss_first": r.get("loss"),
+                "loss_last": r.get("loss"),
+                "_ctx": ctx(r),
+                "_ms_sum": 0.0,
+                "_ms_n": 0,
+                "skips": 0.0,
+                "drops": 0.0,
+            }
+            for k in ("aggregate", "epoch", "generation"):
+                if r.get(k) is not None:
+                    cur[k] = r[k]
+        cur["last_step"] = s
+        cur["n"] += 1
+        cur["loss_last"] = r.get("loss", cur["loss_last"])
+        if r.get("step_ms") is not None:
+            cur["_ms_sum"] += float(r["step_ms"])
+            cur["_ms_n"] += 1
+        cur["skips"] += float(r.get("skipped", 0.0) or 0.0)
+        cur["drops"] += float(r.get("dropped", 0.0) or 0.0)
+        if r.get("calib") is not None:
+            cur["calib_last"] = r["calib"]
+    if cur is not None:
+        segs.append(cur)
+    for seg in segs:
+        if seg["_ms_n"]:
+            seg["mean_step_ms"] = round(seg["_ms_sum"] / seg["_ms_n"], 3)
+        del seg["_ctx"], seg["_ms_sum"], seg["_ms_n"]
+    return segs
+
+
+def _check(name: str, ok: bool, detail: str, skipped: bool = False) -> dict:
+    return {"name": name, "ok": bool(ok), "skipped": skipped,
+            "detail": detail}
+
+
+def _check_membership_incidents(epochs: list[dict], incidents) -> dict:
+    name = "membership_incidents_agree"
+    if not epochs:
+        return _check(name, True, "no membership history", skipped=True)
+    mem = [r for r in incidents if r.get("cause") == "membership"]
+    if not incidents:
+        return _check(name, True, "incidents.jsonl absent", skipped=True)
+    missing = []
+    for e in epochs:
+        want = _EPOCH_REASON_ACTION.get(str(e.get("reason")))
+        if want is None:
+            continue  # operator_resize etc.: no incident contract
+        hit = any(
+            r.get("epoch") == e.get("epoch")
+            and r.get("action") == want
+            and r.get("world") == e.get("world_size")
+            for r in mem
+        )
+        if not hit:
+            missing.append(
+                f"epoch {e.get('epoch')} ({e.get('reason')}, world "
+                f"{e.get('world_size')}) has no matching incident"
+            )
+    return _check(
+        name,
+        not missing,
+        "; ".join(missing)
+        or f"{len(epochs)} epoch(s) all matched by membership incidents",
+    )
+
+
+def _check_metrics_monotone(steps: list[dict], incidents) -> dict:
+    name = "metrics_monotone"
+    if not steps:
+        return _check(name, True, "no step records", skipped=True)
+    viol = [
+        (int(a["step"]), int(b["step"]))
+        for a, b in zip(steps, steps[1:])
+        if int(b["step"]) <= int(a["step"])
+    ]
+    n_roll = sum(
+        1
+        for r in incidents
+        if r.get("cause") == "divergence"
+        and str(r.get("action", "")).startswith("rollback")
+    )
+    if viol:
+        return _check(
+            name,
+            False,
+            f"step regressions in file order at {viol[:5]} — a pruned "
+            "tail survived",
+        )
+    return _check(
+        name,
+        True,
+        f"{len(steps)} step records strictly increasing"
+        + (f" across {n_roll} rollback prune(s)" if n_roll else ""),
+    )
+
+
+def _check_retunes(steps: list[dict], incidents) -> dict:
+    name = "retunes_visible"
+    switches = [
+        (int(r.get("step", 0)), str(r["action"]).split("->", 1)[1])
+        for r in incidents
+        if r.get("cause") == "perf_drift"
+        and str(r.get("action", "")).startswith("retune->")
+    ]
+    if not switches:
+        return _check(name, True, "no retune switches", skipped=True)
+    if not any(r.get("aggregate") for r in steps):
+        return _check(
+            name, True, "metrics carry no aggregate column", skipped=True
+        )
+    bad = []
+    switches.sort()
+    for i, (s, mode) in enumerate(switches):
+        until = switches[i + 1][0] if i + 1 < len(switches) else None
+        span = [
+            r for r in steps
+            if int(r["step"]) > s and (until is None or int(r["step"]) <= until)
+        ]
+        wrong = [r for r in span if r.get("aggregate") not in (None, mode)]
+        if wrong:
+            bad.append(
+                f"retune->{mode} at step {s} but step "
+                f"{wrong[0]['step']} records aggregate="
+                f"{wrong[0].get('aggregate')!r}"
+            )
+    return _check(
+        name,
+        not bad,
+        "; ".join(bad)
+        or f"{len(switches)} retune switch(es) reflected in the "
+        "aggregate column",
+    )
+
+
+def _check_membership_column(steps: list[dict], epochs: list[dict]) -> dict:
+    name = "membership_column_agrees"
+    if not epochs:
+        return _check(name, True, "no membership history", skipped=True)
+    recs = [r for r in steps if r.get("epoch") is not None]
+    if not recs:
+        return _check(
+            name, True, "metrics carry no membership column", skipped=True
+        )
+    starts = sorted(
+        (int(e["start_step"]), int(e["epoch"])) for e in epochs
+    )
+
+    def active(step: int) -> int:
+        cur = starts[0][1]
+        for s0, ep in starts:
+            if s0 < step:
+                cur = ep
+            else:
+                break
+        return cur
+
+    bad = [
+        (int(r["step"]), int(r["epoch"]), active(int(r["step"])))
+        for r in recs
+        if int(r["epoch"]) != active(int(r["step"]))
+    ]
+    return _check(
+        name,
+        not bad,
+        (
+            f"step {bad[0][0]} records epoch {bad[0][1]} but membership "
+            f"history says {bad[0][2]} (+{len(bad) - 1} more)"
+            if bad
+            else f"{len(recs)} records agree with the epoch spans"
+        ),
+    )
+
+
+def build_report(train_dir: str) -> dict:
+    """Join the run's artifacts into the report document (see module
+    docstring). Pure read — writing run_report.json is the caller's move
+    (the CLI ``report`` verb uses write_json_atomic)."""
+    all_recs = FlightRecorder.read(metrics_path(train_dir))
+    steps = [r for r in all_recs if r.get("kind") == "step"]
+    metas = [r for r in all_recs if r.get("kind") == "meta"]
+    incidents = IncidentLog.read(os.path.join(train_dir, INCIDENT_LOG_NAME))
+    epochs: list[dict] = []
+    mpath = os.path.join(train_dir, "membership.json")
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                epochs = list(json.load(f).get("epochs", []))
+        except (OSError, ValueError):
+            epochs = []
+    tune = None
+    tpath = os.path.join(train_dir, "tune_decision.json")
+    if os.path.exists(tpath):
+        try:
+            with open(tpath) as f:
+                tune = json.load(f)
+        except (OSError, ValueError):
+            tune = None
+
+    events: list[dict] = []
+    events.extend(_segments(steps))
+    for r in incidents:
+        events.append(
+            {
+                "kind": "incident",
+                "step": r.get("step"),
+                "ts": r.get("ts"),
+                "line": format_incident(r),
+                "record": r,
+            }
+        )
+    for e in epochs:
+        events.append(
+            {
+                "kind": "membership",
+                "step": e.get("start_step"),
+                "epoch": e.get("epoch"),
+                "world_size": e.get("world_size"),
+                "reason": e.get("reason"),
+                "dead": e.get("dead", []),
+            }
+        )
+    if tune is not None:
+        win = (tune.get("winner") or {})
+        events.append(
+            {
+                "kind": "tune_decision",
+                "step": 0,
+                "winner": win.get("name"),
+                "predicted_ms_per_step": win.get("predicted_ms_per_step"),
+                "measured_ms_per_step": win.get("measured_ms_per_step"),
+                "why": tune.get("why"),
+            }
+        )
+
+    def sort_key(ev):
+        step = ev.get("step") if ev.get("kind") != "metrics" else ev.get(
+            "first_step"
+        )
+        # step-keyed events order by step; step-less ones (supervisor
+        # records, retries) follow in ts order — chronologically they
+        # bracket the run, and ts alone cannot be merged against steps
+        if step is None:
+            return (1, 0, float(ev.get("ts") or 0.0))
+        return (0, int(step), float(ev.get("ts") or 0.0))
+
+    events.sort(key=sort_key)
+
+    checks = [
+        _check_membership_incidents(epochs, incidents),
+        _check_metrics_monotone(steps, incidents),
+        _check_retunes(steps, incidents),
+        _check_membership_column(steps, epochs),
+    ]
+    consistent = all(c["ok"] for c in checks)
+    summary = {
+        "steps_recorded": len(steps),
+        "first_step": int(steps[0]["step"]) if steps else None,
+        "last_step": int(steps[-1]["step"]) if steps else None,
+        "final_loss": steps[-1].get("loss") if steps else None,
+        "incidents": len(incidents),
+        "membership_epochs": len(epochs),
+        "tuned": tune is not None,
+        "quality_armed": any("q_rel" in r for r in steps) or bool(metas),
+    }
+    return {
+        "kind": "run_report",
+        "train_dir": os.path.abspath(train_dir),
+        "sources": {
+            "metrics_jsonl": len(all_recs),
+            "incidents_jsonl": len(incidents),
+            "membership_json": len(epochs),
+            "tune_decision_json": tune is not None,
+        },
+        "summary": summary,
+        "timeline": events,
+        "checks": checks,
+        "consistent": consistent,
+    }
+
+
+def summarize_report(doc: dict) -> str:
+    """The human post-mortem: one line per timeline event."""
+    s = doc.get("summary", {})
+    lines = [
+        f"run report: {doc.get('train_dir')}",
+        "  steps {}..{} ({} recorded), {} incident(s), {} membership "
+        "epoch(s){}{}".format(
+            s.get("first_step"),
+            s.get("last_step"),
+            s.get("steps_recorded"),
+            s.get("incidents"),
+            s.get("membership_epochs"),
+            ", autopilot-tuned" if s.get("tuned") else "",
+            ", quality probes armed" if s.get("quality_armed") else "",
+        ),
+    ]
+    for ev in doc.get("timeline", []):
+        kind = ev.get("kind")
+        if kind == "metrics":
+            ctx = ", ".join(
+                f"{k}={ev[k]}"
+                for k in ("aggregate", "epoch", "generation")
+                if ev.get(k) is not None
+            )
+            ms = (
+                f", {ev['mean_step_ms']} ms/step"
+                if ev.get("mean_step_ms") is not None
+                else ""
+            )
+            extra = ""
+            if ev.get("skips"):
+                extra += f", {int(ev['skips'])} skipped"
+            if ev.get("drops"):
+                extra += f", {int(ev['drops'])} dropped contribs"
+            if ev.get("calib_last") is not None:
+                extra += f", calib {ev['calib_last']}x"
+            lines.append(
+                f"  [steps {ev['first_step']}..{ev['last_step']}] "
+                f"{ev['n']} step(s), loss "
+                f"{_fmt(ev.get('loss_first'))} -> "
+                f"{_fmt(ev.get('loss_last'))}{ms}"
+                + (f" ({ctx})" if ctx else "")
+                + extra
+            )
+        elif kind == "incident":
+            at = f"[step {ev['step']}] " if ev.get("step") is not None else ""
+            lines.append(f"  {at}incident: {ev['line']}")
+        elif kind == "membership":
+            lines.append(
+                f"  [step {ev.get('step')}] membership epoch "
+                f"{ev.get('epoch')}: world {ev.get('world_size')} "
+                f"({ev.get('reason')}"
+                + (f", dead={ev.get('dead')}" if ev.get("dead") else "")
+                + ")"
+            )
+        elif kind == "tune_decision":
+            lines.append(
+                f"  [step 0] autopilot: {ev.get('winner')} "
+                f"(predicted {ev.get('predicted_ms_per_step')} / measured "
+                f"{ev.get('measured_ms_per_step')} ms/step)"
+            )
+    bad = [c["name"] for c in doc.get("checks", []) if not c["ok"]]
+    ran = [c for c in doc.get("checks", []) if not c.get("skipped")]
+    if doc.get("consistent"):
+        lines.append(
+            f"  consistency: OK ({len(ran)} check(s) ran, "
+            f"{len(doc.get('checks', [])) - len(ran)} skipped)"
+        )
+    else:
+        lines.append(f"  consistency: FAILED ({', '.join(bad)})")
+        for c in doc.get("checks", []):
+            if not c["ok"]:
+                lines.append(f"    {c['name']}: {c['detail']}")
+    return "\n".join(lines)
+
+
+def _fmt(x) -> str:
+    return f"{x:.4f}" if isinstance(x, (int, float)) else str(x)

@@ -502,6 +502,7 @@ def make_distributed_train_step(
     remedy=None,
     track_grad_norm: bool = False,
     track_ok_bits: bool = False,
+    track_quality: bool = False,
     survivor_exact: bool = False,
     plan=None,
     _oracle_parts: bool = False,
@@ -528,6 +529,18 @@ def make_distributed_train_step(
     trajectories compare elastic-to-elastic — which the acceptance drill
     does. Both flags default OFF and then add no ops — the compiled
     programs are byte-identical to before.
+
+    ``track_quality`` (``--obs-quality``; needs a codec, flat blocking
+    gather/ring/psum) adds the in-graph per-layer estimator-quality
+    probes (obs.quality.quality_probe): each replica computes
+    ``||decode(encode(g)) - g||^2`` per leaf for its OWN encode, and the
+    cross-replica mean (healthy replicas only under the guard — the
+    grad_norm precedent) lands in ``metrics["q_err2"]``/``["q_rel"]`` as
+    (L,) series. Off (default) the program is byte-identical
+    (lowered-HLO tested); on only ADDS metric outputs, so trajectories
+    are bit-identical armed vs off. Hierarchical/planned schedules and
+    the delayed overlap are rejected honestly (the boundary re-encode
+    and the carried payload are not per-layer-probe-aware yet).
 
     ``plan`` (topology.schedule.AggregationPlan, hierarchical mode only)
     selects the two-level schedule: inner primitive over the fast fabric
@@ -811,6 +824,21 @@ def make_distributed_train_step(
             "survivor_exact only applies to flat aggregation (the "
             "hierarchical guard's drop unit is an inner group)"
         )
+    if track_quality:
+        if codec is None:
+            raise ValueError(
+                "track_quality (--obs-quality) probes the codec's "
+                "estimator error; dense training has no estimator to "
+                "probe — drop one"
+            )
+        if hierarchical or overlap == "delayed":
+            raise ValueError(
+                "track_quality needs flat blocking aggregation: the "
+                "hierarchical boundary re-encode composes two estimators "
+                "per layer and the delayed carry's payload describes the "
+                "PREVIOUS step — neither is per-layer-probe-aware yet; "
+                "rejected honestly rather than silently mis-attributed"
+            )
 
     batch_axes = (axis, inner_axis) if hierarchical else axis
     metric_axes = batch_axes
@@ -919,6 +947,7 @@ def make_distributed_train_step(
         gnorm = _local_grad_norm(grads) if track_grad_norm else None
 
         ok = kept = None  # guard-mode: local health flag / surviving count
+        qm = None  # --obs-quality: per-layer estimator-error telemetry
         n_contrib = k_agg or n_dev  # contributions in the average
         dense_bytes = tree_nbytes(grads)
         if codec is None:
@@ -999,6 +1028,14 @@ def make_distributed_train_step(
                 else:
                     payloads, stats = encode_tree(codec, k_codec, grads)
             msg_bytes = stats.payload_bytes
+            if track_quality:
+                from atomo_tpu.obs.quality import quality_probe
+
+                # this replica's OWN encode error, per layer (raw grads:
+                # an anomalous replica's NaN error is excluded from the
+                # logged mean by the healthy-only fold below, exactly
+                # like grad_norm)
+                qm = quality_probe(codec, payloads, grads)
             # deterministic rotating subset (num_aggregate) — identical on
             # every chip, so replicas stay bit-equal
             sel = (
@@ -1185,6 +1222,16 @@ def make_distributed_train_step(
                 # contained
                 metrics["grad_norm"] = _healthy_mean(
                     gnorm, ok, kept_chips, metric_axes
+                )
+        if qm is not None:
+            for q_name, q_v in qm.items():
+                # cross-replica mean of the per-layer error series;
+                # healthy-only under the guard (the grad_norm rationale:
+                # a masked replica's NaN error must not poison the feed)
+                metrics[q_name] = (
+                    jax.lax.pmean(q_v, metric_axes)
+                    if guard is None
+                    else _healthy_mean(q_v, ok, kept_chips, metric_axes)
                 )
         new_state = TrainState(
             step=state.step + 1,
@@ -1736,6 +1783,8 @@ def distributed_train_loop(
     tuner=None,
     plan=None,
     elastic=None,
+    track_quality: bool = False,
+    recorder=None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -1820,7 +1869,19 @@ def distributed_train_loop(
     size without charging the restart budget. Needs a checkpoint cadence
     and a flat blocking aggregate; rejects zero1 / delayed / hierarchical
     / phase_metrics (the world-size-shaped state those modes carry cannot
-    be resumed across a reshape)."""
+    be resumed across a reshape).
+
+    ``recorder`` (obs.recorder.FlightRecorder) arms the flight recorder:
+    one ``metrics.jsonl`` record per step — the superstep loop rides its
+    existing one-fetch-per-block, the per-step loop pays one fetch per
+    step (the doctor's surveillance-price precedent) — with the
+    aggregate mode in effect stamped on every record (an online re-tune
+    switches the column from its step onward) and the rollback prune
+    cutting the metric timeline in lockstep with the checkpoints. None
+    (default): zero new device ops, stdout byte-identical.
+    ``track_quality`` arms the in-graph per-layer estimator-quality
+    probes (see make_distributed_train_step); not supported with
+    --phase-metrics (no fused step to probe)."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import (
         SUPERVISED_ENV,
@@ -1861,6 +1922,16 @@ def distributed_train_loop(
         raise ValueError(
             "the online re-tuner rebuilds the fused step; --phase-metrics "
             "has no fused step to re-pick — drop one"
+        )
+    if track_quality and phase_metrics:
+        raise ValueError(
+            "--obs-quality probes the fused step's encode in-graph; "
+            "--phase-metrics has no fused step — drop one"
+        )
+    if track_quality and codec is None:
+        raise ValueError(
+            "--obs-quality probes the codec's estimator error; dense "
+            "training has no estimator to probe — drop one"
         )
     if stream_encode:
         if codec is None or aggregate not in ("gather", "ring"):
@@ -2159,6 +2230,8 @@ def distributed_train_loop(
                 stream_bucket_bytes=stream_bucket_bytes,
                 remedy=remedy_cfg, track_grad_norm=diverge is not None,
                 track_ok_bits=elastic is not None,
+                # the densify window has no estimator to probe
+                track_quality=False if densify else track_quality,
                 survivor_exact=elastic is not None,
                 plan=plan,
             )
@@ -2262,10 +2335,28 @@ def distributed_train_loop(
             if new_mode is None:
                 return None
             agg_cell["mode"] = new_mode
+            if recorder is not None:
+                # the aggregate-mode column must switch WITH the program:
+                # the report's retunes_visible check audits exactly this
+                recorder.set_context(aggregate=new_mode)
             return build_step(
                 rig.doctor.generation if rig is not None else 0
             )
 
+    if recorder is not None:
+        recorder.set_context(aggregate=aggregate)
+        # a resumed run replays from the checkpoint: cut the stale metric
+        # tail the killed attempt wrote past its last save, or the replay
+        # would duplicate those steps in the timeline
+        recorder.prune_past(start_step)
+        if track_quality:
+            from atomo_tpu.obs.quality import quality_meta
+
+            # the static per-layer kept-byte split, recorded once
+            # (eval_shape — nothing materializes)
+            recorder.write_meta(
+                quality_meta(codec, jax.device_get(state.params))
+            )
     # superstep mode beats the watchdog once per BLOCK: scale the budget
     # by K so a per-step-tuned --health-timeout does not falsely fire
     with heartbeat_watchdog(
@@ -2280,7 +2371,7 @@ def distributed_train_loop(
                 compress_ckpt, monitor, profile_dir, batch_axes,
                 guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
                 rig=rig, incidents=incidents, tuner=tuner, retune=retune,
-                elastic_rig=elastic_rig,
+                elastic_rig=elastic_rig, recorder=recorder,
             )
         else:
             state = _distributed_steps(
@@ -2290,7 +2381,7 @@ def distributed_train_loop(
                 profile_dir, profile_steps, batch_axes,
                 guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
                 rig=rig, incidents=incidents, tuner=tuner, retune=retune,
-                elastic_rig=elastic_rig,
+                elastic_rig=elastic_rig, recorder=recorder,
             )
     return state
 
@@ -2352,7 +2443,7 @@ def _distributed_steps(
     save_freq, train_dir, compress_ckpt, monitor, lr_fn=None,
     profile_dir=None, profile_steps=3, batch_axes="dp",
     guard=None, chaos=None, keep_ckpts=0, rig=None, incidents=None,
-    tuner=None, retune=None, elastic_rig=None,
+    tuner=None, retune=None, elastic_rig=None, recorder=None,
 ):
     import time as _time
 
@@ -2363,6 +2454,7 @@ def _distributed_steps(
     save_fn = retrying_saver(log_fn, incidents)
     last_saved = start_step
     t_obs = _time.perf_counter()  # the tuner's step-time series anchor
+    t_rec = _time.perf_counter()  # the flight recorder's wall anchor
     # trace steady-state steps only: step 1 is dominated by compilation
     prof_first = start_step + 2 if profile_dir else None
     prof_ctx = None
@@ -2388,6 +2480,21 @@ def _distributed_steps(
         if monitor is not None:
             jax.block_until_ready(metrics["loss"])
             monitor.beat(step)
+        if recorder is not None:
+            # one fetch per step (the doctor's surveillance-price
+            # precedent), recorded BEFORE the doctor observes so a
+            # diverged step lands in the timeline and the rollback prune
+            # cuts it in lockstep with the checkpoint files
+            m_host = jax.device_get(metrics)
+            now_r = _time.perf_counter()
+            recorder.record_block(
+                step, m_host, wall_s=now_r - t_rec,
+                drift=tuner.state if tuner is not None else None,
+                generation=(
+                    rig.doctor.generation if rig is not None else None
+                ),
+            )
+            t_rec = now_r
         if rig is not None:
             # one scalar fetch per step — the price of per-step rollback
             # granularity (superstep mode amortizes it into the block's
@@ -2407,6 +2514,7 @@ def _distributed_steps(
                 # recovery wall (reload/replay/recompile) is not step
                 # time: restamp or it pollutes the next drift observation
                 t_obs = _time.perf_counter()
+                t_rec = _time.perf_counter()
                 continue
             new_fn = rig.maybe_end_densify(step)
             if new_fn is not None:
@@ -2456,7 +2564,9 @@ def _distributed_steps(
                 prec1=float(metrics["prec1"]),
                 prec5=float(metrics["prec5"]),
             )
-            log_fn(rec.worker_line())
+            from atomo_tpu.obs.recorder import emit_worker_line
+
+            emit_worker_line(recorder, rec, log_fn)
             if phases:
                 log_fn(
                     master_line(
@@ -2497,6 +2607,8 @@ def _distributed_steps(
             # spans are cadence costs, not step time — folding them in
             # would teach the drift baseline the checkpoint cadence
             t_obs = _time.perf_counter()
+        if recorder is not None:
+            t_rec = _time.perf_counter()  # same boundary-work rule
     # autosave the final state so a restart never replays the tail
     # (strictly `<`: a resume past max_steps runs no steps and must not
     # write a file whose name disagrees with the state's step field)
@@ -2563,6 +2675,7 @@ def _distributed_superstep_steps(
     eval_freq, save_freq, train_dir, compress_ckpt, monitor,
     profile_dir=None, batch_axes="dp", guard=None, chaos=None, keep_ckpts=0,
     rig=None, incidents=None, tuner=None, retune=None, elastic_rig=None,
+    recorder=None,
 ):
     """distributed_train_loop's fused block path: one SPMD dispatch per K
     steps, one metric fetch per block, next block's shard_superbatch
@@ -2595,6 +2708,7 @@ def _distributed_superstep_steps(
     block_idx = 0
     prof_ctx = None
     t_obs = _time.perf_counter()  # the tuner's step-time series anchor
+    t_rec = _time.perf_counter()  # the flight recorder's wall anchor
     feed.start(min(superstep, max_steps - s))
     while s < max_steps:
         kb, dev_im, dev_lb = feed.take()
@@ -2619,6 +2733,20 @@ def _distributed_superstep_steps(
             prof_ctx = None
         if monitor is not None:
             monitor.beat(s)
+        if recorder is not None:
+            # rides the block's one fetch (zero extra device ops); the
+            # block wall becomes kb equal per-step shares — partition
+            # consistency. Recorded BEFORE the doctor observes so the
+            # rollback prune cuts a diverged block in lockstep.
+            now_r = _time.perf_counter()
+            recorder.record_block(
+                b0 + 1, m, wall_s=now_r - t_rec,
+                drift=tuner.state if tuner is not None else None,
+                generation=(
+                    rig.doctor.generation if rig is not None else None
+                ),
+            )
+            t_rec = now_r
         if rig is not None:
             alarm_step, reason = rig.observe(b0 + 1, m)
             if reason is not None:
@@ -2633,6 +2761,7 @@ def _distributed_superstep_steps(
                 # recovery wall is not step time: restamp or the next
                 # block's K shares alone could fire a bogus drift alarm
                 t_obs = _time.perf_counter()
+                t_rec = _time.perf_counter()
                 continue
             new_fn = rig.maybe_end_densify(s)
             if new_fn is not None:
@@ -2666,7 +2795,9 @@ def _distributed_superstep_steps(
                 s, m, train_iter, n_train, timer.lap(), last_logged
             )
             last_logged = s
-            log_fn(rec.worker_line())
+            from atomo_tpu.obs.recorder import emit_worker_line
+
+            emit_worker_line(recorder, rec, log_fn)
         if eval_freq and eval_fn is not None and _crossed(eval_freq, b0, s):
             _distributed_eval(
                 eval_fn, state, test_iter, mesh, batch_axes, s, log_fn
@@ -2695,6 +2826,8 @@ def _distributed_superstep_steps(
             # restamp after boundary work (eval/save/re-probe): cadence
             # costs must not enter the drift baseline
             t_obs = _time.perf_counter()
+        if recorder is not None:
+            t_rec = _time.perf_counter()  # same boundary-work rule
     # autosave the final state (same strictly-< contract as the K=1 loop)
     if save_freq and train_dir and last_saved < max_steps:
         path = save_fn(

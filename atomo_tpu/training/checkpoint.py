@@ -174,7 +174,13 @@ def prune_after(train_dir: str, step: int) -> list[int]:
     """Remove every model_step_N (and its healthy sidecar) with N > step —
     the rollback engine's timeline cut: after rolling back to ``step``, the
     diverged checkpoints above it must not be resume candidates. Returns
-    the steps removed (best-effort; missing files are skipped)."""
+    the steps removed (best-effort; missing files are skipped).
+
+    The flight recorder's metric timeline is cut in the SAME call
+    (obs.recorder.prune_metrics_after): both prune surfaces — the
+    divergence doctor's in-process rollback and the supervisor's rc=23
+    cut — route through here, so metrics.jsonl can never keep a tail the
+    checkpoint timeline discarded."""
     removed = []
     for s in list_steps(train_dir):
         if s <= step:
@@ -189,6 +195,9 @@ def prune_after(train_dir: str, step: int) -> list[int]:
                 pass
         _verify_cache.pop(checkpoint_path(train_dir, s), None)
         removed.append(s)
+    from atomo_tpu.obs.recorder import prune_metrics_after
+
+    prune_metrics_after(train_dir, step)
     return removed
 
 

@@ -52,7 +52,12 @@ what the rung below it let through):
      the job; exit codes distinguish clean-exit / rollback-requested
      (:data:`ROLLBACK_EXIT_CODE`, raised when the in-process rollback
      budget is exhausted) / crash, and every decision lands in the
-     machine-readable incident log (utils.tracing.IncidentLog).
+     machine-readable incident log (utils.tracing.IncidentLog). Both
+     prune surfaces — the doctor's in-process rollback and the
+     supervisor's rc=23 cut — go through checkpoint.prune_after, which
+     also cuts the flight recorder's metrics.jsonl timeline in lockstep
+     (obs.recorder.prune_metrics_after), so no artifact ever describes
+     a trajectory the checkpoints discarded.
 
   5. Host-side bounded retries (:func:`with_retries`): checkpoint IO, the
      data pipeline, and ``jax.distributed.initialize`` are fallible host

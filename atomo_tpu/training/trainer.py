@@ -30,6 +30,7 @@ from flax.core import FrozenDict
 
 from atomo_tpu.codecs import decode_tree, encode_tree
 from atomo_tpu.data.pipeline import augment_batch
+from atomo_tpu.obs.recorder import emit_worker_line
 from atomo_tpu.utils.metrics import StepMetrics, Timer, accuracy
 
 
@@ -114,7 +115,8 @@ def snapshot_state(state) -> "TrainState":
 def make_train_step(model, optimizer, codec=None, augment: bool = False,
                     compute_dtype=None, guard=None, chaos=None,
                     superstep: int = 1, remedy=None,
-                    track_grad_norm: bool = False):
+                    track_grad_norm: bool = False,
+                    track_quality: bool = False):
     """Build the jitted single-host train step.
 
     codec != None applies encode->decode to the gradient pytree in-graph
@@ -149,6 +151,13 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
     (default) leaves the metrics pytree — and therefore the compiled
     program — exactly as before.
 
+    track_quality (``--obs-quality``; needs a codec) adds the in-graph
+    per-layer estimator-quality probes (obs.quality.quality_probe):
+    ``metrics["q_err2"]``/``metrics["q_rel"]`` are (L,) per-leaf series
+    of this step's encode error. Off (default) the program is
+    byte-identical (lowered-HLO tested) and on only ADDS metric outputs,
+    so trajectories are bit-identical armed vs off.
+
     superstep > 1 returns the FUSED variant: one jitted program that runs
     ``superstep`` full optimizer steps under a single ``lax.scan``
     (amortizing host dispatch, the dominant per-step cost on tunneled
@@ -174,6 +183,11 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
 
     if superstep < 1:
         raise ValueError(f"superstep must be >= 1, got {superstep}")
+    if track_quality and codec is None:
+        raise ValueError(
+            "track_quality probes the codec's estimator error; dense "
+            "training has no estimator to probe — drop one"
+        )
 
     def loss_fn(params, batch_stats, images, labels, dropout_key):
         if compute_dtype is not None:
@@ -221,8 +235,15 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
             grads = zero_if(~ok, grads)
 
         msg_bytes = 0
+        qm = None
         if codec is not None:
             payloads, stats = encode_tree(codec, k_codec, grads)
+            if track_quality:
+                from atomo_tpu.obs.quality import quality_probe
+
+                # per-layer ||decode(encode(g)) - g||^2 of THIS encode —
+                # the estimator-variance feed; off adds zero ops
+                qm = quality_probe(codec, payloads, grads)
             grads = decode_tree(codec, payloads, grads)
             msg_bytes = stats.payload_bytes
 
@@ -248,6 +269,8 @@ def make_train_step(model, optimizer, codec=None, augment: bool = False,
         }
         if gnorm is not None:
             metrics["grad_norm"] = gnorm
+        if qm is not None:
+            metrics.update(qm)
         return (
             TrainState(
                 step=state.step + 1,
@@ -327,6 +350,8 @@ def train_loop(
     superstep: int = 1,
     diverge=None,
     tuner=None,
+    track_quality: bool = False,
+    recorder=None,
 ) -> TrainState:
     """The reference train_and_validate loop (nn_ops.py:123-169), jitted,
     plus working checkpoint/resume (gap §5.4) and the fault-tolerance
@@ -375,7 +400,14 @@ def train_loop(
     recorded to ``incidents.jsonl`` at the next checkpoint boundary, the
     config is kept. Costs one scalar fetch per step in the per-step loop
     (the doctor's surveillance price); the superstep loop amortizes it
-    into the block's one fetch."""
+    into the block's one fetch.
+
+    ``recorder`` (obs.recorder.FlightRecorder) arms the flight recorder:
+    one ``metrics.jsonl`` record per step (per-step shares per superstep
+    block), pruned in lockstep with the checkpoint timeline on rollback.
+    None (default) adds zero device ops — the programs and the stdout
+    log are byte-identical. ``track_quality`` arms the in-graph
+    per-layer estimator-quality probes (see make_train_step)."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import (
         SUPERVISED_ENV,
@@ -439,8 +471,30 @@ def train_loop(
             augment=augment, compute_dtype=compute_dtype, guard=guard,
             chaos=chaos_now, superstep=superstep, remedy=remedy_cfg,
             track_grad_norm=diverge is not None,
+            # the densify window swaps to dense aggregation — no
+            # estimator left to probe for its duration
+            track_quality=False if densify else track_quality,
         )
 
+    if track_quality and codec is None:
+        raise ValueError(
+            "track_quality (--obs-quality) probes the codec's estimator "
+            "error; dense training has no estimator — drop one"
+        )
+    if recorder is not None:
+        recorder.context.setdefault("aggregate", "local")
+        # a resumed run replays from the checkpoint: cut the stale metric
+        # tail the killed attempt wrote past its last save, or the replay
+        # would duplicate those steps in the timeline
+        recorder.prune_past(start_step)
+        if track_quality:
+            from atomo_tpu.obs.quality import quality_meta
+
+            # the static per-layer kept-byte split, once (trace-time
+            # shapes only — nothing materializes)
+            recorder.write_meta(
+                quality_meta(codec, jax.device_get(state.params))
+            )
     step_fn = build_step()
     save_fn = retrying_saver(log_fn, incidents)
     key = jax.random.PRNGKey(seed + 1)
@@ -486,10 +540,12 @@ def train_loop(
                 log_fn, eval_freq, save_freq, train_dir, compress_ckpt,
                 save_fn, monitor, guard=guard, chaos=chaos,
                 keep_ckpts=keep_ckpts, rig=rig, tuner=tuner,
+                recorder=recorder,
             )
     with heartbeat_watchdog(health_timeout, on_health_failure) as monitor:
         step = start_step
         t_obs = time.perf_counter()  # the tuner's step-time series anchor
+        t_rec = time.perf_counter()  # the flight recorder's wall anchor
         while step < max_steps:
             step += 1
             if chaos is not None:
@@ -500,6 +556,22 @@ def train_loop(
             if monitor is not None:
                 jax.block_until_ready(metrics["loss"])
                 monitor.beat(step)
+            if recorder is not None:
+                # one fetch per step — the doctor's surveillance-price
+                # precedent; record BEFORE the doctor observes, so a
+                # diverged step lands in the timeline and the rollback's
+                # prune (checkpoint.prune_after -> prune_metrics_after)
+                # cuts it in lockstep with the checkpoint files
+                m_host = jax.device_get(metrics)
+                now_r = time.perf_counter()
+                recorder.record_block(
+                    step, m_host, wall_s=now_r - t_rec,
+                    drift=tuner.state if tuner is not None else None,
+                    generation=(
+                        rig.doctor.generation if rig is not None else None
+                    ),
+                )
+                t_rec = now_r
             if rig is not None:
                 # one scalar fetch per step: per-step surveillance is the
                 # price of per-step rollback granularity (the superstep
@@ -514,6 +586,7 @@ def train_loop(
                     # recovery wall is not step time: restamp the tuner's
                     # anchor or it pollutes the next drift observation
                     t_obs = time.perf_counter()
+                    t_rec = time.perf_counter()
                     continue
                 new_fn = rig.maybe_end_densify(step)
                 if new_fn is not None:
@@ -550,7 +623,7 @@ def train_loop(
                     prec1=float(metrics["prec1"]),
                     prec5=float(metrics["prec5"]),
                 )
-                log_fn(rec.worker_line())
+                emit_worker_line(recorder, rec, log_fn)
             if eval_freq and test_iter is not None and step % eval_freq == 0:
                 ev = evaluate(model, state, test_iter)
                 log_fn(
@@ -576,6 +649,8 @@ def train_loop(
                 # restamp after boundary work (eval/save): cadence costs
                 # must not enter the drift baseline
                 t_obs = time.perf_counter()
+            if recorder is not None:
+                t_rec = time.perf_counter()  # same boundary-work rule
         # autosave the final state so a restart never replays the tail
         # (strictly `<`: a resume past max_steps runs no steps and must not
         # write a file whose name disagrees with the state's step field)
@@ -636,6 +711,7 @@ def _superstep_steps(
     n_train, start_step, max_steps, superstep, log_every, log_fn,
     eval_freq, save_freq, train_dir, compress_ckpt, save_fn, monitor,
     guard=None, chaos=None, keep_ckpts=0, rig=None, tuner=None,
+    recorder=None,
 ):
     """train_loop's fused block path: one dispatch per K steps, one metric
     fetch per block (the fetch is also the fence the watchdog beats on),
@@ -657,6 +733,7 @@ def _superstep_steps(
     last_saved = start_step
     last_logged = start_step
     t_obs = time.perf_counter()  # the tuner's step-time series anchor
+    t_rec = time.perf_counter()  # the flight recorder's wall anchor
     feed.start(min(superstep, max_steps - s))
     while s < max_steps:
         kb, dev_im, dev_lb = feed.take()
@@ -676,6 +753,21 @@ def _superstep_steps(
         m = jax.device_get(mblk)  # the block's ONE host sync
         if monitor is not None:
             monitor.beat(s)
+        if recorder is not None:
+            # rides the block's one fetch (zero extra device ops); the
+            # block wall becomes kb equal per-step shares — the drift
+            # detector's partition-consistency convention. Recorded
+            # BEFORE the doctor observes: a diverged block lands in the
+            # timeline and the rollback prune cuts it in lockstep.
+            now_r = time.perf_counter()
+            recorder.record_block(
+                b0 + 1, m, wall_s=now_r - t_rec,
+                drift=tuner.state if tuner is not None else None,
+                generation=(
+                    rig.doctor.generation if rig is not None else None
+                ),
+            )
+            t_rec = now_r
         if rig is not None:
             alarm_step, reason = rig.observe(b0 + 1, m)
             if reason is not None:
@@ -690,6 +782,7 @@ def _superstep_steps(
                 feed.start(min(superstep, max_steps - s))
                 # recovery wall is not step time: restamp the tuner anchor
                 t_obs = time.perf_counter()
+                t_rec = time.perf_counter()
                 continue
             new_fn = rig.maybe_end_densify(s)
             if new_fn is not None:
@@ -713,7 +806,7 @@ def _superstep_steps(
                 s, m, train_iter, n_train, timer.lap(), last_logged
             )
             last_logged = s
-            log_fn(rec.worker_line())
+            emit_worker_line(recorder, rec, log_fn)
         if eval_freq and test_iter is not None and _crossed(eval_freq, b0, s):
             ev = evaluate(model, state, test_iter)
             log_fn(
@@ -735,6 +828,8 @@ def _superstep_steps(
                 tuner.maybe_retune(s, "local")  # observe-only on 1 device
         if tuner is not None:
             t_obs = time.perf_counter()  # boundary work is not step time
+        if recorder is not None:
+            t_rec = time.perf_counter()  # same boundary-work rule
     # autosave the final state so a restart never replays the tail (same
     # strictly-< contract as the per-step loop)
     if save_freq and train_dir and last_saved < max_steps:

@@ -774,6 +774,33 @@ def calibration_warning(
     )
 
 
+def rolling_calibration(
+    prev: float | None,
+    measured_s: float,
+    predicted_s: float,
+    window: int = 32,
+) -> float | None:
+    """One fold of the TRACKED calibration series: an EMA (span
+    ``window``) of the measured/predicted step-time ratio. This is
+    :func:`calibration_warning`'s one-shot >2x honesty check generalized
+    into the per-step column the flight recorder emits
+    (obs/recorder.py): the autopilot warns once at probe time, the
+    recorder keeps score for the whole run, so a prediction that goes
+    stale MID-run (a contended host, a changed load profile) is visible
+    in the timeline, not just at startup. ``prev`` is the previous EMA
+    value (None on the first sample); returns the new EMA, or ``prev``
+    unchanged when either input is unusable (a gap is not a sample —
+    the drift-detector convention)."""
+    m, p = float(measured_s), float(predicted_s)
+    if not (m > 0 and p > 0) or not (math.isfinite(m) and math.isfinite(p)):
+        return prev
+    ratio = m / p
+    if prev is None:
+        return ratio
+    alpha = 2.0 / (max(window, 2) + 1.0)
+    return prev + alpha * (ratio - prev)
+
+
 def max_beneficial_ways(dense_bytes: float, payload_bytes: float) -> float:
     """N above which the all_gather moves MORE bytes than dense all-reduce
     (gather traffic grows ~linearly in N; all-reduce saturates at 2D)."""

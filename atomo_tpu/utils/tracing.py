@@ -152,6 +152,57 @@ def write_json_atomic(path: str, obj) -> None:
 INCIDENT_LOG_NAME = "incidents.jsonl"
 
 
+def read_jsonl(path: str) -> list[dict]:
+    """Tolerant JSONL reader — the ONE parse discipline for every
+    append-only evidence stream (incidents.jsonl and the flight
+    recorder's metrics.jsonl): a missing file is an empty history, and
+    torn trailing lines (a write interrupted by SIGKILL) are skipped —
+    the artifact must stay readable after exactly the failures it
+    documents."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def format_incident(r: dict) -> str:
+    """One incident record as one human post-mortem line. The ONE
+    formatter shared by :meth:`IncidentLog.summarize` and the flight
+    recorder's run report (obs/report.py) — the PR-9 epoch/world/rc
+    special-cases used to live only inside summarize and would have
+    drifted the moment a second surface printed incidents."""
+    bits = [f"+{r.get('uptime_s', 0.0):.1f}s", r.get("cause", "?")]
+    if "step" in r:
+        bits.append(f"step={r['step']}")
+    if "target" in r:
+        bits.append(f"target={r['target']}")
+    if "attempt" in r:
+        bits.append(f"attempt={r['attempt']}")
+    # membership / elastic-triage context (PR-9): the epoch and world
+    # size ARE the record for a membership line — dropping them would
+    # reduce a reshape to an unexplained "-> shrink"
+    if "epoch" in r:
+        bits.append(f"epoch={r['epoch']}")
+    if "world" in r:
+        bits.append(f"world={r['world']}")
+    if "rc" in r:
+        bits.append(f"rc={r['rc']}")
+    if r.get("action"):
+        bits.append(f"-> {r['action']}")
+    return " ".join(bits)
+
+
 class IncidentLog:
     """Append-only JSONL incident stream (the post-mortem artifact).
 
@@ -223,48 +274,20 @@ class IncidentLog:
     def read(path: str) -> list[dict]:
         """Parse an incidents.jsonl; missing file = no incidents. Torn
         trailing lines (a write interrupted by a kill) are skipped — the
-        log must stay readable after exactly the failures it documents."""
-        if not os.path.exists(path):
-            return []
-        out = []
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    out.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue
-        return out
+        log must stay readable after exactly the failures it documents
+        (the shared :func:`read_jsonl` discipline)."""
+        return read_jsonl(path)
 
     @staticmethod
     def summarize(path: str) -> str:
-        """Human post-mortem: one line per incident, oldest first."""
+        """Human post-mortem: one line per incident, oldest first
+        (:func:`format_incident` — shared with the obs run report)."""
         recs = IncidentLog.read(path)
         if not recs:
             return f"no incidents recorded in {path!r}"
         lines = [f"incident log {path} ({len(recs)} records):"]
         for r in recs:
-            bits = [f"+{r.get('uptime_s', 0.0):.1f}s", r.get("cause", "?")]
-            if "step" in r:
-                bits.append(f"step={r['step']}")
-            if "target" in r:
-                bits.append(f"target={r['target']}")
-            if "attempt" in r:
-                bits.append(f"attempt={r['attempt']}")
-            # membership / elastic-triage context (PR-9): the epoch and
-            # world size ARE the record for a membership line — dropping
-            # them would reduce a reshape to an unexplained "-> shrink"
-            if "epoch" in r:
-                bits.append(f"epoch={r['epoch']}")
-            if "world" in r:
-                bits.append(f"world={r['world']}")
-            if "rc" in r:
-                bits.append(f"rc={r['rc']}")
-            if r.get("action"):
-                bits.append(f"-> {r['action']}")
-            lines.append("  " + " ".join(bits))
+            lines.append("  " + format_incident(r))
         return "\n".join(lines)
 
 

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
-# Bench smoke (~7 min): prove the bench entrypoint still emits parseable
-# evidence without burning the full-ladder window. Nine checks:
+# Bench smoke (~8 min): prove the bench entrypoint still emits parseable
+# evidence without burning the full-ladder window. Ten checks:
 #
 #   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
 #      the driver's last-line JSON contract, PLUS the partial-artifact
@@ -53,6 +53,15 @@
 #      in-row bit-parity asserts (payloads and step params) TRUE — the
 #      PR-10 backward-interleaved layer-streamed encode.
 #
+#  10. the observability contract (<60 s, forced 4-device CPU mesh): a
+#      run with the flight recorder AND the estimator-quality probes
+#      armed (--obs-record --obs-quality) must exit 0, leave a
+#      metrics.jsonl that parses with per-step records carrying the
+#      per-layer quality columns and the aggregate-mode column, and the
+#      `report` CLI verb must join metrics + incidents into a
+#      run_report.json whose consistency checks all pass — the PR-11
+#      flight recorder.
+#
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
 cd "$(dirname "$0")/.." || exit 2
@@ -88,7 +97,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/9]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/10]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -117,7 +126,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/9]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/10]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -154,7 +163,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/9]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/10]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -185,7 +194,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/9]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/10]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -212,7 +221,7 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/9]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/10]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
 EOF
 [ $? -ne 0 ] && exit 1
@@ -245,7 +254,7 @@ for r in probed:
     assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
     assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
 assert doc.get("why"), doc
-print(f"bench_smoke OK[6/9]: --auto tune picked {win['name']} "
+print(f"bench_smoke OK[6/10]: --auto tune picked {win['name']} "
       f"({win.get('measured_ms_per_step')} ms/step measured, "
       f"{len(probed)}/{len(doc['rows'])} candidates probed); "
       "decision artifact parses")
@@ -289,7 +298,7 @@ for p in plans:
     assert isinstance(p.get("predicted_ms_per_step"), (int, float)), p
 td = row.get("tune_decision") or {}
 assert td.get("hierarchical_probed"), row
-print(f"bench_smoke OK[7/9]: two-tier plans "
+print(f"bench_smoke OK[7/10]: two-tier plans "
       f"{[p['plan'] for p in plans]} measured with per-tier "
       "predicted-vs-measured bytes matching, per-plan bit_parity=True; "
       f"mini-tune probed {td['hierarchical_probed']} "
@@ -337,7 +346,7 @@ sys.path.insert(0, ".")
 from atomo_tpu.training.checkpoint import latest_valid_step
 
 assert latest_valid_step(d) == 8, latest_valid_step(d)
-print("bench_smoke OK[8/9]: die@3:1 shrank 4 -> 3 at a checkpoint "
+print("bench_smoke OK[8/10]: die@3:1 shrank 4 -> 3 at a checkpoint "
       "boundary (planned reshape, restart budget untouched), finished at "
       f"step {latest_valid_step(d)} with membership epochs "
       f"{[w[0] for w in worlds]} recorded")
@@ -373,10 +382,60 @@ for k in ("compute_ms", "encode_monolithic_ms", "encode_streamed_ms",
           "encode_hidden_stream_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 assert int(ph.get("n_buckets", 0)) > 1, row
-print(f"bench_smoke OK[9/9]: stream {row['value']} vs off "
+print(f"bench_smoke OK[9/10]: stream {row['value']} vs off "
       f"{row['off_ms_per_step']} ms/step; exposed encode "
       f"{ph['encode_exposed_stream_ms']} (stream, {ph['n_buckets']} "
       f"buckets) vs {ph['encode_exposed_off_ms']} (off) ms; "
       f"payload+param bit_parity=True")
 EOF9
 [ $? -ne 0 ] && exit 1
+
+# --- 10: flight recorder + quality probes + report verb ------------------
+obsd="$art/obs"
+out=$(timeout -k 5 60 env JAX_PLATFORMS=cpu ATOMO_COMPILE_CACHE="$art/xla" \
+      XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+      python -m atomo_tpu.cli train --synthetic --dataset mnist \
+      --network lenet --batch-size 8 --max-steps 6 --eval-freq 0 \
+      --save-freq 2 --log-interval 2 --n-devices 4 --code qsgd \
+      --quantization-level 8 --aggregate gather --train-dir "$obsd" \
+      --obs-record --obs-quality 2>&1)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: obs-record run exited rc=$rc"
+  printf '%s\n' "$out" | tail -5
+  exit 1
+fi
+rep=$(timeout -k 5 30 env JAX_PLATFORMS=cpu \
+      python -m atomo_tpu.cli report --train-dir "$obsd" --strict 2>&1)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: report verb exited rc=$rc"
+  printf '%s\n' "$rep" | tail -8
+  exit 1
+fi
+python - "$obsd" <<'EOF'
+import json, os, sys
+
+d = sys.argv[1]
+recs = [json.loads(l) for l in open(os.path.join(d, "metrics.jsonl"))]
+steps = [r for r in recs if r.get("kind") == "step"]
+assert [r["step"] for r in steps] == list(range(1, 7)), steps
+for r in steps:
+    assert r["aggregate"] == "gather" and r["step_ms"] > 0, r
+    assert len(r["q_rel"]) == len(r["q_err2"]) > 0, r
+metas = [r for r in recs if r.get("kind") == "meta"]
+assert len(metas) == 1 and metas[0]["what"] == "obs_quality", metas
+assert len(metas[0]["layers"]) == len(steps[0]["q_rel"]), metas
+doc = json.load(open(os.path.join(d, "run_report.json")))
+assert doc["consistent"] is True, doc["checks"]
+ran = [c["name"] for c in doc["checks"] if not c["skipped"]]
+segs = [e for e in doc["timeline"] if e["kind"] == "metrics"]
+assert segs and segs[0]["first_step"] == 1 and segs[-1]["last_step"] == 6
+print("bench_smoke OK[10/10]: recorder+quality run left "
+      f"{len(steps)} step records ({len(steps[0]['q_rel'])}-layer "
+      "quality columns), report verb joined a consistent timeline "
+      f"(checks ran: {ran})")
+EOF
+[ $? -ne 0 ] && exit 1
+
+echo "bench_smoke: all 10 checks passed"

@@ -4,4 +4,8 @@
 # this one script instead of copy-pasting the command (and drifting).
 # Usage: scripts/tier1.sh   (from the repo root or anywhere)
 cd "$(dirname "$0")/.." || exit 2
+# artifact-writer lint first (also runs inside pytest as
+# tests/test_artifact_discipline.py — this keeps the gate visible even
+# when only the script is invoked): the one-discipline rule, enforced
+python scripts/check_artifact_discipline.py || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
