@@ -165,6 +165,34 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                         "stream off's dataflow with stream on's code path). "
                         "Any value is bit-identical (layout only; tested); "
                         "smaller buckets pipeline finer at more dispatches")
+    t.add_argument("--sparse-rows", type=str, default="off",
+                   choices=["off", "auto", "on"],
+                   help="per-layer sparse-row hybrid exchange (sparse/): "
+                        "lookup-table leaves whose lossless (row, value) "
+                        "payload beats the dense path's bytes move as rows "
+                        "(the SparCML density crossover, stated per layer "
+                        "in the plan's reason lines); every other leaf "
+                        "keeps the existing gather/ring exchange. auto = "
+                        "plan from a probe gradient and use it when any "
+                        "leaf is sparse-assignable (with --auto tune, the "
+                        "+sp candidates decide); on = require it. Needs a "
+                        "multi-device flat gather/ring exchange (row-id "
+                        "workloads: --dataset zipf --network embedding); "
+                        "rejects psum/hierarchical/delayed/stream-encode/"
+                        "guard/num-aggregate — the conflict matrix says "
+                        "why. off (default) is byte-identical program text")
+    t.add_argument("--emb-rows", type=int, default=4096, metavar="R",
+                   help="--network embedding: lookup-table rows (must "
+                        "match the --dataset zipf id range; <= 2^24 so "
+                        "float32 batches carry ids exactly)")
+    t.add_argument("--emb-dim", type=int, default=16, metavar="D",
+                   help="--network embedding: embedding dimension")
+    t.add_argument("--zipf-slots", type=int, default=8, metavar="S",
+                   help="--dataset zipf: lookups per sample (bounds the "
+                        "lossless row budget: batch/chip x slots)")
+    t.add_argument("--zipf-alpha", type=float, default=1.1, metavar="A",
+                   help="--dataset zipf: power-law exponent of the row "
+                        "access distribution (p_i ~ 1/i^A)")
     t.add_argument("--ring-bucket-size", type=int, default=65536, metavar="N",
                    help="ring aggregation: elements per packed rotation "
                         "bucket (parallel.common.pack_tree_buckets) — every "
@@ -448,9 +476,26 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
     load_dataset = with_retries(load_dataset, exceptions=(OSError,))
 
     name = canonical_name(args.dataset)
+
+    def _zipf_ds(train: bool):
+        # the zipf workload is synthetic by design and parameterized by
+        # the CLI's table knobs — built directly so rows/slots/alpha
+        # stay consistent with the embedding model below
+        from atomo_tpu.data.zipf import zipf_dataset
+
+        return zipf_dataset(
+            train,
+            rows=getattr(args, "emb_rows", 4096),
+            slots=getattr(args, "zipf_slots", 8),
+            alpha=getattr(args, "zipf_alpha", 1.1),
+            seed=args.seed,
+        )
+
     train_iter = None
     if need_train:  # the evaluator never touches the train split
-        if args.synthetic:
+        if name == "zipf":
+            train_ds = _zipf_ds(True)
+        elif args.synthetic:
             train_ds = synthetic_dataset(SPECS[name], True)
         else:
             train_ds = load_dataset(name, args.data_root, train=True)
@@ -459,14 +504,27 @@ def _build_common(args: argparse.Namespace, need_train: bool = True):
         train_iter = BatchIterator(
             train_ds, args.batch_size, seed=getattr(args, "data_seed", args.seed)
         )
-    if args.synthetic:
+    if name == "zipf":
+        test_ds = _zipf_ds(False)
+    elif args.synthetic:
         test_ds = synthetic_dataset(SPECS[name], False)
     else:
         test_ds = load_dataset(name, args.data_root, train=False)
     test_iter = BatchIterator(
         test_ds, args.test_batch_size, shuffle=False, drop_last=False, seed=args.seed
     )
-    model = get_model(args.network, _num_classes(args.dataset))
+    if args.network.lower() == "embedding":
+        # table sizes are CLI knobs (the zipf id range must match them);
+        # the registry's fixed-size entries serve everything else
+        from atomo_tpu.models import EmbeddingTower
+
+        model = EmbeddingTower(
+            num_classes=_num_classes(args.dataset),
+            rows=getattr(args, "emb_rows", 4096),
+            dim=getattr(args, "emb_dim", 16),
+        )
+    else:
+        model = get_model(args.network, _num_classes(args.dataset))
     optimizer = make_optimizer(
         args.optimizer,
         lr=args.lr,
@@ -665,6 +723,10 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             pinned.append(f"--overlap {args.overlap}")
         if getattr(args, "stream_encode", "off") != "off":
             pinned.append(f"--stream-encode {args.stream_encode}")
+        if getattr(args, "sparse_rows", "off") == "on":
+            # "auto" is the explore sentinel (the +sp candidates decide);
+            # "on" is a pinned knob like any other
+            pinned.append(f"--sparse-rows {args.sparse_rows}")
         if args.superstep != 0:
             pinned.append(f"--superstep {args.superstep}")
         if getattr(args, "plan", "auto") != "auto":
@@ -779,6 +841,74 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "--phase-metrics times a monolithic encode phase program "
                 "and cannot describe the bucket-streamed schedule; drop "
                 "one of the flags"
+            )
+    if getattr(args, "sparse_rows", "off") != "off":
+        if args.n_devices == 1 and args.sparse_rows == "on":
+            # "auto" degrades gracefully in cmd_train (single device ->
+            # all-dense, out loud); only the pinned "on" is a hard
+            # config error here
+            raise SystemExit(
+                "--sparse-rows needs a multi-device mesh: single-device "
+                "training has no exchange to save wire on"
+            )
+        if args.aggregate == "psum":
+            raise SystemExit(
+                "--sparse-rows does not compose with --aggregate psum: "
+                "the row payloads would ride a full dense all-reduce "
+                "wire, so the sparse exchange degenerates (the SparCML "
+                "crossover can never pay); use --aggregate gather or ring"
+            )
+        if args.aggregate == "hierarchical" or plan_flag != "auto":
+            raise SystemExit(
+                "--sparse-rows does not compose with hierarchical "
+                "aggregation (--aggregate hierarchical / --plan): the "
+                "boundary re-encode composes a second estimator per "
+                "layer and is not row-aware yet — rejected honestly"
+            )
+        if args.overlap == "delayed":
+            raise SystemExit(
+                "--sparse-rows does not compose with --overlap delayed: "
+                "the carried payload's shapes are assignment-specific "
+                "and the consume chain is not row-aware yet"
+            )
+        if getattr(args, "stream_encode", "off") == "on":
+            raise SystemExit(
+                "--sparse-rows does not compose with --stream-encode: "
+                "the layer-bucket encode pipeline is not "
+                "assignment-aware yet; drop one"
+            )
+        if args.phase_metrics:
+            raise SystemExit(
+                "--sparse-rows is not supported with --phase-metrics "
+                "(the phased programs assume one whole-tree codec "
+                "exchange; there is no row-aware phase split)"
+            )
+        if (
+            args.grad_guard or args.max_grad_norm > 0
+            or getattr(args, "elastic", False)
+        ):
+            raise SystemExit(
+                "--sparse-rows does not compose with the gradient guard "
+                "(--grad-guard / --max-grad-norm) or --elastic: the row "
+                "exchange has no skip-and-rescale masking yet — run the "
+                "guard all-dense"
+            )
+        if args.num_aggregate is not None:
+            raise SystemExit(
+                "--sparse-rows does not compose with --num-aggregate: "
+                "the rotating replica subset is not wired into the row "
+                "exchange"
+            )
+        if (
+            getattr(args, "auto", "off") == "tune"
+            and args.code.lower() in DENSE_CODES
+        ):
+            raise SystemExit(
+                "--auto tune with --sparse-rows needs a compressing "
+                "--code: with --code sgd the dense-assigned leaves' only "
+                "exchange is the plain dense wire, so there is no "
+                "candidate space for the +sp variants to compete in — "
+                "pick a compressing --code or drop --auto tune"
             )
     if getattr(args, "obs_record", False) and not args.train_dir:
         raise SystemExit(
@@ -993,7 +1123,7 @@ def _real_stream_buckets(model_init_fn, bucket_bytes: int) -> int:
 
 
 def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
-                   save_freq):
+                   save_freq, sparse_plan=None):
     """``--auto tune``: run the startup probe ladder, apply the winning
     knob vector onto ``args`` (aggregate / overlap / ring bucket) and
     return ``(superstep, tuner)`` — the chosen fused-block size plus the
@@ -1154,6 +1284,16 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             # estimate overstates granularity when one leaf exceeds the
             # bound (an LM embedding)
             allow_stream=codec is not None and n_dev > 1,
+            # the +sp hybrid variants: explored only under --sparse-rows
+            # auto with a plan that actually sparse-assigns something
+            # (preflight rejected the pinned "on" and the dense-code
+            # case); priced from the plan's per-leaf wire bytes and
+            # probed with the plan attached to the real step builder
+            allow_sparse=(
+                sparse_plan is not None
+                and getattr(args, "sparse_rows", "off") == "auto"
+            ),
+            hybrid=sparse_plan,
             stream_bucket_bytes=_stream_bucket_bytes(args),
             stream_buckets=_real_stream_buckets(
                 _init_params, _stream_bucket_bytes(args)
@@ -1212,6 +1352,8 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
     args.ring_bucket_size = int(
         knobs.get("ring_bucket_size", args.ring_bucket_size)
     )
+    # a +sp winner pins the hybrid plan on; cmd_train applies it
+    args._tuned_sparse = knobs.get("sparse_rows", "off")
     superstep = max(int(knobs.get("superstep", 1)), 1)
     print(f"--auto tune -> {win.get('name')} ({doc.get('why')})", flush=True)
 
@@ -1242,6 +1384,9 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
                 num_aggregate=k_agg, zero1=zero1,
                 grad_accum=args.grad_accum, compute_dtype=compute_dtype,
                 ring_bucket_size=args.ring_bucket_size,
+                # a +sp winner's gather<->ring re-probe must time the
+                # hybrid program the run actually dispatches
+                hybrid=sparse_plan,
             )
             return row["measured_ms_per_step"]
 
@@ -1375,10 +1520,86 @@ def cmd_train(args: argparse.Namespace) -> int:
                 f"run resolved to a {n_dev}-device mesh (replicas are "
                 "0-based); the fault would never fire"
             )
+    sparse_plan = None
+    if args.sparse_rows != "off":
+        if n_dev <= 1:
+            # the argv-ambiguous half of the preflight mesh check
+            if args.sparse_rows == "on":
+                raise SystemExit(
+                    "--sparse-rows needs a multi-device mesh: this host "
+                    "resolved to 1 device, so there is no exchange to "
+                    "save wire on"
+                )
+            print(
+                "--sparse-rows auto: single device, no exchange — "
+                "running dense",
+                flush=True,
+            )
+        elif train_iter.images.ndim != 2:
+            msg = (
+                "--sparse-rows: this workload's batches are not row-id "
+                "shaped, so no leaf has a provable per-step row bound "
+                "(row-id workloads: --dataset zipf --network embedding)"
+            )
+            if args.sparse_rows == "on":
+                raise SystemExit(msg + "; drop --sparse-rows")
+            print(msg + " — running all-dense", flush=True)
+        else:
+            # plan from a probe gradient over a DIRECT slice of the
+            # training arrays (never epoch(): pulling a batch would
+            # advance the shuffle RNG — the --aggregate auto precedent)
+            from atomo_tpu.codecs import DenseCodec
+            from atomo_tpu.sparse import plan_for_model
+
+            plan_codec = codec if codec is not None else DenseCodec()
+            probe_n = min(max(args.batch_size, 8), len(train_iter.images))
+            plan = plan_for_model(
+                plan_codec, model,
+                train_iter.images[:probe_n], train_iter.labels[:probe_n],
+                batch_per_chip=max(args.batch_size // n_dev, 1),
+                slots=int(train_iter.images.shape[1]),
+            )
+            if plan.any_sparse:
+                sparse_plan = plan
+                print(plan.describe(), flush=True)
+                for a in plan.assignments:
+                    print(f"  [{a.index}] {a.name}: {a.reason}", flush=True)
+            elif args.sparse_rows == "on":
+                for a in plan.assignments:
+                    print(f"  [{a.index}] {a.name}: {a.reason}", flush=True)
+                raise SystemExit(
+                    "--sparse-rows on: the hybrid planner assigned no "
+                    "leaf sparse for this model/codec/batch (per-leaf "
+                    "reasons above); drop --sparse-rows or shrink the "
+                    "dense path's payload"
+                )
+            else:
+                print(
+                    "--sparse-rows auto: the planner assigned no leaf "
+                    "sparse — running all-dense",
+                    flush=True,
+                )
     tuner = None
     if args.auto == "tune":
         superstep, tuner = _run_autopilot(args, model, optimizer, codec,
-                                          train_iter, n_dev, save_freq)
+                                          train_iter, n_dev, save_freq,
+                                          sparse_plan=sparse_plan)
+    hybrid_plan = None
+    if sparse_plan is not None:
+        if args.auto == "tune":
+            # the +sp candidates competed in the probe ladder; the
+            # winner's knob decides (measured, not assumed)
+            if getattr(args, "_tuned_sparse", "off") == "on":
+                hybrid_plan = sparse_plan
+        else:
+            hybrid_plan = sparse_plan
+        if hybrid_plan is not None and codec is None:
+            # --code sgd: the dense-assigned leaves ride the payload
+            # gather/ring as uncompressed DenseCodec payloads (the
+            # hybrid's "existing dense exchange"), priced honestly
+            from atomo_tpu.codecs import DenseCodec
+
+            codec = DenseCodec()
     diverge = None
     if args.on_diverge != "off":
         from atomo_tpu.training.resilience import (
@@ -1466,6 +1687,45 @@ def cmd_train(args: argparse.Namespace) -> int:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
         from atomo_tpu.training import stepwise_shrink
 
+        if args.aggregate == "auto" and hybrid_plan is not None:
+            # the hybrid plan's wire bytes decide — the dense-path byte
+            # budget would mis-price the exchange --sparse-rows actually
+            # dispatches; and the row payloads need the payload path, so
+            # a psum/hierarchical pick falls back to gather out loud
+            from atomo_tpu.utils.comm_model import (
+                choose_aggregate,
+                resolve_fabric,
+            )
+
+            try:
+                bw = resolve_fabric(args.fabric, n_proc=jax.process_count())
+            except ValueError as exc:
+                raise SystemExit(str(exc)) from None
+            mode, reason = choose_aggregate(
+                has_codec=True,
+                dense_bytes=sum(
+                    a.dense_bytes for a in hybrid_plan.assignments
+                ),
+                payload_bytes=hybrid_plan.payload_bytes(),
+                ways=n_dev,
+                fabric_bw=bw,
+                tax_s=(
+                    None if args.codec_tax_ms is None
+                    else args.codec_tax_ms / 1e3
+                ),
+            )
+            if mode not in ("gather", "ring"):
+                reason = (
+                    f"{mode} pick overridden — the sparse-row exchange "
+                    f"needs the payload path ({reason})"
+                )
+                mode = "gather"
+            print(
+                f"--aggregate auto -> {mode} (sparse-row hybrid plan: "
+                f"{reason})",
+                flush=True,
+            )
+            args.aggregate = mode
         if args.aggregate == "auto":
             # shape only — do NOT pull a batch: epoch() advances the
             # iterator's persistent shuffle RNG, which would change the
@@ -1609,6 +1869,7 @@ def cmd_train(args: argparse.Namespace) -> int:
                 elastic=elastic_cfg,
                 track_quality=args.obs_quality,
                 recorder=recorder,
+                hybrid=hybrid_plan,
             )
         except DivergenceError as exc:
             return _diverged_exit(exc)

@@ -13,3 +13,8 @@ from atomo_tpu.data.pipeline import (  # noqa: F401
     augment_batch,
     normalize,
 )
+from atomo_tpu.data.zipf import (  # noqa: F401
+    zipf_dataset,
+    zipf_probs,
+    zipf_spec,
+)

@@ -54,10 +54,16 @@ SPECS = {
     "svhn": DatasetSpec(
         "svhn", (32, 32, 3), 10, 73257, 26032, (0.0, 0.0, 0.0), (1.0, 1.0, 1.0)
     ),
+    # the sparse/embedding workload: (slots,) float32 row ids, identity
+    # normalization (ids stay bit-exact). Literal kept in lockstep with
+    # data/zipf.py's defaults (tested: test_sparse.py) — a module-load
+    # import of zipf here would be circular.
+    "zipf": DatasetSpec("zipf", (8,), 10, 4096, 1024, (0.0,), (1.0,)),
 }
 
-# reference CLI spellings (distributed_nn.py --dataset choices)
-_ALIASES = {"mnist": "mnist", "cifar10": "cifar10", "cifar100": "cifar100", "svhn": "svhn"}
+# reference CLI spellings (distributed_nn.py --dataset choices) + the
+# capability-superset zipf row-access workload
+_ALIASES = {"mnist": "mnist", "cifar10": "cifar10", "cifar100": "cifar100", "svhn": "svhn", "zipf": "zipf"}
 
 
 def canonical_name(name: str) -> str:
@@ -174,6 +180,19 @@ def synthetic_dataset(spec: DatasetSpec, train: bool, size: Optional[int] = None
     fit them (loss decreases, accuracy rises above chance) — making the
     end-to-end trainer testable offline.
     """
+    if spec.name == "zipf":
+        # power-law row ids, not images: one builder (data/zipf.py) so
+        # every synthetic entry point hands back the same deterministic
+        # stream. Lazy import — zipf imports this module's dataclasses.
+        from atomo_tpu.data.zipf import zipf_dataset
+
+        return zipf_dataset(
+            train,
+            slots=int(spec.image_shape[0]),
+            num_classes=spec.num_classes,
+            size=size,
+            seed=seed,
+        )
     n = size or (spec.train_size if train else spec.test_size)
     n = min(n, 10000 if train else 2000) if size is None else n
     rng = np.random.RandomState(seed + (0 if train else 1))
@@ -195,6 +214,10 @@ def load_dataset(
 ) -> ArrayDataset:
     key = canonical_name(name)
     spec = SPECS[key]
+    if key == "zipf":
+        # no on-disk format: the zipf workload is synthetic by design
+        # (deterministic from seed — resume/replay fingerprintable)
+        return synthetic_dataset(spec, train, size=synthetic_size)
     loaded = None
     if os.path.isdir(root):
         if key == "mnist":

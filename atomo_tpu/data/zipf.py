@@ -1,0 +1,96 @@
+"""Seeded power-law (Zipf) row-access sampler — the sparse workload's data.
+
+Real embedding traffic is power-law: a few hot rows dominate, a long tail
+is touched rarely (the Parallax/SparCML measurement setting). This module
+synthesizes that shape DETERMINISTICALLY: ``zipf_dataset`` materializes a
+``(size, slots)`` float32 array of row ids drawn from
+``p_i ∝ 1/(i+1)^alpha`` with a seeded ``np.random.RandomState``, wrapped
+in the standard :class:`~atomo_tpu.data.datasets.ArrayDataset` (identity
+normalization: mean 0, std 1 — ``normalized()`` returns the ids bit-exact
+as float32, exact for any table ≤ 2^24 rows).
+
+Riding the existing :class:`~atomo_tpu.data.pipeline.BatchIterator` is
+the point, not a shortcut: the iterator's ``rng_signature()`` CRC
+fingerprint, ``forever(skip=...)`` resume-replay and ``restream``
+rollback-replay all apply to the new workload with zero new code, so
+elastic shard maps and the divergence doctor's replay cover it exactly
+like the image datasets (satellite contract; pinned in
+tests/test_sparse.py).
+
+Labels are a deterministic function of the accessed rows
+(``first-row id mod num_classes``) so the tower has real signal to fit —
+the synthetic_dataset "models can actually fit it" rule.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from atomo_tpu.data.datasets import ArrayDataset, DatasetSpec
+
+# defaults match models/embedding.EmbeddingTower's table and keep the
+# per-step density realistic (~batch*slots/rows) without bloating tests
+ZIPF_ROWS = 4096
+ZIPF_SLOTS = 8
+ZIPF_ALPHA = 1.1
+ZIPF_TRAIN_SIZE = 4096
+ZIPF_TEST_SIZE = 1024
+ZIPF_CLASSES = 10
+
+
+def zipf_spec(
+    slots: int = ZIPF_SLOTS,
+    num_classes: int = ZIPF_CLASSES,
+) -> DatasetSpec:
+    """The zipf DatasetSpec: ``image_shape`` carries ``(slots,)`` (the
+    pipeline treats it opaquely) and identity normalization keeps
+    ``normalized()`` bit-exact on the float row ids. The table row range
+    is a property of the ARRAYS (``zipf_dataset``'s ``rows``), not the
+    spec — DatasetSpec has no field for it."""
+    return DatasetSpec(
+        name="zipf",
+        image_shape=(int(slots),),
+        num_classes=int(num_classes),
+        train_size=ZIPF_TRAIN_SIZE,
+        test_size=ZIPF_TEST_SIZE,
+        mean=(0.0,),
+        std=(1.0,),
+    )
+
+
+def zipf_probs(rows: int, alpha: float = ZIPF_ALPHA) -> np.ndarray:
+    """``p_i ∝ 1/(i+1)^alpha`` over ``rows`` ids, normalized (float64 for
+    an exactly-summing distribution)."""
+    w = 1.0 / np.power(np.arange(1, int(rows) + 1, dtype=np.float64), alpha)
+    return w / w.sum()
+
+
+def zipf_dataset(
+    train: bool = True,
+    *,
+    rows: int = ZIPF_ROWS,
+    slots: int = ZIPF_SLOTS,
+    alpha: float = ZIPF_ALPHA,
+    num_classes: int = ZIPF_CLASSES,
+    size: int | None = None,
+    seed: int = 0,
+) -> ArrayDataset:
+    """Deterministic power-law row-access dataset (module docstring).
+
+    Same ``(seed, rows, slots, alpha, size)`` -> bit-identical arrays;
+    train/test draw from offset seeds like ``synthetic_dataset``."""
+    if rows > (1 << 24):
+        raise ValueError(
+            f"zipf rows={rows} exceeds 2^24: float32 batches could not "
+            "carry the row ids exactly"
+        )
+    spec = zipf_spec(slots=slots, num_classes=num_classes)
+    n = int(size) if size is not None else (
+        spec.train_size if train else spec.test_size
+    )
+    rng = np.random.RandomState(seed + (0 if train else 1))
+    ids = rng.choice(
+        int(rows), size=(n, int(slots)), p=zipf_probs(rows, alpha)
+    ).astype(np.float32)
+    labels = (ids[:, 0].astype(np.int64) % num_classes).astype(np.int32)
+    return ArrayDataset(spec=spec, images=ids, labels=labels, synthetic=True)

@@ -13,6 +13,7 @@ from typing import Callable
 import flax.linen as nn
 
 from atomo_tpu.models.alexnet import AlexNet, alexnet  # noqa: F401
+from atomo_tpu.models.embedding import EmbeddingTower  # noqa: F401
 from atomo_tpu.models.densenet import (  # noqa: F401
     DenseNet,
     densenet_bc_100,
@@ -62,6 +63,13 @@ _REGISTRY: dict[str, Callable[[int], nn.Module]] = {
     "vgg13_plain": vgg13,
     "vgg16_plain": vgg16,
     "vgg19_plain": vgg19,
+    # sparse/embedding workload family (PR-12): row-sparse table + dense
+    # tower; sizes beyond the CLI's --emb-rows/--emb-dim knobs register
+    # here
+    "embedding": lambda n: EmbeddingTower(num_classes=n),
+    "embedding_wide": lambda n: EmbeddingTower(
+        num_classes=n, rows=65536, dim=32
+    ),
 }
 
 

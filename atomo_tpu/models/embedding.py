@@ -1,0 +1,54 @@
+"""Embedding-tower model family: the row-sparse workload.
+
+The "millions of users" workloads the ROADMAP targets are
+recommendation/retrieval-shaped: a lookup table whose per-step gradient
+touches only the rows the batch accessed, feeding a small dense tower.
+No reference analogue (the reference zoo is CV-only); the family exists
+to exercise the sparse exchange subsystem (sparse/) on gradients whose
+row sparsity is structural, not incidental.
+
+Input convention: the data pipeline feeds ``(batch, slots)`` float32 row
+ids (the Zipf sampler, data/zipf.py — float32 so the existing
+BatchIterator/shard_batch/checkpoint machinery carries them unchanged;
+ids are exact in f32 up to 2^24, enforced at construction). The model
+casts to int32 and looks rows up with ``jnp.take``, whose backward is a
+scatter-add — each sample contributes gradient to at most ``slots`` rows,
+the bound ``sparse.hybrid.infer_row_bounds`` turns into the lossless row
+budget. The table param is named ``table`` on purpose: the hybrid
+planner's stated name-matching (TABLE_NAME_HINTS) keys off it.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# float32 holds integers exactly only up to 2^24: a bigger table would
+# silently alias row ids in the data pipeline's float batches
+MAX_F32_EXACT_ROWS = 1 << 24
+
+
+class EmbeddingTower(nn.Module):
+    """Table lookup -> concat -> 2-layer dense tower -> classes."""
+
+    num_classes: int = 10
+    rows: int = 4096
+    dim: int = 16
+    hidden: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        if self.rows > MAX_F32_EXACT_ROWS:
+            raise ValueError(
+                f"EmbeddingTower rows={self.rows} exceeds 2^24: the "
+                "float32 data pipeline cannot carry row ids exactly"
+            )
+        idx = jnp.asarray(x, jnp.int32)  # (batch, slots) row ids
+        table = self.param(
+            "table", nn.initializers.normal(0.02), (self.rows, self.dim)
+        )
+        emb = jnp.take(table, idx, axis=0)  # backward = row scatter-add
+        h = emb.reshape((emb.shape[0], -1))
+        h = nn.relu(nn.Dense(self.hidden)(h))
+        return nn.Dense(self.num_classes)(h)

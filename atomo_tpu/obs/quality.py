@@ -50,8 +50,18 @@ def quality_probe(codec, payloads, grads) -> dict:
     denominator at f32-tiny so a zero-gradient layer reads 0/tiny = 0
     error, not NaN."""
     decoded = decode_tree(codec, payloads, grads)
-    g_leaves = jax.tree_util.tree_leaves(grads)
-    d_leaves = jax.tree_util.tree_leaves(decoded)
+    return quality_from_decoded(
+        jax.tree_util.tree_leaves(decoded),
+        jax.tree_util.tree_leaves(grads),
+    )
+
+
+def quality_from_decoded(d_leaves, g_leaves) -> dict:
+    """The error math of :func:`quality_probe` over an already-decoded
+    leaf list — shared with the hybrid exchange's probe, whose per-leaf
+    decode dispatches on the plan's assignment (sparse-assigned leaves
+    decode losslessly and read exactly 0 here: the lossless contract,
+    observed live in the telemetry stream)."""
     err2 = []
     g2 = []
     for g, d in zip(g_leaves, d_leaves):
@@ -68,13 +78,26 @@ def quality_probe(codec, payloads, grads) -> dict:
     }
 
 
-def quality_meta(codec, tree: Any, stream_bucket_bytes: Optional[int] = None) -> dict:
+def quality_meta(
+    codec,
+    tree: Any,
+    stream_bucket_bytes: Optional[int] = None,
+    hybrid=None,
+) -> dict:
     """The static half of the quality telemetry: the per-layer kept-byte
     split — layer name, shape, dense bytes, payload bytes — computed at
     zero cost with ``jax.eval_shape`` (nothing materializes; the
     _zero_carry_host precedent). Recorded once as a ``meta`` line so the
     per-step records stay small; keyed by the same canonical leaf order
-    ``q_err2``/``q_rel`` index."""
+    ``q_err2``/``q_rel`` index.
+
+    ``hybrid`` (sparse.hybrid.HybridPlan) adds the per-layer MEASURED
+    density, the assignment (sparse vs dense) and — for sparse-assigned
+    layers — the static row budget, and overrides those layers' payload
+    bytes with the row-payload wire size, so the byte split describes
+    the exchange that actually runs. The ``report`` verb's consistency
+    checks audit these columns (density in [0, 1]; a sparse-assigned
+    layer's payload strictly below its dense bytes)."""
     import numpy as np
 
     from atomo_tpu.codecs import encode_tree
@@ -84,8 +107,13 @@ def quality_meta(codec, tree: Any, stream_bucket_bytes: Optional[int] = None) ->
     )
     flat_paths, treedef = jax.tree_util.tree_flatten_with_path(tree)
     p_leaves = treedef.flatten_up_to(shapes)
+    if hybrid is not None and hybrid.n_leaves != len(flat_paths):
+        raise ValueError(
+            f"hybrid plan covers {hybrid.n_leaves} leaves but the tree "
+            f"has {len(flat_paths)} — plan and tree must match"
+        )
     layers = []
-    for (path, leaf), p in zip(flat_paths, p_leaves):
+    for i, ((path, leaf), p) in enumerate(zip(flat_paths, p_leaves)):
         dense = int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
         pay = int(
             sum(
@@ -93,14 +121,20 @@ def quality_meta(codec, tree: Any, stream_bucket_bytes: Optional[int] = None) ->
                 for s in jax.tree_util.tree_leaves(p)
             )
         )
-        layers.append(
-            {
-                "name": jax.tree_util.keystr(path),
-                "shape": [int(d) for d in leaf.shape],
-                "dense_bytes": dense,
-                "payload_bytes": pay,
-            }
-        )
+        row = {
+            "name": jax.tree_util.keystr(path),
+            "shape": [int(d) for d in leaf.shape],
+            "dense_bytes": dense,
+            "payload_bytes": pay,
+        }
+        if hybrid is not None:
+            a = hybrid.assignments[i]
+            row["assignment"] = a.kind
+            row["density"] = round(float(a.density), 6)
+            row["payload_bytes"] = int(a.payload_bytes)
+            if a.kind == "sparse":
+                row["row_budget"] = int(a.row_budget)
+        layers.append(row)
     out = {
         "what": "obs_quality",
         "codec": getattr(codec, "name", str(codec)),

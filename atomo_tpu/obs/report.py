@@ -23,6 +23,9 @@ artifacts audit each other instead of being trusted independently:
     incident's step).
   * ``membership_column_agrees`` — each step record's membership epoch
     matches the epoch whose span covers that step per membership.json.
+  * ``quality_density_valid`` — the hybrid plan's per-layer density
+    columns in the obs_quality meta lie in [0, 1] and sparse-assigned
+    layers are actually sparse (row-budgeted payload < dense bytes).
 
 A check whose source artifact is absent is SKIPPED (reported, not
 failed): a run without elastic has no membership to agree with.
@@ -252,6 +255,54 @@ def _check_membership_column(steps: list[dict], epochs: list[dict]) -> dict:
     )
 
 
+def _check_quality_density(metas: list[dict]) -> dict:
+    """``quality_density_valid`` — audit the hybrid plan's per-layer
+    columns in the obs_quality meta record (PR-12 satellite): every
+    recorded density lies in [0, 1], and a sparse-ASSIGNED layer is
+    actually sparse — its row-budgeted payload strictly below its dense
+    bytes (otherwise the plan's own crossover rule was violated) with a
+    row budget inside the table. Skipped when no meta carries density
+    columns (non-hybrid runs)."""
+    name = "quality_density_valid"
+    layers = [
+        l
+        for m in metas
+        if m.get("what") == "obs_quality"
+        for l in (m.get("layers") or [])
+        if "density" in l
+    ]
+    if not layers:
+        return _check(
+            name, True, "no per-layer density columns recorded",
+            skipped=True,
+        )
+    bad = []
+    for l in layers:
+        d = l.get("density")
+        if not isinstance(d, (int, float)) or not 0.0 <= float(d) <= 1.0:
+            bad.append(f"{l.get('name')}: density {d!r} outside [0, 1]")
+            continue
+        if l.get("assignment") == "sparse":
+            if not l.get("payload_bytes", 0) < l.get("dense_bytes", 0):
+                bad.append(
+                    f"{l.get('name')}: sparse-assigned but payload "
+                    f"{l.get('payload_bytes')} B >= dense "
+                    f"{l.get('dense_bytes')} B — not actually sparse"
+                )
+            rows = (l.get("shape") or [0])[0]
+            if not 0 < l.get("row_budget", 0) <= rows:
+                bad.append(
+                    f"{l.get('name')}: sparse-assigned with row budget "
+                    f"{l.get('row_budget')!r} outside (0, {rows}]"
+                )
+    return _check(
+        name,
+        not bad,
+        "; ".join(bad[:5])
+        or f"{len(layers)} per-layer density column(s) all valid",
+    )
+
+
 def build_report(train_dir: str) -> dict:
     """Join the run's artifacts into the report document (see module
     docstring). Pure read — writing run_report.json is the caller's move
@@ -331,6 +382,7 @@ def build_report(train_dir: str) -> dict:
         _check_metrics_monotone(steps, incidents),
         _check_retunes(steps, incidents),
         _check_membership_column(steps, epochs),
+        _check_quality_density(metas),
     ]
     consistent = all(c["ok"] for c in checks)
     summary = {

@@ -55,6 +55,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from atomo_tpu.codecs import (
     decode_mean_tree,
     decode_tree,
+    encode_leaf_subset,
     encode_tree,
     encode_tree_streamed,
     payload_nbytes,
@@ -445,6 +446,130 @@ def _ring_stream_mean_layered(
     return jax.tree_util.tree_unflatten(treedef, out), ok_stage
 
 
+def _hybrid_mean(
+    codec,
+    hplan,
+    grads,
+    k_codec,
+    *,
+    axis: str,
+    n_dev: int,
+    my,
+    aggregate: str,
+    ring_bucket_size: int,
+    unfused_decode: bool,
+    track_quality: bool,
+):
+    """Per-layer hybrid exchange (``sparse/hybrid.HybridPlan``): the
+    sparse-assigned leaves move as LOSSLESS (row-index, row-value)
+    payloads — all_gather'd, per-replica scatter-decoded, averaged with
+    the same canonical ``jnp.mean(axis=0)`` the gather path's vmap-decode
+    applies — while the dense-assigned leaves ride the EXISTING
+    compressed gather/ring machinery over their sub-list.
+
+    Bit-exactness, by construction rather than by test alone:
+
+      * The dense-assigned encode is ``encode_leaf_subset`` with GLOBAL
+        leaf-index keys over an ASCENDING index list, so when every leaf
+        is dense-assigned the payloads — and the decode-mean arithmetic
+        over them — are identical to the ``hybrid=None`` program's, and
+        trajectories bit-match (the hybrid-off contract, tested).
+      * The sparse decode is exact (``RowCodec`` scatter-add of exact
+        values; padding adds IEEE-exact zeros), so the per-replica
+        decoded stack equals the raw dense gradients bit for bit and the
+        canonical mean equals the canonical dense exchange's — including
+        duplicate-row collisions, which sum exactly (the lossless
+        contract the per-codec drill pins).
+
+    Fused-trajectory caveat (honest, measured): with sparse leaves
+    assigned under ``aggregate='ring'``, the dense SUB-LIST changes the
+    ring's flat segmentation, XLA fuses the restructured step
+    differently, and full trajectories track the all-dense run to the
+    last-mantissa-bit fusion drift (~1e-8 allclose) — the same measured
+    class as ring-vs-gather and scan-vs-standalone. The bit-exact
+    claims are: the standalone aggregation operator (any mode), full
+    GATHER trajectories, and any all-dense assignment (where the full
+    leaf list keeps the segmentation) — all tested.
+
+    Returns ``(mean_tree, msg_bytes, qm, overflow)`` where ``msg_bytes``
+    is the plan's honest per-replica wire total (sparse rows + dense
+    payloads), ``qm`` is the per-layer quality telemetry
+    (``track_quality``; sparse-assigned layers read exactly 0 error —
+    losslessness observed live, not just asserted in tests), and
+    ``overflow`` is THIS replica's total nonzero rows dropped across the
+    sparse leaves — the rowcodec's "counted, never hidden" contract
+    surfaced to the caller, which psums it into
+    ``metrics["row_overflow"]`` so a live budget violation is a visible
+    nonzero column, not a silently truncated gradient."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if hplan.n_leaves != len(leaves):
+        raise ValueError(
+            f"hybrid plan covers {hplan.n_leaves} leaves but the gradient "
+            f"tree has {len(leaves)} — plan and tree must come from the "
+            "same structure"
+        )
+    d_idxs = list(hplan.dense_idxs)
+    s_idxs = list(hplan.sparse_idxs)
+    d_payloads = encode_leaf_subset(codec, k_codec, leaves, d_idxs)
+    s_payloads = [
+        hplan.row_codec(i).encode(k_codec, leaves[i]) for i in s_idxs
+    ]
+    msg_bytes = sum(payload_nbytes(p) for p in d_payloads) + sum(
+        payload_nbytes(p) for p in s_payloads
+    )
+    overflow = jnp.float32(0.0)
+    for p in s_payloads:
+        overflow = overflow + p.overflow.astype(jnp.float32)
+    out: list = [None] * len(leaves)
+    for i, p in zip(s_idxs, s_payloads):
+        rc = hplan.row_codec(i)
+        g = leaves[i]
+        gathered = jax.lax.all_gather(p, axis)
+        dec = jax.vmap(
+            lambda q, rc=rc, s=tuple(g.shape), dt=g.dtype: rc.decode(
+                q, s, dt
+            )
+        )(gathered)
+        # the gather path's canonical reduction (decode_mean_tree's
+        # vmap_mean) — identical arithmetic, so the sparse mean and the
+        # dense exchange's mean are the same program over the same bits
+        out[i] = jnp.mean(dec, axis=0)
+    if d_idxs:
+        d_grads = [leaves[i] for i in d_idxs]
+        if aggregate == "gather":
+            gathered_d = jax.lax.all_gather(d_payloads, axis)
+            mean_d = decode_mean_tree(
+                codec, gathered_d, d_grads, n_dev,
+                fused=not unfused_decode,
+            )
+        else:  # ring — the dense sub-list rides the standard rotation
+            mean_d, _ = _ring_stream_mean(
+                codec, d_payloads, d_grads,
+                axis=axis, n_dev=n_dev, my=my, n_contrib=n_dev,
+                bucket_size=ring_bucket_size,
+            )
+        for i, m in zip(d_idxs, mean_d):
+            out[i] = m
+    qm = None
+    if track_quality:
+        from atomo_tpu.obs.quality import quality_from_decoded
+
+        decoded: list = [None] * len(leaves)
+        for j, i in enumerate(d_idxs):
+            decoded[i] = codec.decode(
+                d_payloads[j], tuple(leaves[i].shape), leaves[i].dtype
+            )
+        for j, i in enumerate(s_idxs):
+            decoded[i] = hplan.row_codec(i).decode(
+                s_payloads[j], tuple(leaves[i].shape), leaves[i].dtype
+            )
+        qm = quality_from_decoded(decoded, leaves)
+    return (
+        jax.tree_util.tree_unflatten(treedef, out), msg_bytes, qm,
+        overflow,
+    )
+
+
 def _healthy_mean(x, ok, kept_chips, metric_axes):
     """Mean of a per-chip scalar over healthy chips only (guard mode): the
     anomalous replica's loss/precision may be NaN and a plain pmean would
@@ -505,9 +630,22 @@ def make_distributed_train_step(
     track_quality: bool = False,
     survivor_exact: bool = False,
     plan=None,
+    hybrid=None,
     _oracle_parts: bool = False,
 ):
     """Build the jitted SPMD train step over ``mesh``.
+
+    ``hybrid`` (sparse.hybrid.HybridPlan; flat blocking gather/ring with
+    a codec only) arms the per-layer hybrid exchange: sparse-assigned
+    leaves move as lossless (row, value) payloads, dense-assigned leaves
+    keep the existing compressed exchange over their sub-list — see
+    :func:`_hybrid_mean` for the operator and its bit-exactness
+    contracts (all-dense assignments are bit-identical to ``hybrid=
+    None``; ``hybrid=None`` itself is byte-identical program text — the
+    knob-off contract, HLO-tested). The guard/elastic, delayed overlap,
+    stream-encode, num_aggregate and hierarchical/planned schedules are
+    rejected honestly (their masking/carry/bucket machinery is not
+    row-aware yet).
 
     ``track_ok_bits`` (elastic membership mode; requires ``guard``, flat
     aggregation, blocking overlap) adds ``metrics["ok_bits"]`` — the psum
@@ -840,6 +978,46 @@ def make_distributed_train_step(
                 "rejected honestly rather than silently mis-attributed"
             )
 
+    if hybrid is not None:
+        if aggregate == "hierarchical":
+            raise ValueError(
+                "hybrid= (sparse-row per-layer exchange) does not compose "
+                "with aggregate='hierarchical': the boundary re-encode "
+                "composes a second estimator per layer and is not "
+                "row-aware yet — rejected honestly rather than silently "
+                "degraded"
+            )
+        if codec is None or aggregate not in ("gather", "ring"):
+            raise ValueError(
+                "hybrid= (sparse-row per-layer exchange) needs a codec "
+                "with aggregate='gather' or 'ring': a dense psum wire "
+                "degenerates the row exchange (the rows would ride a "
+                "full dense all-reduce), and dense-only training has no "
+                "per-leaf payload path to hybridize"
+            )
+        if overlap == "delayed":
+            raise ValueError(
+                "hybrid= does not compose with overlap='delayed': the "
+                "carried payload's shapes are assignment-specific and "
+                "the consume chain is not row-aware yet"
+            )
+        if stream_encode:
+            raise ValueError(
+                "hybrid= does not compose with stream_encode: the "
+                "layer-bucket encode pipeline is not assignment-aware yet"
+            )
+        if guard is not None:
+            raise ValueError(
+                "hybrid= does not compose with the guard (and therefore "
+                "elastic membership): the row exchange has no "
+                "skip-and-rescale masking yet — run the guard all-dense"
+            )
+        if k_agg:
+            raise ValueError(
+                "hybrid= does not compose with num_aggregate: the "
+                "rotating replica subset is not wired into the row "
+                "exchange"
+            )
     batch_axes = (axis, inner_axis) if hierarchical else axis
     metric_axes = batch_axes
 
@@ -948,6 +1126,7 @@ def make_distributed_train_step(
 
         ok = kept = None  # guard-mode: local health flag / surviving count
         qm = None  # --obs-quality: per-layer estimator-error telemetry
+        sp_overflow = None  # hybrid mode: dropped nonzero rows (budget)
         n_contrib = k_agg or n_dev  # contributions in the average
         dense_bytes = tree_nbytes(grads)
         if codec is None:
@@ -1004,6 +1183,20 @@ def make_distributed_train_step(
                 )
             else:
                 mean_grads = decode_mean_tree(codec, gathered, grads, n_dev)
+        elif hybrid is not None:
+            # per-layer hybrid exchange (sparse/): rows for the sparse-
+            # assigned leaves, the existing compressed gather/ring for
+            # the dense-assigned rest — one honest msg_bytes total. The
+            # guard was rejected at build time, so ok/kept stay None and
+            # the guard-off metrics tail below applies unchanged.
+            with named_phase("hybrid_exchange"):
+                mean_grads, msg_bytes, qm, sp_overflow = _hybrid_mean(
+                    codec, hybrid, grads, k_codec,
+                    axis=axis, n_dev=n_dev, my=my, aggregate=aggregate,
+                    ring_bucket_size=ring_bucket_size,
+                    unfused_decode=unfused_decode,
+                    track_quality=track_quality,
+                )
         else:
             if guard is not None:
                 # screen the RAW gradient before it is encoded: codecs
@@ -1212,6 +1405,13 @@ def make_distributed_train_step(
                     ),
                     metric_axes,
                 )
+        if sp_overflow is not None:
+            # the lossless budget's live audit (rowcodec's "counted,
+            # never hidden"): total nonzero rows dropped across replicas
+            # this step — any nonzero means a truncated gradient shipped
+            metrics["row_overflow"] = jax.lax.psum(
+                sp_overflow, metric_axes
+            )
         if gnorm is not None:
             if guard is None:
                 metrics["grad_norm"] = jax.lax.pmean(gnorm, metric_axes)
@@ -1785,6 +1985,7 @@ def distributed_train_loop(
     elastic=None,
     track_quality: bool = False,
     recorder=None,
+    hybrid=None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -1881,7 +2082,14 @@ def distributed_train_loop(
     (default): zero new device ops, stdout byte-identical.
     ``track_quality`` arms the in-graph per-layer estimator-quality
     probes (see make_distributed_train_step); not supported with
-    --phase-metrics (no fused step to probe)."""
+    --phase-metrics (no fused step to probe).
+
+    ``hybrid`` (sparse.hybrid.HybridPlan) arms the per-layer sparse-row
+    hybrid exchange (see make_distributed_train_step, which owns the
+    conflict matrix); the doctor's densify window runs all-dense (dense
+    psum has no per-leaf payload path — the stream-encode precedent),
+    and the quality meta record gains the plan's per-layer density and
+    assignment columns."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import (
         SUPERVISED_ENV,
@@ -2187,6 +2395,12 @@ def distributed_train_loop(
                 "--grad-accum is not supported with --phase-metrics (the "
                 "phase split assumes one fused compute program)"
             )
+        if hybrid is not None:
+            raise ValueError(
+                "--sparse-rows is not supported with --phase-metrics "
+                "(the phased programs assume one whole-tree codec "
+                "exchange; there is no row-aware phase split)"
+            )
         if num_aggregate:
             warnings.warn(
                 "--phase-metrics uses full aggregation; ignoring --num-aggregate"
@@ -2234,6 +2448,9 @@ def distributed_train_loop(
                 track_quality=False if densify else track_quality,
                 survivor_exact=elastic is not None,
                 plan=plan,
+                # the densify window's dense psum has no per-leaf payload
+                # path: the hybrid plan stands down with the codec
+                hybrid=None if densify else hybrid,
             )
 
         step_fn = build_step()
@@ -2353,9 +2570,12 @@ def distributed_train_loop(
             from atomo_tpu.obs.quality import quality_meta
 
             # the static per-layer kept-byte split, recorded once
-            # (eval_shape — nothing materializes)
+            # (eval_shape — nothing materializes); a hybrid plan adds
+            # its per-layer measured-density and assignment columns
             recorder.write_meta(
-                quality_meta(codec, jax.device_get(state.params))
+                quality_meta(
+                    codec, jax.device_get(state.params), hybrid=hybrid
+                )
             )
     # superstep mode beats the watchdog once per BLOCK: scale the budget
     # by K so a per-step-tuned --health-timeout does not falsely fire

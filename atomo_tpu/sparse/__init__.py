@@ -1,0 +1,25 @@
+"""Sparse gradient exchange: lossless row codec + per-layer hybrid plans.
+
+Three layers (the PR-12 subsystem): the embedding-tower WORKLOAD lives in
+``models/embedding.py`` + ``data/zipf.py``; the CODEC here
+(:mod:`~atomo_tpu.sparse.rowcodec`) moves (row-index, row-value) pairs
+with a static worst-case budget, losslessly; the HYBRID PLAN
+(:mod:`~atomo_tpu.sparse.hybrid`) assigns each leaf sparse-row vs the
+existing dense/compressed exchange from measured density and comm-model
+pricing, and ``make_distributed_train_step(hybrid=...)`` executes it.
+"""
+
+from atomo_tpu.sparse.hybrid import (  # noqa: F401
+    HybridPlan,
+    LeafAssignment,
+    infer_row_bounds,
+    measured_densities,
+    plan_for_model,
+    plan_hybrid,
+    probe_gradient,
+)
+from atomo_tpu.sparse.rowcodec import (  # noqa: F401
+    RowCodec,
+    RowPayload,
+    row_payload_bytes,
+)

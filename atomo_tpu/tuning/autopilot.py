@@ -107,7 +107,8 @@ def winner_knobs(row: dict) -> dict:
     return {
         k: row[k]
         for k in ("aggregate", "overlap", "superstep", "ring_bucket_size",
-                  "plan", "stream_encode", "stream_bucket_bytes")
+                  "plan", "stream_encode", "stream_bucket_bytes",
+                  "sparse_rows")
         if k in row
     }
 
@@ -178,6 +179,8 @@ def tune(
     allow_stream: bool = False,
     stream_bucket_bytes: int = 4 << 20,
     stream_buckets: int = 0,
+    allow_sparse: bool = False,
+    hybrid=None,
     superstep_options=(1, 8),
     bucket_options=(65536,),
     dcn_ways: int = 0,
@@ -206,6 +209,13 @@ def tune(
     refuse. Flat candidates are then priced at the OUTER tier's bandwidth
     (the slowest link on their gradient path). The chosen plan lands in
     the decision artifact's winner knobs.
+
+    ``allow_sparse`` + ``hybrid`` (a sparse.hybrid.HybridPlan with at
+    least one sparse-assigned leaf) add a ``+sp`` variant of every plain
+    blocking gather/ring candidate, priced from the plan's per-leaf wire
+    bytes (``comm_model.leaf_budget_totals`` — the same sums the
+    executed program reports) and probed through the SAME step builder
+    with the plan attached.
     """
     import jax
 
@@ -267,6 +277,10 @@ def tune(
         allow_stream=allow_stream,
         stream_bucket_bytes=stream_bucket_bytes,
         stream_buckets=stream_buckets,
+        allow_sparse=bool(allow_sparse and hybrid is not None),
+        sparse_leaf_budgets=(
+            hybrid.leaf_budgets() if hybrid is not None else None
+        ),
         superstep_options=superstep_options,
         bucket_options=bucket_options,
         dcn_ways=int(dcn_ways) if two_tier else 0,
@@ -281,6 +295,11 @@ def tune(
         tax_s=codec_tax_s,
         dispatch_s=dispatch_s,
         fabric2=fabric2,
+        # prices the +sp candidates from the plan's per-leaf pairs —
+        # held ONCE here rather than copied into every candidate row
+        sparse_leaf_budgets=(
+            hybrid.leaf_budgets() if hybrid is not None else None
+        ),
     )
     pb = probe_batch_size(batch, n_dev)
     meta = {
@@ -319,7 +338,8 @@ def tune(
             for k, v in cand.items()
             if k in ("aggregate", "overlap", "superstep",
                      "ring_bucket_size", "plan", "name",
-                     "stream_encode", "stream_bucket_bytes")
+                     "stream_encode", "stream_bucket_bytes",
+                     "sparse_rows")
         }
         try:
             row = probe_candidate(
@@ -344,6 +364,7 @@ def tune(
                 # tiers): probe at the value the run will execute with,
                 # not the builder default
                 ring_bucket_size=ring_bucket_size,
+                hybrid=hybrid,
             )
         except Exception as exc:  # noqa: BLE001 — one candidate failing
             # to compile/execute (OOM, a backend quirk) must not abort the

@@ -93,20 +93,21 @@ def model_init_fn(model, sample):
     return init
 
 
-def byte_budget(codec, init_fn) -> tuple[int, int]:
-    """(dense_bytes, payload_bytes) of one gradient exchange, at zero cost
-    via jax.eval_shape (static shapes make the payload size a trace-time
-    constant). ``codec=None`` (dense training) reports payload 0. The one
-    implementation behind the CLI's ``--aggregate auto`` resolution and
-    the autopilot's prediction context; build ``init_fn`` with
-    :func:`model_init_fn`."""
+def leaf_byte_budgets(codec, init_fn) -> list:
+    """Per-leaf ``(dense_bytes, payload_bytes)`` pairs in canonical
+    flatten order, at zero cost via jax.eval_shape — the per-leaf form of
+    the byte budget (PR-12): :func:`byte_budget` is now its sum through
+    ``comm_model.leaf_budget_totals``, so the whole-tree scalars and any
+    per-leaf consumer (the hybrid planner's pricing, the +sp autopilot
+    candidates) read the SAME accounting. ``codec=None`` (dense
+    training) reports payload 0 per leaf."""
     import jax
 
-    from atomo_tpu.codecs import encode_tree, tree_nbytes
+    from atomo_tpu.codecs import encode_tree, payload_nbytes, tree_nbytes
 
     if codec is None:
-        params_s = jax.eval_shape(init_fn)
-        return tree_nbytes(params_s), 0
+        leaves = jax.tree_util.tree_leaves(jax.eval_shape(init_fn))
+        return [(tree_nbytes([l]), 0) for l in leaves]
 
     def shapes():
         params = init_fn()
@@ -114,7 +115,25 @@ def byte_budget(codec, init_fn) -> tuple[int, int]:
         return params, payload
 
     grads_s, payload_s = jax.eval_shape(shapes)
-    return tree_nbytes(grads_s), tree_nbytes(payload_s)
+    g_leaves, treedef = jax.tree_util.tree_flatten(grads_s)
+    p_leaves = treedef.flatten_up_to(payload_s)
+    return [
+        (tree_nbytes([g]), payload_nbytes(p))
+        for g, p in zip(g_leaves, p_leaves)
+    ]
+
+
+def byte_budget(codec, init_fn) -> tuple[int, int]:
+    """(dense_bytes, payload_bytes) of one gradient exchange — the sum of
+    :func:`leaf_byte_budgets` through the one honest accounting function
+    (``comm_model.leaf_budget_totals``). Report shape unchanged: the one
+    implementation behind the CLI's ``--aggregate auto`` resolution and
+    the autopilot's prediction context; build ``init_fn`` with
+    :func:`model_init_fn`."""
+    from atomo_tpu.utils.comm_model import leaf_budget_totals
+
+    d, p = leaf_budget_totals(leaf_byte_budgets(codec, init_fn))
+    return int(d), int(p)
 
 
 def fenced_seconds_per_call(
@@ -175,6 +194,7 @@ def probe_candidate(
     compute_dtype=None,
     ring_bucket_size: int = 65536,
     dcn_ways: int = 0,
+    hybrid=None,
 ) -> dict:
     """Measure one candidate knob vector: build the REAL step program the
     train path would run (same builders, same knobs — zero1 / grad_accum
@@ -188,7 +208,15 @@ def probe_candidate(
     knob) probe on the two-tier mesh ``(dp=dcn_ways, ici=n_dev/dcn_ways)``
     through the same builder the train path uses (inner_axis='ici',
     topology plan attached) — the probes `--auto tune` was missing on
-    ``--dcn-ways`` meshes."""
+    ``--dcn-ways`` meshes.
+
+    ``hybrid`` (sparse.hybrid.HybridPlan) is attached to the built step
+    only for ``+sp`` candidates (``cand["sparse_rows"] == "on"``) — the
+    probe then times the REAL per-layer hybrid exchange the train path
+    would dispatch. The probe batch stays the synthetic float batch;
+    row-id workloads read it as low row ids, which under-exercises the
+    power-law tail but prices the program structure honestly (the
+    lossless budget is static, so the timing is shape-faithful)."""
     import jax
     import jax.numpy as jnp
 
@@ -276,6 +304,7 @@ def probe_candidate(
                 cand.get("stream_bucket_bytes", 4 << 20)
             ),
             inner_axis=inner_axis, plan=plan,
+            hybrid=hybrid if cand.get("sparse_rows") == "on" else None,
         )
         if overlap == "delayed":
             state = init_delayed_state(mesh, state, codec)

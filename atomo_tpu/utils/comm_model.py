@@ -197,6 +197,22 @@ def choose_aggregate(
     return "gather", reason
 
 
+def leaf_budget_totals(leaf_budgets) -> tuple[float, float]:
+    """Sum per-leaf ``(dense_bytes, payload_bytes)`` pairs into the
+    ``(dense, payload)`` totals every wire formula consumes — THE one
+    honest accounting function (PR-12 refactor): the single-codec paths
+    route their whole-tree scalars through it as a one-leaf list, and
+    the hybrid candidates sum the same per-leaf pairs the executed
+    program reports (``sparse.hybrid.HybridPlan.leaf_budgets``), so
+    prediction and execution can never disagree about what a byte is."""
+    d = 0.0
+    p = 0.0
+    for pair in leaf_budgets:
+        d += float(pair[0])
+        p += float(pair[1])
+    return d, p
+
+
 def ring_allreduce_wire_bytes(dense_bytes: float, ways: int) -> float:
     """Per-chip one-direction wire traffic of a ring all-reduce."""
     return 2.0 * dense_bytes * (ways - 1) / ways
@@ -434,6 +450,8 @@ def candidate_name(cand: dict) -> str:
         bits.append(cand.get("overlap", "off"))
     if cand.get("stream_encode") == "on":
         bits.append("se")  # backward-interleaved layer-streamed encode
+    if cand.get("sparse_rows") == "on":
+        bits.append("sp")  # per-layer sparse-row hybrid exchange
     bits.append(f"k{cand.get('superstep', 1)}")
     if cand.get("aggregate") == "ring":
         bits.append(f"b{cand.get('ring_bucket_size', 65536)}")
@@ -450,6 +468,8 @@ def enumerate_candidates(
     allow_stream: bool = False,
     stream_bucket_bytes: int = 4 << 20,
     stream_buckets: int = 0,
+    allow_sparse: bool = False,
+    sparse_leaf_budgets=None,
     superstep_options=(1, 8),
     bucket_options=(65536,),
     dcn_ways: int = 0,
@@ -476,7 +496,17 @@ def enumerate_candidates(
     The knob is trajectory-neutral (bit-identical payloads for any
     bucket plan), so stream candidates are pure schedule points;
     ``stream_bucket_bytes`` rides along so prediction and probe price
-    the plan the run would execute."""
+    the plan the run would execute.
+
+    ``allow_sparse`` emits a ``--sparse-rows on`` variant (suffix
+    ``+sp``) of every plain blocking gather/ring candidate, carrying the
+    hybrid plan's per-leaf ``leaf_budgets`` so :func:`predict_step_s`
+    prices the candidate's wire from the SAME per-leaf sums the executed
+    program reports (honest pricing, not a separate estimate). Unlike
+    the +se variants, sparse candidates change the trajectory only on
+    lossy-codec tables (the row path is lossless), and compose with
+    neither delayed overlap nor stream-encode (the in-run conflict
+    matrix), so only the plain blocking points gain variants."""
     ks = sorted({max(int(k), 1) for k in superstep_options})
     out: list[dict] = []
     if ways <= 1:
@@ -526,6 +556,19 @@ def enumerate_candidates(
                                         stream_buckets
                                     )
                             out.append(c)
+                            if (
+                                allow_sparse
+                                and sparse_leaf_budgets
+                                and agg in ("gather", "ring")
+                                and ov == "off"
+                                and sb is None
+                            ):
+                                # the flag alone — the per-leaf budgets
+                                # live ONCE at the ranking call
+                                # (rank_candidates' sparse_leaf_budgets),
+                                # not duplicated into every candidate
+                                # row of the decision artifact
+                                out.append({**c, "sparse_rows": "on"})
     if (
         has_codec
         and ways > 1
@@ -561,8 +604,22 @@ def predict_step_s(
     tax_s: float | None = None,
     dispatch_s: float = 0.0,
     fabric2=None,
+    leaf_budgets=None,
+    sparse_leaf_budgets=None,
 ) -> float:
     """Model one candidate's synchronous step time (seconds).
+
+    BYTE ACCOUNTING IS PER LEAF (PR-12 refactor): the whole-tree
+    ``dense_bytes``/``payload_bytes`` scalars, an explicit
+    ``leaf_budgets`` list of per-leaf pairs, a candidate's own
+    ``cand["leaf_budgets"]`` override, and — for ``+sp`` hybrid
+    candidates (``sparse_rows == "on"``) — the hybrid plan's
+    ``sparse_leaf_budgets`` all flow through ONE summing function,
+    :func:`leaf_budget_totals`, before any wire formula runs, so the
+    single-codec paths and the hybrid candidates share one honest
+    accounting and the report shapes stay exactly as before. A sparse
+    candidate still pays the full codec tax (the dense-assigned share
+    dominates it; stated conservative, the probe ladder corrects).
 
     step = compute + encode + comm_chain + dispatch/K, where the comm
     chain is the candidate's wire bytes over ``fabric_bw`` plus the
@@ -584,7 +641,14 @@ def predict_step_s(
     :class:`~atomo_tpu.topology.fabric.TwoTierFabric`); on a two-tier
     mesh the flat candidates' ``fabric_bw`` should be the OUTER tier's
     bandwidth — the slowest link on their gradient path."""
-    dense_bytes = float(dense_bytes)
+    lb = cand.get("leaf_budgets")
+    if lb is None and cand.get("sparse_rows") == "on":
+        lb = sparse_leaf_budgets
+    if lb is None:
+        lb = leaf_budgets
+    if lb is None:
+        lb = [(dense_bytes, payload_bytes)]
+    dense_bytes, payload_bytes = leaf_budget_totals(lb)
     if compute_s is None:
         compute_s = estimate_compute_s(dense_bytes)
     ways = int(ways)
@@ -660,11 +724,14 @@ def rank_candidates(
     tax_s: float | None = None,
     dispatch_s: float = 0.0,
     fabric2=None,
+    sparse_leaf_budgets=None,
 ) -> list[dict]:
     """Candidates + their predicted ms/step, best first (ties broken by
     name so the order — and therefore which candidates get probed — is
     deterministic for a given context). ``fabric2`` prices any
-    hierarchical candidates per tier (see :func:`predict_step_s`)."""
+    hierarchical candidates per tier; ``sparse_leaf_budgets`` prices any
+    ``+sp`` candidates from the hybrid plan's per-leaf pairs (see
+    :func:`predict_step_s`)."""
     rows = []
     for c in cands:
         s = predict_step_s(
@@ -677,6 +744,7 @@ def rank_candidates(
             tax_s=tax_s,
             dispatch_s=dispatch_s,
             fabric2=fabric2,
+            sparse_leaf_budgets=sparse_leaf_budgets,
         )
         rows.append({**c, "predicted_ms_per_step": round(s * 1e3, 4)})
     rows.sort(key=lambda r: (r["predicted_ms_per_step"], r["name"]))

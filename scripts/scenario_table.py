@@ -111,6 +111,88 @@ def model_only_recs(ways: int, dcn_ways: int = 2,
     return recs
 
 
+def sparse_recs(ways: int) -> dict:
+    """``--sparse``: the embedding x zipf scenario rows — the flat codec
+    recommendations PLUS the per-layer hybrid sparse-row candidate
+    (``+sp``), priced from the real hybrid plan's per-leaf wire bytes
+    (comm_model.leaf_budget_totals — the sums the executed program
+    reports, bench config 13's wire-match gate). Opt-in so the published
+    historical table is stable by default; model-only ordering with the
+    same stated anchors as the flat rows — bench config 13 carries the
+    measured evidence."""
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import DenseCodec, QsgdCodec
+    from atomo_tpu.data.zipf import zipf_dataset
+    from atomo_tpu.models import EmbeddingTower
+    from atomo_tpu.sparse import plan_for_model
+    from atomo_tpu.tuning.probe import byte_budget, model_init_fn
+    from atomo_tpu.utils.comm_model import (
+        FABRICS,
+        enumerate_candidates,
+        estimate_codec_tax_s,
+        estimate_compute_s,
+        rank_candidates,
+        recommend_for_scenario,
+    )
+
+    model = EmbeddingTower(num_classes=10)
+    batch = 32
+    ds = zipf_dataset(True, size=batch, seed=0)
+    init_fn = model_init_fn(model, jnp.zeros((1, 8), jnp.float32))
+    budgets = {
+        "dense": byte_budget(None, init_fn),
+        "qsgd8": byte_budget(QsgdCodec(bits=8, bucket_size=512), init_fn),
+    }
+    dense_b = budgets["dense"][0]
+    compute_ms = estimate_compute_s(dense_b) * 1e3
+    tax_ms = estimate_codec_tax_s(dense_b) * 1e3
+    measured = {"dense": compute_ms, "qsgd8": compute_ms + tax_ms}
+    # the hybrid plan: rows for the table, uncompressed DenseCodec
+    # payloads for the tower (no codec tax — stated)
+    plan = plan_for_model(
+        DenseCodec(), model, ds.images, ds.labels,
+        batch_per_chip=max(batch // ways, 1), slots=8,
+    )
+    out = {}
+    for label, bw in sorted(FABRICS.items()):
+        rec = recommend_for_scenario(
+            codec_budgets=budgets, measured_ms=measured, ways=ways,
+            fabric_bw=bw,
+        )
+        sp = [
+            c for c in enumerate_candidates(
+                has_codec=True, ways=ways, allow_overlap=False,
+                allow_sparse=True,
+                sparse_leaf_budgets=plan.leaf_budgets(),
+            )
+            if c.get("sparse_rows") == "on"
+        ] if plan.any_sparse else []
+        if sp:  # ways <= 1 enumerates no exchange candidates at all
+            top = rank_candidates(
+                sp, dense_bytes=dense_b,
+                payload_bytes=plan.payload_bytes(), ways=ways,
+                fabric_bw=bw, compute_s=compute_ms / 1e3, tax_s=0.0,
+                # the per-leaf pairs the executed program sums — the
+                # one-honest-accounting invariant, not the scalar
+                # fallback that merely coincides with it today
+                sparse_leaf_budgets=plan.leaf_budgets(),
+            )[0]
+            rec["ranked"].append({
+                "code": "hybrid_rows",
+                "candidate": top["name"],
+                "predicted_ms_per_step": top["predicted_ms_per_step"],
+                "measured_1chip_ms": None,
+                "codec_tax_ms": 0.0,
+            })
+            rec["ranked"].sort(
+                key=lambda r: (r["predicted_ms_per_step"], r["code"])
+            )
+            rec["winner"] = rec["ranked"][0]
+        out[label] = rec
+    return {"embedding(zipf)": out}
+
+
 def render(recs: dict, ways: int, source: str) -> str:
     lines = [
         f"| scenario | fabric | recommended config | predicted ms/step "
@@ -157,6 +239,13 @@ def main() -> int:
                          "default so the published table's historical "
                          "candidate space is stable; bench config 12 "
                          "carries the measured streamed-encode evidence")
+    ap.add_argument("--sparse", action="store_true", default=False,
+                    help="add the embedding x zipf scenario with the "
+                         "per-layer hybrid sparse-row (+sp) candidate, "
+                         "priced from the real plan's per-leaf wire "
+                         "bytes. Off by default so the published table's "
+                         "historical rows are stable; bench config 13 "
+                         "carries the measured sparse evidence")
     ap.add_argument("--from-bench", type=str, default="",
                     help="read recommendations from a bench "
                          "scenario_matrix row / artifact instead of the "
@@ -180,8 +269,11 @@ def main() -> int:
         print(render(row["recommendations"], ways,
                      f"measured anchors, {args.from_bench}"))
         return 0
-    print(render(model_only_recs(args.ways, dcn_ways=args.dcn_ways,
-                                 allow_stream=args.stream),
+    recs = model_only_recs(args.ways, dcn_ways=args.dcn_ways,
+                           allow_stream=args.stream)
+    if args.sparse:
+        recs.update(sparse_recs(args.ways))
+    print(render(recs,
                  args.ways,
                  "model-only anchors, artifacts/BENCH_ONCHIP_r3.md; "
                  "2-tier rows: topology planner over the same anchors + "
