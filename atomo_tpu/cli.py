@@ -201,10 +201,18 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                         "into a single unpadded bucket. Any value produces "
                         "bit-identical results (layout only; tested)")
     t.add_argument("--fabric", type=str, default="auto", metavar="F",
-                   help="fabric for --aggregate auto's ADVISORY (the mode "
-                        "itself is decided by wire bytes + host topology): "
-                        "auto (ici single-host, dcn multi-host) | ici | "
-                        "dcn | eth10g | a per-chip GB/s number")
+                   help="fabric every prediction is priced from "
+                        "(--aggregate auto's advisory, the autopilot, the "
+                        "topology planner): auto (ici single-host, dcn "
+                        "multi-host) | ici | dcn | eth10g | a per-chip "
+                        "GB/s number | <inner>:<outer> (two-tier) | "
+                        "measured — a startup probe times fenced "
+                        "ppermute/all_gather ladders per tier on the real "
+                        "mesh, records train_dir/fabric_probe.json, and "
+                        "every prediction prices from it. PRICING ONLY: "
+                        "the resolved knobs being equal, measured trains "
+                        "bit-identical to any pinned fabric (bench "
+                        "config 14's parity gate)")
     t.add_argument("--codec-tax-ms", type=float, default=None, metavar="MS",
                    help="measured single-chip codec tax for --aggregate "
                         "auto's advisory; default scales the measured "
@@ -615,7 +623,8 @@ def _resolve_auto_aggregate(
         k = dcn_ways or max(n_proc, 2)
         try:
             fabric2 = resolve_two_tier(
-                args.fabric, dcn_ways=k, n_dev=n_dev, n_proc=n_proc
+                args.fabric, dcn_ways=k, n_dev=n_dev, n_proc=n_proc,
+                measured=getattr(args, "_fabric_probe", None),
             )
         except ValueError as exc:
             raise SystemExit(str(exc)) from None
@@ -650,7 +659,10 @@ def _resolve_auto_aggregate(
         )
         return "hierarchical"
     try:
-        bw = resolve_fabric(args.fabric, n_proc=n_proc)
+        bw = resolve_fabric(
+            args.fabric, n_proc=n_proc,
+            measured=getattr(args, "_fabric_probe", None),
+        )
     except ValueError as exc:
         raise SystemExit(str(exc)) from None
     mode, reason = choose_aggregate(
@@ -698,6 +710,12 @@ def _membership_exit(exc: Exception) -> int:
     return MEMBERSHIP_EXIT_CODE
 
 
+# the one pointer every --phase-metrics conflict reject carries (shared
+# with both train loops and the doctor's matrix via utils.tracing, so
+# the surfaces cannot drift)
+from atomo_tpu.utils.tracing import PHASE_METRICS_HINT as _TIMELINE_HINT
+
+
 def _argv_preflight(args: argparse.Namespace) -> None:
     """Deterministic config conflicts knowable from argv alone, checked
     BEFORE the supervisor re-exec (and before the jax backend initializes
@@ -743,12 +761,26 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "--auto tune cannot compose with --phase-metrics (the "
                 "phased observability mode forces superstep 1 + gather — "
                 "there is nothing left to tune); drop one"
+                + _TIMELINE_HINT
             )
         if not args.train_dir:
             raise SystemExit(
                 "--auto tune needs a --train-dir: the decision artifact "
                 "(tune_decision.json) and the online re-tuner's incident "
                 "log live there"
+            )
+    if getattr(args, "fabric", "auto") == "measured":
+        # argv-knowable half of the measured-fabric contract; the
+        # resolved device count is re-checked in cmd_train
+        if not args.train_dir:
+            raise SystemExit(
+                "--fabric measured records the startup probe in "
+                "train_dir/fabric_probe.json and needs a --train-dir"
+            )
+        if args.n_devices == 1:
+            raise SystemExit(
+                "--fabric measured needs a multi-device mesh: a single "
+                "device has no inter-chip fabric to measure"
             )
     plan_flag = getattr(args, "plan", "auto")
     if plan_flag not in ("auto", "legacy"):
@@ -798,6 +830,7 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             raise SystemExit(
                 "--phase-metrics times blocking phase programs and cannot "
                 "describe the overlapped step; drop one of the flags"
+                + _TIMELINE_HINT
             )
         if args.zero1 and args.max_restarts > 0 and args.train_dir:
             raise SystemExit(
@@ -841,6 +874,7 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "--phase-metrics times a monolithic encode phase program "
                 "and cannot describe the bucket-streamed schedule; drop "
                 "one of the flags"
+                + _TIMELINE_HINT
             )
     if getattr(args, "sparse_rows", "off") != "off":
         if args.n_devices == 1 and args.sparse_rows == "on":
@@ -882,6 +916,7 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "--sparse-rows is not supported with --phase-metrics "
                 "(the phased programs assume one whole-tree codec "
                 "exchange; there is no row-aware phase split)"
+                + _TIMELINE_HINT
             )
         if (
             args.grad_guard or args.max_grad_norm > 0
@@ -925,6 +960,7 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             raise SystemExit(
                 "--obs-quality probes the fused step's encode in-graph; "
                 "--phase-metrics has no fused step — drop one"
+                + _TIMELINE_HINT
             )
         if args.overlap == "delayed":
             raise SystemExit(
@@ -1049,6 +1085,7 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             raise SystemExit(
                 "--elastic needs the fused step's ok_bits metric; "
                 "--phase-metrics has no membership wiring — drop one"
+                + _TIMELINE_HINT
             )
         if args.elastic_patience < 1:
             raise SystemExit(
@@ -1321,6 +1358,11 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             # execute with (bit-identical layout knob, but the measured
             # ms/step must describe the dispatched packing)
             ring_bucket_size=args.ring_bucket_size,
+            # --fabric measured: the startup probe's document — every
+            # candidate priced from the measured mesh, and the decision
+            # meta records the per-tier GB/s for the report's
+            # cross-artifact check
+            fabric_probe=getattr(args, "_fabric_probe", None),
             context={
                 "network": args.network, "dataset": args.dataset,
                 "code": args.code, "seed": args.seed,
@@ -1390,7 +1432,115 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             )
             return row["measured_ms_per_step"]
 
-    return superstep, OnlineRetuner(probe_fn=probe_fn)
+    # drift blame (the fabric observatory): armed when this run measured
+    # its fabric at startup — the startup probe is the baseline, the
+    # cheap re-probe runs at the alarm, and a fabric verdict re-writes
+    # fabric_probe.json so later pricing (and a resume) reads the fabric
+    # that exists NOW, not the one that existed at launch
+    fabric_kw = {}
+    probe_doc = getattr(args, "_fabric_probe", None)
+    if probe_doc is not None and n_dev > 1:
+        from atomo_tpu.obs.fabric import (
+            measured_bandwidths,
+            quick_probe,
+            write_fabric_probe,
+        )
+
+        probe_k = int((probe_doc.get("meta") or {}).get("dcn_ways") or 0)
+
+        def fabric_probe_fn(_n=n_dev, _k=probe_k):
+            return quick_probe(n_dev=_n, dcn_ways=_k)
+
+        def on_fabric_moved(doc, _dir=args.train_dir):
+            path = write_fabric_probe(_dir, doc)
+            print(
+                f"Autopilot: fabric moved — {path} re-written from the "
+                "re-probe (meta.reps says it was the quick ladder)",
+                flush=True,
+            )
+
+        fabric_kw = dict(
+            fabric_probe_fn=fabric_probe_fn,
+            fabric_baseline=measured_bandwidths(probe_doc),
+            on_fabric_moved=on_fabric_moved,
+        )
+    return superstep, OnlineRetuner(probe_fn=probe_fn, **fabric_kw)
+
+
+def _recorder_tier_ms(args, n_dev, model, train_iter, codec):
+    """{tier label: predicted comm ms} for the flight recorder's
+    per-tier calibration column (obs.fabric.predicted_tier_ms): the
+    autopilot winner's predicted step decomposed over the fabric tiers
+    its exchange crosses — one tier for the flat aggregates, both for a
+    hierarchical winner. Returns None when the context cannot be priced
+    (single device, unresolved aggregate) — the column is then absent,
+    never invented."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.obs.fabric import (
+        measured_bandwidths,
+        predicted_tier_ms,
+    )
+    from atomo_tpu.tuning.probe import byte_budget, model_init_fn
+    from atomo_tpu.utils.comm_model import FABRICS, resolve_fabric
+
+    if n_dev <= 1:
+        return None
+    agg = args.aggregate
+    if agg not in ("gather", "ring", "psum", "hierarchical"):
+        return None
+    probe_doc = getattr(args, "_fabric_probe", None)
+    sample = jnp.zeros(
+        (1,) + tuple(train_iter.images.shape[1:]), jnp.float32
+    )
+    dense_b, payload_b = byte_budget(codec, model_init_fn(model, sample))
+    n_proc = jax.process_count()
+    if agg == "hierarchical":
+        from atomo_tpu.topology.fabric import resolve_two_tier
+
+        k = args.dcn_ways or max(n_proc, 2)
+        if not (1 < k <= n_dev) or n_dev % k:
+            return None
+        fabric2 = resolve_two_tier(
+            args.fabric, dcn_ways=k, n_dev=n_dev, n_proc=n_proc,
+            measured=probe_doc,
+        )
+        plan_name = (
+            getattr(args, "_tuned_plan", None)
+            or (args.plan if args.plan != "auto" else None)
+            or getattr(args, "_auto_plan", None)
+            or "legacy"
+        )
+        return predicted_tier_ms(
+            aggregate=agg, dense_bytes=dense_b, payload_bytes=payload_b,
+            ways=n_dev, fabric2=fabric2, plan_name=plan_name,
+        )
+    fabric_tok = args.fabric
+    try:
+        bw = resolve_fabric(fabric_tok, n_proc=n_proc, measured=probe_doc)
+    except ValueError:
+        # a two-tier <inner>:<outer> string with a FLAT winner: the flat
+        # exchange crosses the slow tier end to end, so price at the
+        # OUTER token — the same fallback tune() applied when it priced
+        # this very winner (the column must mirror the pricing path)
+        if ":" not in fabric_tok:
+            raise
+        fabric_tok = fabric_tok.rpartition(":")[2]
+        bw = resolve_fabric(fabric_tok, n_proc=n_proc, measured=probe_doc)
+    if fabric_tok == "measured" and probe_doc is not None:
+        bws = measured_bandwidths(probe_doc)
+        label = "measured_" + min(bws, key=bws.get)
+    elif fabric_tok == "auto":
+        label = "dcn" if n_proc > 1 else "ici"
+    elif fabric_tok in FABRICS:
+        label = fabric_tok
+    else:
+        label = "fabric"
+    return predicted_tier_ms(
+        aggregate=agg, dense_bytes=dense_b, payload_bytes=payload_b,
+        ways=n_dev, fabric_bw=bw, fabric_label=label,
+    )
 
 
 def cmd_train(args: argparse.Namespace) -> int:
@@ -1443,6 +1593,16 @@ def cmd_train(args: argparse.Namespace) -> int:
             )
 
     _warn_dead_flags(args)
+    if args.phase_metrics:
+        warnings.warn(
+            "--phase-metrics is DEPRECATED: it times the four phases as "
+            "separate blocking programs, so it cannot observe any fused "
+            "program we ship (superstep, stream-encode, sparse-rows, "
+            "tune, delayed, elastic, hierarchical are all rejected). "
+            "The replacement is trace-based: run with --profile-dir and "
+            "use `report timeline` to get per-step "
+            "encode/exchange/decode/compute spans of the REAL fused step"
+        )
     if args.bf16:
         # measured on v5e (artifacts/BENCH_ONCHIP_r3.md): bf16 ran the
         # CIFAR CNN ladder SLOWER than f32 (7.78-7.91 vs 6.50 ms/step on
@@ -1503,6 +1663,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         warnings.warn(
             "--phase-metrics times individual phase programs and cannot "
             "run under a fused superstep scan; forcing --superstep 1"
+            + _TIMELINE_HINT
         )
         superstep = 1
     n_dev = args.n_devices or len(jax.devices())
@@ -1520,6 +1681,33 @@ def cmd_train(args: argparse.Namespace) -> int:
                 f"run resolved to a {n_dev}-device mesh (replicas are "
                 "0-based); the fault would never fire"
             )
+    if args.fabric == "measured":
+        # the startup fabric probe (obs.fabric): measure per-tier
+        # bandwidth/latency on the real mesh BEFORE anything prices a
+        # prediction from the fabric (the hybrid planner's crossover,
+        # --aggregate auto, the autopilot ladder). The probe draws its
+        # buffers from jnp constants — never the data iterator or the
+        # init seed — so the trajectory is bit-identical to a pinned
+        # fabric with the same resolved knobs (the PR-6 probe-isolation
+        # precedent, drilled by bench config 14).
+        from atomo_tpu.obs.fabric import ensure_fabric_probe
+
+        if n_dev <= 1:
+            # the argv-ambiguous half (--n-devices 0 on a 1-device host)
+            raise SystemExit(
+                "--fabric measured needs a multi-device mesh: this host "
+                "resolved to 1 device, so there is no inter-chip fabric "
+                "to measure"
+            )
+        try:
+            args._fabric_probe = ensure_fabric_probe(
+                args.train_dir,
+                n_dev=n_dev,
+                dcn_ways=getattr(args, "dcn_ways", 0),
+                reuse=args.resume,
+            )
+        except ValueError as exc:
+            raise SystemExit(str(exc)) from None
     sparse_plan = None
     if args.sparse_rows != "off":
         if n_dev <= 1:
@@ -1675,13 +1863,28 @@ def cmd_train(args: argparse.Namespace) -> int:
         # decision_reusable-vetted resume): a stale decision file left in
         # the dir by some earlier differently-configured run must not
         # fabricate a calibration series for a program it never priced
+        pred_ms = (
+            resolve_predicted_ms(args.train_dir)
+            if args.auto == "tune"
+            else None
+        )
+        tier_ms = None
+        if pred_ms is not None:
+            # the per-tier calibration column's reference: the winner's
+            # predicted comm decomposed over the fabric tiers it crosses.
+            # Best-effort observability — an unpriceable context drops
+            # the column, never the run
+            try:
+                tier_ms = _recorder_tier_ms(args, n_dev, model, train_iter,
+                                            codec)
+            except Exception as exc:  # noqa: BLE001
+                warnings.warn(
+                    f"per-tier calibration column disabled ({exc})"
+                )
         recorder = FlightRecorder.for_train_dir(
             args.train_dir,
-            predicted_ms=(
-                resolve_predicted_ms(args.train_dir)
-                if args.auto == "tune"
-                else None
-            ),
+            predicted_ms=pred_ms,
+            predicted_tier_ms=tier_ms,
         )
     if n_dev > 1:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
@@ -1698,7 +1901,10 @@ def cmd_train(args: argparse.Namespace) -> int:
             )
 
             try:
-                bw = resolve_fabric(args.fabric, n_proc=jax.process_count())
+                bw = resolve_fabric(
+                    args.fabric, n_proc=jax.process_count(),
+                    measured=getattr(args, "_fabric_probe", None),
+                )
             except ValueError as exc:
                 raise SystemExit(str(exc)) from None
             mode, reason = choose_aggregate(
@@ -2324,19 +2530,64 @@ def cmd_evaluate(args: argparse.Namespace) -> int:
 
 def cmd_report(args: argparse.Namespace) -> int:
     """``report``: join the run's artifacts — metrics.jsonl (flight
-    recorder) + incidents.jsonl + membership.json + tune_decision.json —
-    into one time-ordered run_report.json with cross-artifact
-    consistency checks, and print the human post-mortem. "What happened
-    to this run" as one command. Pure host-side file reads: no jax, no
-    devices, safe on a box that cannot reach the accelerator."""
+    recorder) + incidents.jsonl + membership.json + tune_decision.json +
+    fabric_probe.json — into one time-ordered run_report.json with
+    cross-artifact consistency checks, and print the human post-mortem.
+    "What happened to this run" as one command.
+
+    ``report timeline``: the trace-based phase timeline — parse the
+    newest ``--profile-dir`` trace into per-step encode/exchange/decode/
+    compute spans (the ``named_phase`` scopes inside the fused step),
+    join them against metrics.jsonl, and write
+    ``train_dir/timeline_report.json``. This is the replacement the
+    deprecated ``--phase-metrics`` mode points at: it observes the REAL
+    fused/superstep/stream-encode/hybrid programs.
+
+    Both verbs are pure host-side file reads: no jax, no devices, safe
+    on a box that cannot reach the accelerator."""
     import os
+
+    from atomo_tpu.utils.tracing import write_json_atomic
+
+    if getattr(args, "what", "run") == "timeline":
+        from atomo_tpu.obs.timeline import (
+            TIMELINE_REPORT_NAME,
+            build_timeline,
+            summarize_timeline,
+        )
+
+        prof = args.profile_dir
+        if not prof and args.train_dir:
+            # convention fallback: a trace captured into the train dir
+            prof = os.path.join(args.train_dir, "trace")
+        if not prof or not os.path.isdir(prof):
+            raise SystemExit(
+                f"report timeline: profile dir {prof!r} does not exist — "
+                "run training with --profile-dir DIR to capture a trace, "
+                "then report timeline --profile-dir DIR"
+            )
+        train_dir = (
+            args.train_dir
+            if args.train_dir and os.path.isdir(args.train_dir)
+            else None
+        )
+        doc = build_timeline(prof, train_dir)
+        if train_dir:
+            out = os.path.join(train_dir, TIMELINE_REPORT_NAME)
+            write_json_atomic(out, doc)
+            print(summarize_timeline(doc), flush=True)
+            print(f"timeline report -> {out}", flush=True)
+        else:
+            print(summarize_timeline(doc), flush=True)
+        if args.strict and not doc["consistent"]:
+            return 3
+        return 0
 
     from atomo_tpu.obs.report import (
         build_report,
         report_path,
         summarize_report,
     )
-    from atomo_tpu.utils.tracing import write_json_atomic
 
     if not args.train_dir or not os.path.isdir(args.train_dir):
         raise SystemExit(
@@ -2483,11 +2734,27 @@ def build_parser() -> argparse.ArgumentParser:
     p_rep = sub.add_parser(
         "report",
         help="join metrics.jsonl + incidents.jsonl + membership.json + "
-             "tune_decision.json into run_report.json and print the "
-             "post-mortem timeline (cross-artifact consistency checks)",
+             "tune_decision.json + fabric_probe.json into run_report.json "
+             "and print the post-mortem timeline (cross-artifact "
+             "consistency checks); `report timeline` parses a "
+             "--profile-dir trace into per-step phase spans instead",
     )
+    p_rep.add_argument("what", nargs="?", default="run",
+                       choices=["run", "timeline"],
+                       help="run (default): the cross-artifact run "
+                            "report; timeline: per-step encode/exchange/"
+                            "decode/compute spans from a --profile-dir "
+                            "trace, joined against metrics.jsonl — the "
+                            "replacement for the deprecated "
+                            "--phase-metrics mode")
     p_rep.add_argument("--train-dir", type=str, default="output/models/",
                        metavar="N", help="the run's artifact directory")
+    p_rep.add_argument("--profile-dir", type=str, default="",
+                       metavar="DIR",
+                       help="for `report timeline`: the jax profiler "
+                            "trace directory a training run captured "
+                            "with --profile-dir (default: "
+                            "train-dir/trace)")
     p_rep.add_argument("--strict", action="store_true", default=False,
                        help="exit rc=3 when a consistency check fails "
                             "(default: report and exit 0 — the report "

@@ -26,7 +26,10 @@ Record kinds (every record carries ``kind``):
             drift-detector state (``drift_ms``/``drift_hot``), and the
             rolling predicted-vs-measured calibration column
             (``predicted_ms``/``calib`` — comm_model.rolling_calibration,
-            the autopilot's one-shot >2x warning as a tracked series).
+            the autopilot's one-shot >2x warning as a tracked series),
+            generalized PER FABRIC TIER when the tier decomposition is
+            known (``calib_tiers`` — {tier label: blame-bound EMA}; see
+            the ``predicted_tier_ms`` note on ``__init__``).
   ``log``   the reference worker line, structured: the SAME StepMetrics
             record the stdout line is formatted from
             (:func:`emit_worker_line` — one sink, so the two surfaces
@@ -128,14 +131,37 @@ class FlightRecorder:
     re-tune switches the aggregate column from its step onward).
     """
 
-    def __init__(self, path: str, predicted_ms: Optional[float] = None):
+    def __init__(
+        self,
+        path: str,
+        predicted_ms: Optional[float] = None,
+        predicted_tier_ms: Optional[dict] = None,
+    ):
         self.path = path
         self.predicted_ms = (
             float(predicted_ms)
             if predicted_ms is not None and predicted_ms > 0
             else None
         )
+        # the per-TIER calibration column (the fabric-observatory lift of
+        # the scalar `calib` series): {tier label: predicted comm ms} —
+        # obs.fabric.predicted_tier_ms decomposes the winner's predicted
+        # step over the fabric tiers it crosses. Per record the column
+        # tracks the BLAME BOUND per tier: the ratio the tier's predicted
+        # time would have to move by to explain the whole step-time
+        # residual alone ((measured - (predicted - tier)) / tier, EMA'd).
+        # A run on target keeps every tier's column at ~1; a drifting one
+        # shows which tier CAN'T explain the excursion (ratio exploding
+        # past plausibility) — the retuner's fabric re-probe then decides
+        # for real. A bound, not a joint estimate — stated here and in
+        # the README.
+        self.predicted_tier_ms = {
+            str(k): float(v)
+            for k, v in (predicted_tier_ms or {}).items()
+            if isinstance(v, (int, float)) and v > 0
+        } if self.predicted_ms is not None else {}
         self._calib: Optional[float] = None
+        self._calib_tiers: dict = {}
         self.context: dict = {"epoch": _env_membership_epoch()}
         parent = os.path.dirname(path)
         if parent:
@@ -143,9 +169,16 @@ class FlightRecorder:
 
     @classmethod
     def for_train_dir(
-        cls, train_dir: str, predicted_ms: Optional[float] = None
+        cls,
+        train_dir: str,
+        predicted_ms: Optional[float] = None,
+        predicted_tier_ms: Optional[dict] = None,
     ) -> "FlightRecorder":
-        return cls(metrics_path(train_dir), predicted_ms=predicted_ms)
+        return cls(
+            metrics_path(train_dir),
+            predicted_ms=predicted_ms,
+            predicted_tier_ms=predicted_tier_ms,
+        )
 
     def set_context(self, **kw) -> "FlightRecorder":
         """Merge context fields stamped onto every subsequent record
@@ -267,6 +300,25 @@ class FlightRecorder:
                     rec["predicted_ms"] = self.predicted_ms
                     if self._calib is not None:
                         rec["calib"] = round(self._calib, 4)
+                    if self.predicted_tier_ms:
+                        for lbl, tms in self.predicted_tier_ms.items():
+                            # the per-tier blame bound (__init__ note):
+                            # attribute the whole residual to this tier
+                            implied = share_ms - (
+                                self.predicted_ms - tms
+                            )
+                            self._calib_tiers[lbl] = rolling_calibration(
+                                self._calib_tiers.get(lbl),
+                                implied / 1e3,
+                                tms / 1e3,
+                            )
+                        tiers = {
+                            lbl: round(v, 4)
+                            for lbl, v in self._calib_tiers.items()
+                            if v is not None
+                        }
+                        if tiers:
+                            rec["calib_tiers"] = tiers
             if generation is not None:
                 rec["generation"] = int(generation)
             if drift is not None:

@@ -26,6 +26,13 @@ artifacts audit each other instead of being trusted independently:
   * ``quality_density_valid`` — the hybrid plan's per-layer density
     columns in the obs_quality meta lie in [0, 1] and sparse-assigned
     layers are actually sparse (row-budgeted payload < dense bytes).
+  * ``fabric_probe_consistent`` — a tune decision priced from
+    ``--fabric measured`` agrees with ``fabric_probe.json``: the
+    artifact exists, is complete, and its tier labels/GB/s match the
+    decision meta's ``fabric_tiers``.
+  * ``drift_blame_present`` — every ``perf_drift`` retune incident
+    carries the quantified blame record (step-ms pair always; per-tier
+    baseline/measured GB/s on a fabric verdict).
 
 A check whose source artifact is absent is SKIPPED (reported, not
 failed): a run without elastic has no membership to agree with.
@@ -303,6 +310,138 @@ def _check_quality_density(metas: list[dict]) -> dict:
     )
 
 
+def _check_fabric_probe(tune, fabric_probe, incidents=()) -> dict:
+    """``fabric_probe_consistent`` — a tune decision priced from
+    ``--fabric measured`` must agree with the probe artifact it claims
+    to have read: the artifact exists and is complete, and the
+    decision's recorded per-tier GB/s (``meta.fabric_tiers``) match the
+    artifact's tier labels and numbers. Two artifacts describing one
+    measurement must tell one story; skipped when no decision was
+    measured-priced. ONE legitimate divergence exists: the drift-blame
+    flow re-writes the artifact when the fabric MOVED mid-run — but
+    that rewrite is itself on the record (a ``perf_drift`` incident
+    whose blame verdict is ``fabric``), so a number mismatch is only a
+    violation when no such incident explains it."""
+    name = "fabric_probe_consistent"
+    meta = (tune or {}).get("meta") or {}
+    if meta.get("fabric") != "measured":
+        return _check(
+            name, True,
+            "no measured-fabric tune decision to cross-check",
+            skipped=True,
+        )
+    if not fabric_probe:
+        return _check(
+            name, False,
+            "tune_decision.json was priced from --fabric measured but "
+            "fabric_probe.json is missing or unparseable — the pricing "
+            "source is gone",
+        )
+    if not fabric_probe.get("complete"):
+        return _check(
+            name, False,
+            "fabric_probe.json is incomplete (no usable tier fit) but "
+            "the tune decision claims measured pricing",
+        )
+    probe_tiers = {
+        str(t.get("label")): t.get("bandwidth_gbps")
+        for t in fabric_probe.get("tiers", [])
+        if t.get("bandwidth_gbps")
+    }
+    meta_tiers = meta.get("fabric_tiers") or {}
+    fabric_moved = any(
+        r.get("cause") == "perf_drift"
+        and (r.get("blame") or {}).get("verdict") == "fabric"
+        for r in incidents
+    )
+    bad = []
+    repriced = 0
+    if not meta_tiers:
+        bad.append(
+            "decision meta carries no fabric_tiers (pre-probe artifact?)"
+        )
+    for lbl, gbps in meta_tiers.items():
+        if lbl not in probe_tiers:
+            bad.append(
+                f"decision priced tier {lbl!r} ({gbps} GB/s) but the "
+                f"probe artifact measured {sorted(probe_tiers) or 'none'}"
+            )
+        elif round(float(gbps), 4) != round(float(probe_tiers[lbl]), 4):
+            if fabric_moved:
+                # the recorded drift-blame re-price: the retuner rewrote
+                # the artifact because the fabric MOVED, and said so in
+                # incidents.jsonl — a divergence that explains itself
+                repriced += 1
+            else:
+                bad.append(
+                    f"tier {lbl!r}: decision says {gbps} GB/s, probe "
+                    f"artifact says {probe_tiers[lbl]} GB/s — one of "
+                    "them was rewritten with no fabric-moved incident "
+                    "to explain it"
+                )
+    return _check(
+        name,
+        not bad,
+        "; ".join(bad)
+        or (
+            f"decision tiers {sorted(meta_tiers)} match the probe "
+            "artifact"
+            + (
+                f" up to {repriced} recorded drift-blame re-price(s)"
+                if repriced else ""
+            )
+        ),
+    )
+
+
+def _check_drift_blame(incidents) -> dict:
+    """``drift_blame_present`` — every ``perf_drift`` RETUNE incident
+    (action ``retune->X`` / ``retune_keep``) must carry the blame record
+    with both quoted numbers: the step-ms pair always, and per-tier
+    GB/s whenever the verdict is ``fabric`` (an unquantified blame is an
+    opinion, not evidence). Skipped when no retune incidents exist."""
+    name = "drift_blame_present"
+    retunes = [
+        r for r in incidents
+        if r.get("cause") == "perf_drift"
+        and str(r.get("action", "")).startswith("retune")
+    ]
+    if not retunes:
+        return _check(
+            name, True, "no perf_drift retune incidents", skipped=True
+        )
+    bad = []
+    for r in retunes:
+        blame = r.get("blame")
+        where = f"step {r.get('step')} ({r.get('action')})"
+        if not isinstance(blame, dict) or blame.get("verdict") not in (
+            "fabric", "program",
+        ):
+            bad.append(f"{where}: no blame verdict recorded")
+            continue
+        sm = blame.get("step_ms") or {}
+        if not isinstance(sm.get("baseline"), (int, float)):
+            bad.append(f"{where}: blame quotes no baseline step ms")
+        if blame["verdict"] == "fabric":
+            tiers = blame.get("fabric") or {}
+            if not any(
+                isinstance(t, dict)
+                and isinstance(t.get("measured_gbps"), (int, float))
+                and isinstance(t.get("baseline_gbps"), (int, float))
+                for t in tiers.values()
+            ):
+                bad.append(
+                    f"{where}: fabric verdict without per-tier "
+                    "baseline/measured GB/s"
+                )
+    return _check(
+        name,
+        not bad,
+        "; ".join(bad[:5])
+        or f"{len(retunes)} retune incident(s) all carry quantified blame",
+    )
+
+
 def build_report(train_dir: str) -> dict:
     """Join the run's artifacts into the report document (see module
     docstring). Pure read — writing run_report.json is the caller's move
@@ -327,6 +466,9 @@ def build_report(train_dir: str) -> dict:
                 tune = json.load(f)
         except (OSError, ValueError):
             tune = None
+    from atomo_tpu.obs.fabric import read_fabric_probe
+
+    fabric_probe = read_fabric_probe(train_dir)
 
     events: list[dict] = []
     events.extend(_segments(steps))
@@ -383,6 +525,8 @@ def build_report(train_dir: str) -> dict:
         _check_retunes(steps, incidents),
         _check_membership_column(steps, epochs),
         _check_quality_density(metas),
+        _check_fabric_probe(tune, fabric_probe, incidents),
+        _check_drift_blame(incidents),
     ]
     consistent = all(c["ok"] for c in checks)
     summary = {
@@ -403,6 +547,7 @@ def build_report(train_dir: str) -> dict:
             "incidents_jsonl": len(incidents),
             "membership_json": len(epochs),
             "tune_decision_json": tune is not None,
+            "fabric_probe_json": fabric_probe is not None,
         },
         "summary": summary,
         "timeline": events,

@@ -68,7 +68,7 @@ from atomo_tpu.parallel.common import (
     unpack_tree_buckets,
 )
 from atomo_tpu.parallel.mesh import replicated
-from atomo_tpu.utils.tracing import named_phase
+from atomo_tpu.utils.tracing import PHASE_METRICS_HINT, named_phase
 from atomo_tpu.training.resilience import (
     grad_ok,
     masked_mean,
@@ -2119,6 +2119,7 @@ def distributed_train_loop(
             raise ValueError(
                 "--phase-metrics times blocking phase programs and cannot "
                 "describe the overlapped step; drop one of the flags"
+                + PHASE_METRICS_HINT
             )
         if zero1 and resume:
             raise ValueError(
@@ -2130,11 +2131,13 @@ def distributed_train_loop(
         raise ValueError(
             "the online re-tuner rebuilds the fused step; --phase-metrics "
             "has no fused step to re-pick — drop one"
+            + PHASE_METRICS_HINT
         )
     if track_quality and phase_metrics:
         raise ValueError(
             "--obs-quality probes the fused step's encode in-graph; "
             "--phase-metrics has no fused step — drop one"
+            + PHASE_METRICS_HINT
         )
     if track_quality and codec is None:
         raise ValueError(
@@ -2155,6 +2158,7 @@ def distributed_train_loop(
                 "--phase-metrics times a monolithic encode phase program "
                 "and cannot describe the bucket-streamed schedule; drop "
                 "one of the flags"
+                + PHASE_METRICS_HINT
             )
     if elastic is not None:
         if guard is None:
@@ -2184,6 +2188,7 @@ def distributed_train_loop(
             raise ValueError(
                 "--elastic needs the fused step's ok_bits metric; "
                 "--phase-metrics has no membership wiring — drop one"
+                + PHASE_METRICS_HINT
             )
         if jax.process_count() > 1:
             raise ValueError(
@@ -2378,6 +2383,7 @@ def distributed_train_loop(
                 "--phase-metrics times individual phase programs and cannot "
                 "run under a fused superstep scan; drop --phase-metrics or "
                 "use --superstep 1"
+                + PHASE_METRICS_HINT
             )
         if guard is not None or chaos is not None:
             raise ValueError(
@@ -2400,6 +2406,7 @@ def distributed_train_loop(
                 "--sparse-rows is not supported with --phase-metrics "
                 "(the phased programs assume one whole-tree codec "
                 "exchange; there is no row-aware phase split)"
+                + PHASE_METRICS_HINT
             )
         if num_aggregate:
             warnings.warn(
@@ -2688,6 +2695,16 @@ def _distributed_steps(
             prof_ctx = profile(profile_dir)
             prof_ctx.__enter__()
             log_fn(f"Profiling steps {step}..{step + profile_steps - 1} -> {profile_dir}")
+            if recorder is not None:
+                # the artifact-side join key for `report timeline`: which
+                # recorded steps the trace window covers (an exact step
+                # range beats reconstructing it from wall-clock overlap)
+                recorder.write_meta({
+                    "what": "profile_window",
+                    "first_step": step,
+                    "last_step": step + profile_steps - 1,
+                    "profile_dir": profile_dir,
+                })
         images, labels = next(stream)
         si, sl = shard_batch(mesh, images, labels, axis=batch_axes)
         out = step_fn(state, key, si, sl)
@@ -2945,6 +2962,14 @@ def _distributed_superstep_steps(
             prof_ctx = profile(profile_dir)
             prof_ctx.__enter__()
             log_fn(f"Profiling superstep block {b0 + 1}..{s} -> {profile_dir}")
+            if recorder is not None:
+                # the `report timeline` join key (per-step-loop twin)
+                recorder.write_meta({
+                    "what": "profile_window",
+                    "first_step": b0 + 1,
+                    "last_step": s,
+                    "profile_dir": profile_dir,
+                })
         state, mblk = step_fn(state, key, dev_im, dev_lb)
         feed.start(min(superstep, max_steps - s))  # overlap next transfer
         m = jax.device_get(mblk)  # the block's ONE host sync

@@ -20,6 +20,9 @@ forms for ``--fabric`` on a two-tier mesh:
                       meaning: the slowest link on the gradient path)
   ``<inner>:<outer>`` both tiers explicit, e.g. ``ici:eth10g`` or
                       ``45:1.25`` (per-chip GB/s numbers)
+  ``measured``        both tiers from the startup fabric probe's
+                      ``fabric_probe.json`` (measured bandwidths AND
+                      per-hop latencies — obs.fabric.measured_two_tier)
 
 Latency anchors are stated estimates (per-hop ICI ~1 us, DCN ~25 us —
 the order-of-magnitude split between on-chip links and a routed
@@ -96,13 +99,21 @@ def resolve_two_tier(
     dcn_ways: int,
     n_dev: int,
     n_proc: int = 1,
+    measured=None,
 ) -> TwoTierFabric:
     """Parse a ``--fabric`` value into a :class:`TwoTierFabric` for a mesh
     of ``n_dev`` data-parallel chips split into ``dcn_ways`` slow-fabric
     groups. Grammar in the module docstring; every token reuses
     :func:`comm_model.resolve_fabric` so the two parsers cannot drift.
     Raises ValueError (same contract as resolve_fabric) on a bad token or
-    a group shape that does not divide the mesh."""
+    a group shape that does not divide the mesh.
+
+    ``measured`` (the ``fabric_probe.json`` document) serves two forms:
+    the full ``measured`` token builds BOTH tiers from the probe —
+    measured bandwidths and measured per-hop latencies, labels
+    ``measured_ici``/``measured_dcn`` (obs.fabric.measured_two_tier) —
+    and a ``measured`` TOKEN inside ``<inner>:<outer>`` resolves through
+    ``resolve_fabric``'s slowest-tier convention like any other token."""
     k = int(dcn_ways)
     n = int(n_dev)
     if not (1 < k <= n) or n % k:
@@ -110,6 +121,18 @@ def resolve_two_tier(
             f"two-tier fabric needs 1 < dcn_ways <= n_dev with "
             f"dcn_ways | n_dev; got dcn_ways={k}, n_dev={n}"
         )
+    if fabric == "measured":
+        from atomo_tpu.obs.fabric import measured_two_tier
+
+        if measured is None:
+            # the same instruction resolve_fabric's scalar path gives
+            raise ValueError(
+                "--fabric measured resolves from a fabric_probe.json "
+                "artifact and this surface has none — run `train "
+                "--fabric measured` with a --train-dir so the startup "
+                "probe measures both tiers (--dcn-ways set)"
+            )
+        return measured_two_tier(measured, dcn_ways=k, n_dev=n)
     if fabric == "auto":
         inner_tok, outer_tok = "ici", "dcn"
     elif ":" in fabric:
@@ -124,8 +147,8 @@ def resolve_two_tier(
         # gradient path = the OUTER tier; inner keeps the ici preset
         inner_tok, outer_tok = "ici", fabric
     return TwoTierFabric(
-        inner_bw=resolve_fabric(inner_tok, n_proc=1),
-        outer_bw=resolve_fabric(outer_tok, n_proc=n_proc),
+        inner_bw=resolve_fabric(inner_tok, n_proc=1, measured=measured),
+        outer_bw=resolve_fabric(outer_tok, n_proc=n_proc, measured=measured),
         inner_ways=n // k,
         outer_ways=k,
         inner_label=_tier_label(inner_tok),

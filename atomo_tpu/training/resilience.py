@@ -78,7 +78,10 @@ from typing import Callable, Optional, Sequence
 
 # re-export: the supervisor protocol constant lives in utils.tracing so
 # utils.chaos (crashloop's reader side) can share it without an import cycle
-from atomo_tpu.utils.tracing import ATTEMPT_ENV  # noqa: F401
+from atomo_tpu.utils.tracing import (  # noqa: F401
+    ATTEMPT_ENV,
+    PHASE_METRICS_HINT,
+)
 
 SUPERVISED_ENV = "ATOMO_SUPERVISED"  # set by run_supervised on children
 # the trainer's "roll me back from a clean checkpoint" exit: distinct from
@@ -572,6 +575,7 @@ def diverge_conflict(
         return (
             "--on-diverge needs the fused step's metric series; "
             "--phase-metrics has no doctor wiring — drop one"
+            + PHASE_METRICS_HINT
         )
     if remedy == "densify":
         if codec is None:

@@ -195,6 +195,7 @@ def tune(
     codec_tax_s: Optional[float] = None,
     ring_bucket_size: int = 65536,
     context: Optional[dict] = None,
+    fabric_probe: Optional[dict] = None,
     log_fn=print,
 ) -> dict:
     """Run the startup autopilot; returns the finished decision document
@@ -216,6 +217,13 @@ def tune(
     bytes (``comm_model.leaf_budget_totals`` — the same sums the
     executed program reports) and probed through the SAME step builder
     with the plan attached.
+
+    ``fabric_probe`` (the ``fabric_probe.json`` document) is required
+    when ``fabric == "measured"``: the ONE parsers resolve the token
+    from it, so every candidate — flat and hierarchical — is priced
+    from the measured mesh, and the decision artifact's meta records
+    the measured per-tier GB/s (``meta.fabric_tiers``) so the report's
+    cross-artifact check can audit decision against probe.
     """
     import jax
 
@@ -241,14 +249,16 @@ def tune(
 
         fabric2 = resolve_two_tier(
             fabric, dcn_ways=int(dcn_ways), n_dev=n_dev,
-            n_proc=jax.process_count(),
+            n_proc=jax.process_count(), measured=fabric_probe,
         )
         # flat candidates cross the slow tier end to end: price them at
         # the OUTER bandwidth, not a blended scalar
         bw = fabric2.outer_bw
     else:
         try:
-            bw = resolve_fabric(fabric, n_proc=jax.process_count())
+            bw = resolve_fabric(
+                fabric, n_proc=jax.process_count(), measured=fabric_probe
+            )
         except ValueError:
             # a two-tier <inner>:<outer> fabric string with a flat
             # candidate space (e.g. the CLI excluded the hierarchical
@@ -259,7 +269,10 @@ def tune(
             if ":" not in fabric:
                 raise
             outer_tok = fabric.rpartition(":")[2]
-            bw = resolve_fabric(outer_tok, n_proc=jax.process_count())
+            bw = resolve_fabric(
+                outer_tok, n_proc=jax.process_count(),
+                measured=fabric_probe,
+            )
             log_fn(
                 f"Autopilot: two-tier --fabric {fabric!r} with a flat "
                 "candidate space; pricing flat candidates at the outer "
@@ -307,6 +320,20 @@ def tune(
         "n_devices": n_dev,
         "fabric": fabric,
         "fabric_gbps_per_chip": round(bw / 1e9, 3),
+        # a measured fabric's per-tier GB/s, copied from the probe doc
+        # so report's fabric_probe_consistent check can audit this
+        # decision against the artifact it was priced from
+        **(
+            {
+                "fabric_tiers": {
+                    t["label"]: t["bandwidth_gbps"]
+                    for t in fabric_probe.get("tiers", [])
+                    if t.get("bandwidth_gbps")
+                }
+            }
+            if fabric == "measured" and fabric_probe is not None
+            else {}
+        ),
         **(
             {
                 "dcn_ways": int(dcn_ways),
@@ -471,6 +498,22 @@ class OnlineRetuner:
     stated over. ``probe_fn=None`` is the observe-only mode (the
     single-host loop): drift is still detected and logged as an incident,
     but nothing is switched — a single device has no exchange to re-pick.
+
+    DRIFT BLAME (the fabric-observatory lift): a step-time alarm has two
+    root-cause families — the FABRIC moved (a contended link, a changed
+    route) or the PROGRAM did (a different phase balance, a remedy, a
+    slow host). With ``fabric_probe_fn`` armed (the CLI wires it for
+    ``--fabric measured`` runs, whose startup probe is the baseline),
+    :meth:`maybe_retune` re-runs the cheap fabric probe and every
+    ``perf_drift`` retune incident carries a ``blame`` record quoting
+    BOTH numbers: the step-time pair (frozen baseline vs the observed
+    excursion) and, per tier, baseline-vs-measured GB/s. Verdict
+    ``fabric`` (any tier moved past ``obs.fabric.FABRIC_MOVED_RATIO``)
+    additionally invokes ``on_fabric_moved`` so the caller re-prices —
+    the CLI rewrites ``fabric_probe.json`` with the fresh measurement;
+    verdict ``program`` leaves the re-probe of candidates (already this
+    method's job) as the response. Without a fabric baseline the blame
+    record says so (``basis``) instead of guessing.
     """
 
     def __init__(
@@ -480,6 +523,9 @@ class OnlineRetuner:
         drift=None,
         margin: float = 1.05,
         incidents=None,
+        fabric_probe_fn: Optional[Callable[[], dict]] = None,
+        fabric_baseline: Optional[dict] = None,
+        on_fabric_moved: Optional[Callable[[dict], None]] = None,
         log_fn=print,
     ):
         from atomo_tpu.training.resilience import DriftConfig, DriftState
@@ -490,8 +536,12 @@ class OnlineRetuner:
         self.state = DriftState()
         self.margin = float(margin)
         self.incidents = incidents
+        self.fabric_probe_fn = fabric_probe_fn
+        self.fabric_baseline = dict(fabric_baseline or {})
+        self.on_fabric_moved = on_fabric_moved
         self.log_fn = log_fn
         self.pending: Optional[str] = None
+        self._alarm_ms: Optional[dict] = None
         self.retunes = 0
         self.switches = 0
 
@@ -513,6 +563,27 @@ class OnlineRetuner:
         self.state, alarm = drift_scan(self.cfg, self.state, dts)
         if alarm is not None and self.pending is None:
             self.pending = alarm
+            # the blame record's program-side pair: the frozen baseline
+            # vs the excursion that fired the alarm (the last observed
+            # share — representative of the sustained run, the detector
+            # requires `patience` of them above ratio x baseline)
+            try:
+                last = [float(d) for d in (
+                    dts if hasattr(dts, "__iter__") else [dts]
+                )]
+                obs = next(
+                    (d for d in reversed(last)
+                     if math.isfinite(d) and d > 0), None,
+                )
+            except (TypeError, ValueError):
+                obs = None
+            self._alarm_ms = {
+                "baseline": round(self.state.mean * 1e3, 3),
+                "observed": (
+                    round(obs * 1e3, 3) if obs is not None
+                    else round(self.state.mean * 1e3, 3)
+                ),
+            }
             self.log_fn(
                 f"Autopilot: sustained step-time drift detected "
                 f"(baseline {self.state.mean * 1e3:.1f} ms/step); "
@@ -520,6 +591,95 @@ class OnlineRetuner:
             )
             return alarm
         return None
+
+    def _blame(self) -> dict:
+        """The drift-blame record (class docstring): re-run the cheap
+        fabric probe and quote BOTH number pairs — per-tier
+        baseline-vs-measured GB/s and the baseline-vs-observed step ms.
+        Verdict ``fabric`` when any tier moved past
+        ``obs.fabric.FABRIC_MOVED_RATIO`` either way (the re-price hook
+        ``on_fabric_moved`` then fires); ``program`` otherwise — the
+        candidate re-probe is the response. A failed or unavailable
+        fabric probe is stated in ``basis``, never guessed around."""
+        blame: dict = {
+            "verdict": "program",
+            "step_ms": dict(
+                self._alarm_ms
+                or {"baseline": round(self.state.mean * 1e3, 3),
+                    "observed": None}
+            ),
+        }
+        if self.fabric_probe_fn is None or not self.fabric_baseline:
+            blame["basis"] = (
+                "no fabric baseline (run --fabric measured to arm "
+                "fabric blame); program blamed by default — the "
+                "candidate re-probe decides the response"
+            )
+            return blame
+        try:
+            probe_doc = self.fabric_probe_fn()
+        except Exception as exc:  # noqa: BLE001 — blame must not kill training
+            blame["basis"] = (
+                f"fabric re-probe failed ({type(exc).__name__}: "
+                f"{str(exc)[:120]}); program blamed by default"
+            )
+            return blame
+        from atomo_tpu.obs.fabric import (
+            FABRIC_MOVED_RATIO,
+            measured_bandwidths,
+        )
+
+        tiers = {}
+        moved = False
+        for label, bw in sorted(measured_bandwidths(probe_doc).items()):
+            base = self.fabric_baseline.get(label)
+            row = {"measured_gbps": round(bw / 1e9, 4)}
+            if base and base > 0:
+                ratio = bw / float(base)
+                row["baseline_gbps"] = round(float(base) / 1e9, 4)
+                row["ratio"] = round(ratio, 4)
+                if not (
+                    1.0 / FABRIC_MOVED_RATIO <= ratio <= FABRIC_MOVED_RATIO
+                ):
+                    moved = True
+            tiers[label] = row
+        blame["fabric"] = tiers
+        blame["basis"] = (
+            f"per-tier re-probe vs the startup baseline "
+            f"(moved = ratio outside 1/{FABRIC_MOVED_RATIO}x.."
+            f"{FABRIC_MOVED_RATIO}x)"
+        )
+        if moved:
+            blame["verdict"] = "fabric"
+            self.log_fn(
+                "Autopilot: drift blame = FABRIC (per-tier GB/s moved "
+                f"past {FABRIC_MOVED_RATIO}x: "
+                + ", ".join(
+                    f"{lbl} {r.get('baseline_gbps')}->"
+                    f"{r.get('measured_gbps')}"
+                    for lbl, r in tiers.items()
+                )
+                + "); re-pricing from the fresh probe"
+            )
+            # re-price: the new measurement replaces the stale baseline
+            # for the NEXT alarm, and the caller persists it (the CLI
+            # rewrites fabric_probe.json so resumes and reports read
+            # the fabric that actually exists now)
+            self.fabric_baseline = measured_bandwidths(probe_doc)
+            if self.on_fabric_moved is not None:
+                try:
+                    self.on_fabric_moved(probe_doc)
+                except Exception as exc:  # noqa: BLE001
+                    self.log_fn(
+                        f"Autopilot: fabric re-price hook failed: {exc}"
+                    )
+        else:
+            self.log_fn(
+                "Autopilot: drift blame = PROGRAM (fabric within "
+                f"{FABRIC_MOVED_RATIO}x of baseline per tier); the "
+                "candidate re-probe decides"
+            )
+        return blame
 
     def maybe_retune(self, step: int, current_mode: str) -> Optional[str]:
         """Execute the pending re-probe (call at a checkpoint boundary).
@@ -533,6 +693,7 @@ class OnlineRetuner:
         reason, self.pending = self.pending, None
         self.retunes += 1
         self.state = DriftState()
+        blame = self._blame()
         if self.probe_fn is None or current_mode not in self.modes:
             # observe-only (single-host, or a mode outside the safe online
             # pair, e.g. psum/hierarchical): record the drift, keep config
@@ -543,6 +704,7 @@ class OnlineRetuner:
                     step=step,
                     reason=reason,
                     mode=current_mode,
+                    blame=blame,
                 )
             self.log_fn(
                 f"Autopilot: step-time drift at step {step} recorded; "
@@ -580,6 +742,7 @@ class OnlineRetuner:
                 measured_ms={
                     m: round(v, 4) for m, v in measured.items()
                 },
+                blame=blame,
             )
         if new_mode:
             self.switches += 1

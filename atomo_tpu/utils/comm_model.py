@@ -372,18 +372,40 @@ def overlap_report(
     }
 
 
-def resolve_fabric(fabric: str, *, n_proc: int = 1) -> float:
+def resolve_fabric(fabric: str, *, n_proc: int = 1, measured=None) -> float:
     """Per-chip bandwidth (bytes/s) for a ``--fabric`` value: ``auto``
-    (ici single-host, dcn multi-host), a named preset, or a positive
-    finite per-chip GB/s number. ONE parser for the CLI's ``--aggregate
-    auto`` advisory and the autopilot's predictor, so the two surfaces
-    cannot disagree about what a fabric string means. Raises ValueError
-    with the usage line on anything else.
+    (ici single-host, dcn multi-host), a named preset, ``measured`` (the
+    ``fabric_probe.json`` artifact — see below), or a positive finite
+    per-chip GB/s number. ONE parser for the CLI's ``--aggregate auto``
+    advisory and the autopilot's predictor, so the two surfaces cannot
+    disagree about what a fabric string means. Raises ValueError with
+    the usage line on anything else.
 
     A single scalar prices every hop at one bandwidth — on a two-tier
     mesh that is the OUTER (slowest) tier by convention, and per-tier
     arithmetic lives in ``topology.fabric.resolve_two_tier``, which
-    reuses this grammar per tier token."""
+    reuses this grammar per tier token AND additionally accepts the
+    two-tier ``<inner>:<outer>`` form (each side any token this parser
+    takes) — a ``:``-carrying string reaching THIS scalar parser is
+    rejected with the pointer below, not silently mis-read.
+
+    ``measured`` resolves to the SLOWEST probed tier's bandwidth from a
+    startup fabric probe (obs.fabric.probe_fabric); the caller threads
+    the probe document via ``measured=`` — the CLI runs the probe when
+    ``--fabric measured`` is passed with a ``--train-dir``. Without a
+    document the token is a config error with the instruction attached
+    (a preset must never silently stand in for a measurement)."""
+    if fabric == "measured":
+        if measured is None:
+            raise ValueError(
+                "--fabric measured resolves from a fabric_probe.json "
+                "artifact (obs.fabric.probe_fabric) and this surface has "
+                "none — run `train --fabric measured` with a --train-dir "
+                "so the startup probe measures the mesh and records it"
+            )
+        from atomo_tpu.obs.fabric import measured_outer_bw
+
+        return measured_outer_bw(measured)
     if fabric == "auto":
         return FABRICS["dcn" if n_proc > 1 else "ici"]
     if fabric in FABRICS:
@@ -394,8 +416,15 @@ def resolve_fabric(fabric: str, *, n_proc: int = 1) -> float:
         bw = -1.0
     if not (0 < bw < float("inf")):  # also rejects nan/inf strings
         raise ValueError(
-            f"--fabric {fabric!r}: expected auto | "
+            f"--fabric {fabric!r}: expected auto | measured | "
             f"{' | '.join(sorted(FABRICS))} | <positive finite GB/s>"
+            + (
+                " (two-tier <inner>:<outer> strings are accepted by the "
+                "two-tier surfaces — topology.fabric.resolve_two_tier — "
+                "with each side any of the forms above)"
+                if ":" in str(fabric)
+                else " | <inner>:<outer> on two-tier surfaces"
+            )
         )
     return bw
 

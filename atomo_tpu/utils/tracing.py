@@ -45,6 +45,17 @@ ATTEMPT_ENV = "ATOMO_RUN_ATTEMPT"
 # the elastic coordinator cross-checks it against membership.json.
 MEMBERSHIP_EPOCH_ENV = "ATOMO_MEMBERSHIP_EPOCH"
 
+# The one pointer every --phase-metrics conflict reject carries (CLI
+# preflight, both train loops, the doctor's conflict matrix — defined in
+# this stdlib-only module because all of them import it): the legacy
+# blocking mode is deprecated in favor of the trace-based timeline,
+# which observes exactly the fused programs the conflict matrix refuses
+# to let --phase-metrics near.
+PHASE_METRICS_HINT = (
+    " (deprecated mode — the trace-based replacement observes fused "
+    "programs: run with --profile-dir and use `report timeline`)"
+)
+
 
 @contextlib.contextmanager
 def span(name: str, sink: Optional[dict] = None) -> Iterator[None]:

@@ -59,7 +59,8 @@ def _budgets(network: str, shape) -> dict:
 
 
 def model_only_recs(ways: int, dcn_ways: int = 2,
-                    allow_stream: bool = False) -> dict:
+                    allow_stream: bool = False,
+                    fabric_probe: dict | None = None) -> dict:
     """{network: {fabric: recommendation}} from the stated anchors.
 
     Besides the three single-fabric columns, each network gets a TWO-TIER
@@ -70,7 +71,14 @@ def model_only_recs(ways: int, dcn_ways: int = 2,
     SAME size-scaled single-chip anchors as the flat rows plus the
     fabric module's per-hop latency estimates; they order plans, they do
     not promise wall-clock — bench config 11 carries the measured
-    evidence and its calibration fields."""
+    evidence and its calibration fields.
+
+    ``fabric_probe`` (``--from-probe``: a ``fabric_probe.json``
+    document) replaces the preset fabric columns with the PROBED tiers
+    (``measured_<label>`` columns at the measured per-chip GB/s), and
+    the two-tier row prices from the probe's measured bandwidths AND
+    latencies (obs.fabric.measured_two_tier) — the table then describes
+    the mesh that was measured, not the mesh the presets assert."""
     from atomo_tpu.topology.fabric import resolve_two_tier
     from atomo_tpu.topology.schedule import recommend_two_tier
     from atomo_tpu.utils.comm_model import (
@@ -80,6 +88,30 @@ def model_only_recs(ways: int, dcn_ways: int = 2,
         recommend_for_scenario,
     )
 
+    fabric_cols = dict(FABRICS)
+    probe_fabric2 = None
+    if fabric_probe is not None:
+        from atomo_tpu.obs.fabric import measured_bandwidths
+
+        bws = measured_bandwidths(fabric_probe)
+        if not bws:
+            raise SystemExit(
+                "--from-probe: the artifact carries no usable tier "
+                "measurement"
+            )
+        fabric_cols = {
+            f"measured_{label}": bw for label, bw in bws.items()
+        }
+        if (
+            {"ici", "dcn"} <= set(bws)
+            and 1 < dcn_ways <= ways
+            and ways % dcn_ways == 0
+        ):
+            from atomo_tpu.obs.fabric import measured_two_tier
+
+            probe_fabric2 = measured_two_tier(
+                fabric_probe, dcn_ways=dcn_ways, n_dev=ways
+            )
     recs = {}
     for net, (shape, _names) in SCENARIOS.items():
         budgets = _budgets(net, shape)
@@ -98,15 +130,20 @@ def model_only_recs(ways: int, dcn_ways: int = 2,
                 fabric_bw=bw,
                 allow_stream=allow_stream,
             )
-            for label, bw in sorted(FABRICS.items())
+            for label, bw in sorted(fabric_cols.items())
         }
         if 1 < dcn_ways <= ways and ways % dcn_ways == 0:
-            recs[net][f"ici:dcn 2-tier (K={dcn_ways})"] = recommend_two_tier(
+            fabric2 = probe_fabric2 or resolve_two_tier(
+                "auto", dcn_ways=dcn_ways, n_dev=ways
+            )
+            tier_label = (
+                f"measured 2-tier (K={dcn_ways})" if probe_fabric2
+                else f"ici:dcn 2-tier (K={dcn_ways})"
+            )
+            recs[net][tier_label] = recommend_two_tier(
                 codec_budgets=budgets,
                 measured_ms=measured,
-                fabric=resolve_two_tier(
-                    "auto", dcn_ways=dcn_ways, n_dev=ways
-                ),
+                fabric=fabric2,
             )
     return recs
 
@@ -250,6 +287,12 @@ def main() -> int:
                     help="read recommendations from a bench "
                          "scenario_matrix row / artifact instead of the "
                          "model-only anchors")
+    ap.add_argument("--from-probe", type=str, default="",
+                    help="price the fabric columns from a "
+                         "fabric_probe.json artifact (--fabric measured "
+                         "runs write one): measured_<tier> columns at "
+                         "the probed per-chip GB/s, and the two-tier "
+                         "row from the probed bandwidths AND latencies")
     args = ap.parse_args()
     if args.from_bench:
         with open(args.from_bench) as f:
@@ -269,16 +312,25 @@ def main() -> int:
         print(render(row["recommendations"], ways,
                      f"measured anchors, {args.from_bench}"))
         return 0
+    fabric_probe = None
+    if args.from_probe:
+        with open(args.from_probe) as f:
+            fabric_probe = json.load(f)
     recs = model_only_recs(args.ways, dcn_ways=args.dcn_ways,
-                           allow_stream=args.stream)
+                           allow_stream=args.stream,
+                           fabric_probe=fabric_probe)
     if args.sparse:
         recs.update(sparse_recs(args.ways))
-    print(render(recs,
-                 args.ways,
-                 "model-only anchors, artifacts/BENCH_ONCHIP_r3.md; "
-                 "2-tier rows: topology planner over the same anchors + "
-                 "stated latency estimates — ordering only, measured "
-                 "evidence is bench config 11"))
+    source = (
+        f"measured fabric, {args.from_probe} (compute/tax anchors stay "
+        "the stated model-only estimates)"
+        if fabric_probe is not None
+        else "model-only anchors, artifacts/BENCH_ONCHIP_r3.md; "
+             "2-tier rows: topology planner over the same anchors + "
+             "stated latency estimates — ordering only, measured "
+             "evidence is bench config 11"
+    )
+    print(render(recs, args.ways, source))
     return 0
 
 

@@ -28,7 +28,7 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")  # skip the real TPU probe
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     assert bench.main() == 0
-    assert order == [2, 1, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13]
+    assert order == [2, 1, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14]
 
     lines = [
         json.loads(ln)
@@ -41,7 +41,7 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     assert aggs[-1]["configs_complete"] is True
     assert [c["metric"] for c in aggs[-1]["configs"]] == [
         "m1", "m2", "m3", "m4", "m5", "m6", "m7", "m8", "m9", "m10",
-        "m11", "m12", "m13"
+        "m11", "m12", "m13", "m14"
     ]
     # an aggregate exists right after the FIRST config completes
     assert "configs" in lines[1]
@@ -178,7 +178,7 @@ def test_artifact_rows_written_atomically_as_they_complete(
     assert doc["tpu_probe"] == {"ok": False, "skipped": "JAX_PLATFORMS=cpu"}
     assert [r["metric"] for r in doc["rows"]] == [
         "m2", "m1", "m3", "m4", "m5", "m6", "m7", "m8", "m9", "m10",
-        "m11", "m12", "m13"
+        "m11", "m12", "m13", "m14"
     ]
     # atomicity: no torn temp file left behind
     assert not list(tmp_path.glob("*.tmp.*"))
