@@ -426,7 +426,23 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                    help="ZeRO-1 optimizer-state sharding: each dp chip "
                         "holds 1/n of the flat momentum/Adam buffers, "
                         "updates its slice, and one all_gather reassembles "
-                        "the replicated params (multi-device mesh only)")
+                        "the replicated params (multi-device mesh only). "
+                        "Alias for --partition zero1")
+    t.add_argument("--partition", type=str, default="replicated",
+                   choices=["replicated", "zero1", "sharded-update"],
+                   help="weight-update partitioning (the mesh subsystem's "
+                        "knob): 'replicated' keeps params+optimizer state "
+                        "on every chip; 'zero1' shards the optimizer "
+                        "state only; 'sharded-update' (Xu et al. "
+                        "2004.13336) shards master weights AND optimizer "
+                        "state AND the update computation over the data "
+                        "axes — per-chip persistent state drops to 1/n, "
+                        "the dense model exists only transiently inside "
+                        "the step, trajectories stay bit-identical to "
+                        "replicated per codec (canonical decode order), "
+                        "and — unlike zero1 — checkpoints carry the "
+                        "--overlap delayed in-flight payload, so "
+                        "supervised restarts resume bit-exact")
     t.add_argument("--bf16", action="store_true", default=False,
                    help="mixed precision: forward/backward compute in "
                         "bfloat16 on the MXU (master params, optimizer "
@@ -716,6 +732,24 @@ def _membership_exit(exc: Exception) -> int:
 from atomo_tpu.utils.tracing import PHASE_METRICS_HINT as _TIMELINE_HINT
 
 
+def _partition(args: argparse.Namespace) -> str:
+    """Resolve the weight-update partition knob to one of
+    {'replicated', 'zero1', 'sharded_update'} — ``--zero1`` is the legacy
+    alias for ``--partition zero1`` and conflicts with the full
+    sharded-update (which supersedes it as the shard-state-only
+    degenerate point)."""
+    p = getattr(args, "partition", "replicated").replace("-", "_")
+    if getattr(args, "zero1", False):
+        if p == "sharded_update":
+            raise SystemExit(
+                "--zero1 conflicts with --partition sharded-update: "
+                "ZeRO-1 is the sharded update's shard-state-only "
+                "degenerate point — pass one of the two"
+            )
+        p = "zero1"
+    return p
+
+
 def _argv_preflight(args: argparse.Namespace) -> None:
     """Deterministic config conflicts knowable from argv alone, checked
     BEFORE the supervisor re-exec (and before the jax backend initializes
@@ -724,6 +758,35 @@ def _argv_preflight(args: argparse.Namespace) -> None:
     the restart budget as a chain of "crash" incidents. Conflicts that
     need the resolved device count or the built codec are (re-)checked in
     the run itself."""
+    partition = _partition(args)  # raises on the --zero1 conflict
+    if partition == "sharded_update":
+        # the sharded-update compatibility matrix, argv-knowable half
+        # (the loop re-checks with the resolved mesh)
+        if args.phase_metrics:
+            raise SystemExit(
+                "--partition sharded-update is not supported with "
+                "--phase-metrics (the phased update program assumes a "
+                "replicated optimizer state)"
+            )
+        if getattr(args, "elastic", False):
+            raise SystemExit(
+                "--elastic runs the replicated update for now (a "
+                "membership reshape re-shards live state via "
+                "mesh.reshard, which the elastic loop does not drive "
+                "yet); drop --partition sharded-update"
+            )
+        if args.on_diverge != "off":
+            raise SystemExit(
+                "--on-diverge rollback rebuilds replicated templates "
+                "and cannot re-thread the sharded master layout yet; "
+                "drop --partition sharded-update or --on-diverge"
+            )
+        if getattr(args, "sparse_rows", "off") != "off":
+            raise SystemExit(
+                "--partition sharded-update does not compose with "
+                "--sparse-rows yet (the row exchange is untested "
+                "against the flat master layout)"
+            )
     if args.superstep < 0:
         raise SystemExit(
             f"--superstep {args.superstep}: must be >= 1 (or 0 for the "
@@ -832,14 +895,24 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "describe the overlapped step; drop one of the flags"
                 + _TIMELINE_HINT
             )
-        if args.zero1 and args.max_restarts > 0 and args.train_dir:
+        if (
+            _partition(args) == "zero1"
+            and args.max_restarts > 0
+            and args.train_dir
+        ):
+            # the LEGACY dead end, kept on the legacy path only: the new
+            # sharded path (--partition sharded-update) checkpoints the
+            # in-flight payload as a sharded carry leaf and resumes
+            # bit-exact (drilled: tests/test_mesh.py kill->restart drill)
             raise SystemExit(
                 "--max-restarts with --zero1 --overlap delayed cannot work: "
                 "supervised restarts resume from checkpoints, and a "
                 "--zero1 run cannot resume the delayed in-flight payload "
-                "(the sharded optimizer template cannot carry it) — every "
-                "restart would fail instantly and burn the budget; drop "
-                "one of the three"
+                "(the legacy sharded optimizer template cannot carry it) "
+                "— every restart would fail instantly and burn the "
+                "budget; drop one of the three, or switch to --partition "
+                "sharded-update, whose checkpoints hold the payload as a "
+                "sharded carry leaf and resume bit-exact"
             )
     if getattr(args, "stream_encode", "off") == "on":
         if args.code.lower() in DENSE_CODES:
@@ -1124,7 +1197,7 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             codec=None if args.code.lower() in DENSE_CODES else args.code,
             aggregate=args.aggregate if multi else None,
             overlap=args.overlap,
-            zero1=args.zero1 and multi,
+            zero1=_partition(args) == "zero1" and multi,
             phase_metrics=args.phase_metrics,
             num_aggregate=args.num_aggregate if multi else None,
             keep_ckpts=args.keep_ckpts,
@@ -1207,7 +1280,8 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
     sample = jnp.zeros((1,) + sample_shape, jnp.float32)
     num_classes = _num_classes(args.dataset)
     _init_params = model_init_fn(model, sample)
-    zero1 = args.zero1 and n_dev > 1
+    partition = _partition(args)
+    zero1 = partition == "zero1" and n_dev > 1
     k_agg = 0
     if (
         args.num_aggregate is not None
@@ -1273,7 +1347,12 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
                 prior = _json.load(f)
         except (OSError, ValueError):
             prior = None
-        reusable, why = decision_reusable(prior, n_dev=n_dev)
+        from atomo_tpu.mesh import MeshSpec
+
+        reusable, why = decision_reusable(
+            prior, n_dev=n_dev,
+            mesh_axes=MeshSpec.from_world(n_dev, dcn_ways).shape_dict(),
+        )
         if reusable:
             doc = prior
             print(
@@ -1347,7 +1426,8 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             dcn_ways=dcn_ways,
             probe_top=args.tune_top, probe_steps=args.tune_steps,
             probe_reps=args.tune_reps,
-            num_aggregate=k_agg, zero1=zero1, grad_accum=args.grad_accum,
+            num_aggregate=k_agg, zero1=zero1, partition=partition,
+            grad_accum=args.grad_accum,
             compute_dtype=compute_dtype,
             codec_tax_s=(
                 None if args.codec_tax_ms is None
@@ -1803,7 +1883,7 @@ def cmd_train(args: argparse.Namespace) -> int:
             codec=codec,
             aggregate=args.aggregate if n_dev > 1 else None,
             overlap=args.overlap,
-            zero1=args.zero1 and n_dev > 1,
+            zero1=_partition(args) == "zero1" and n_dev > 1,
             phase_metrics=args.phase_metrics,
             num_aggregate=args.num_aggregate if n_dev > 1 else None,
             keep_ckpts=args.keep_ckpts,
@@ -2053,7 +2133,9 @@ def cmd_train(args: argparse.Namespace) -> int:
             distributed_train_loop(
                 model, optimizer, mesh, train_iter, test_iter,
                 codec=codec, aggregate=args.aggregate, augment=augment,
-                num_aggregate=k_agg, zero1=args.zero1,
+                num_aggregate=k_agg,
+                zero1=_partition(args) == "zero1",
+                sharded_update=_partition(args) == "sharded_update",
                 grad_accum=args.grad_accum, inner_axis=inner_axis,
                 max_steps=max_steps, eval_freq=args.eval_freq, seed=args.seed,
                 train_dir=args.train_dir, save_freq=save_freq, resume=args.resume,
@@ -2105,6 +2187,13 @@ def cmd_train(args: argparse.Namespace) -> int:
             warnings.warn(
                 "--grad-accum is only wired into the multi-device step; "
                 "single-device training ignores it"
+            )
+        if _partition(args) != "replicated":
+            warnings.warn(
+                f"--partition {_partition(args)} is wired into the "
+                "distributed loop; the single-device path trains the "
+                "replicated update (the --zero1 precedent — there is "
+                "nothing to shard a 1-chip update over)"
             )
         try:
             train_loop(

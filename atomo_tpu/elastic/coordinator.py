@@ -268,6 +268,38 @@ class ElasticCoordinator:
 
     # -- transitions ----------------------------------------------------
 
+    def reshard_spec(self, new_world: int):
+        """The target mesh shape of a reshape, in the one mesh grammar
+        (:class:`~atomo_tpu.mesh.spec.MeshSpec`) — recorded with every
+        shrink/grow incident so the reshape is a mesh-shape transition in
+        the artifact record, not a bare device count. Elastic meshes are
+        flat by construction (the coordinator rejects hierarchical
+        runs)."""
+        from atomo_tpu.mesh import MeshSpec
+
+        return MeshSpec.from_world(new_world)
+
+    def reshard_live(self, state, specs, optimizer, *, new_world: int):
+        """Reshape as DATA MOVEMENT: re-shard a live sharded-update state
+        onto the shrunken/grown flat mesh without exiting the process
+        (:func:`atomo_tpu.mesh.reshard.reshard_sharded_update` — gathers
+        once, re-slices, continues the same optimizer trajectory).
+        Returns ``(new_mesh, new_state, new_specs)``.
+
+        This is the forward path for in-process reshapes; the
+        exit-and-re-exec protocol (:class:`MembershipChange` -> rc=29 ->
+        supervisor relaunch) REMAINS the fallback and the default wiring
+        — it is the only correct move when the dead replica took its
+        host process down, and the elastic loop currently runs the
+        replicated update. Drilled directly in tests/test_mesh.py."""
+        from atomo_tpu.mesh.reshard import reshard_sharded_update
+
+        new_mesh = self.reshard_spec(new_world).build()
+        new_state, new_specs = reshard_sharded_update(
+            state, specs, new_mesh, optimizer
+        )
+        return new_mesh, new_state, new_specs
+
     def maybe_transition(self, step: int) -> None:
         """Call at every periodic checkpoint boundary (AFTER the save
         landed — the next epoch resumes from it). Raises
@@ -327,7 +359,8 @@ class ElasticCoordinator:
             )
             self.log.append(rec)
             self._incident(
-                "shrink", rec, dead=dead_members, from_world=self.n_dev
+                "shrink", rec, dead=dead_members, from_world=self.n_dev,
+                mesh_axes=self.reshard_spec(new_world).shape_dict(),
             )
             self.log_fn(
                 f"Elastic: shrinking {self.n_dev} -> {new_world} at "
@@ -374,7 +407,10 @@ class ElasticCoordinator:
                 shard_map=self._shard_map(step, full, self._rng_crc),
             )
             self.log.append(rec)
-            self._incident("grow", rec, from_world=self.n_dev)
+            self._incident(
+                "grow", rec, from_world=self.n_dev,
+                mesh_axes=self.reshard_spec(full).shape_dict(),
+            )
             self.log_fn(
                 f"Elastic: re-admitting to the full roster "
                 f"({self.n_dev} -> {full}) at checkpoint step {step} "

@@ -1,0 +1,42 @@
+"""atomo_tpu.mesh — the explicit-sharding subsystem.
+
+One grammar for device layouts (:class:`~atomo_tpu.mesh.spec.MeshSpec`:
+degenerate 1-device, flat dp, and two-tier dp x ici meshes as points of
+the same shape space), one set of named-axis collective helpers
+(:mod:`~atomo_tpu.mesh.collectives`), the cross-replica sharded weight
+update of Xu et al. 2004.13336 (:mod:`~atomo_tpu.mesh.update`:
+sharded-persistent master weights + sharded optimizer state + sharded
+update computation, superseding ZeRO-1 as its shard-state-only
+degenerate point), and live state re-sharding for elastic reshapes
+(:mod:`~atomo_tpu.mesh.reshard`). The companion compile path that turns
+these descriptions into programs is
+:func:`atomo_tpu.parallel.compile.compile_step`.
+"""
+
+from atomo_tpu.mesh.spec import MeshSpec, spec_of_mesh
+from atomo_tpu.mesh.update import (
+    ShardedUpdateSpecs,
+    ShardedUpdateState,
+    chunk_len,
+    check_slice_invariant,
+    flat_opt_state,
+    place_sharded_update,
+    sharded_state_from_params,
+    sharded_update_state,
+)
+from atomo_tpu.mesh.reshard import reshard_plan, reshard_sharded_update
+
+__all__ = [
+    "MeshSpec",
+    "ShardedUpdateSpecs",
+    "ShardedUpdateState",
+    "check_slice_invariant",
+    "chunk_len",
+    "flat_opt_state",
+    "place_sharded_update",
+    "reshard_plan",
+    "reshard_sharded_update",
+    "sharded_state_from_params",
+    "sharded_update_state",
+    "spec_of_mesh",
+]

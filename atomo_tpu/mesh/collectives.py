@@ -1,0 +1,48 @@
+"""Named-axis collective helpers — the single source for the collectives
+the aggregation schedules place.
+
+Before the mesh subsystem, every schedule hand-placed its own
+``jax.lax.ppermute`` permutation lists and tiled ``all_gather`` calls;
+a topology plan was a recipe of raw collectives. These helpers are the
+sharding-annotated spelling: each one is a THIN, trace-identical wrapper
+over the ``jax.lax`` primitive (same op, same arguments, byte-identical
+HLO — the legacy-plan byte-identity tests pin this), so call sites
+migrate without moving a single compiled instruction, and the mesh axis
+name is the only vocabulary a schedule needs.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def ring_perm(n: int) -> list[tuple[int, int]]:
+    """The canonical ring rotation ``i -> i-1 (mod n)``: payload chunk t
+    held by chip i moves so that after t hops chip i holds source
+    ``(i + t) % n`` — the rotation every ring schedule in the repo uses
+    (ONE definition; the staging index math in _ring_stream_mean assumes
+    exactly this direction)."""
+    return [(i, (i - 1) % n) for i in range(n)]
+
+
+def ppermute_ring(x, axis: str, n: int):
+    """One ring hop of ``x`` over the named ``axis``."""
+    return jax.lax.ppermute(x, axis, ring_perm(n))
+
+
+def all_gather(x, axis):
+    """Stacking all_gather (leading source axis) over one or more named
+    axes."""
+    return jax.lax.all_gather(x, axis)
+
+
+def all_gather_tiled(x, axis):
+    """Tiled all_gather: per-chip slices concatenate along dim 0 — the
+    republish step of every sharded-segment reduction (ring segment
+    means, ZeRO-1 and sharded-update param reassembly)."""
+    return jax.lax.all_gather(x, axis, tiled=True)
+
+
+def psum_mean(x, axis):
+    """Dense mean over named data axes (the psum exchange)."""
+    return jax.lax.pmean(x, axis)

@@ -1,0 +1,155 @@
+"""Mesh description layer — ONE grammar for every device layout.
+
+Every program family in the repo runs over a ``jax.sharding.Mesh`` whose
+shape used to be re-derived ad hoc at each call site (``make_mesh(n)``
+here, ``make_mesh(n, axes=(("dp", k), ("ici", n // k)))`` there, a bare
+``n_devices`` int in the tune decision). :class:`MeshSpec` is the single
+description those sites now share:
+
+  * ``dp`` is always the first (outer, slow-fabric) data axis;
+  * ``--dcn-ways K`` declares a SECOND data axis ``ici`` (the fast
+    fabric): the mesh is ``(dp=K, ici=n/K)`` and the data-parallel world
+    is the product;
+  * the degenerate shapes are first-class, not special cases: a 1-device
+    mesh is ``dp1`` and a flat data-parallel mesh is ``dpN`` — the same
+    spec grammar, the same compile path
+    (:func:`atomo_tpu.parallel.compile.compile_step`), the same artifact
+    record.
+
+``shape_dict()`` is the artifact form (``{"dp": 2, "ici": 2}``) — the
+tune decision's ``meta.mesh_axes`` and the elastic membership record both
+carry it, and :func:`atomo_tpu.tuning.autopilot.decision_reusable`
+compares it on resume (an ``n_devices``-only check cannot tell ``dp4``
+from ``dp2 x ici2``, which are different program families).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """An ordered tuple of named mesh axes, e.g. ``(("dp", 2), ("ici", 2))``.
+
+    Immutable and hashable so it can ride static closures and dict keys;
+    build the runtime ``jax.sharding.Mesh`` with :meth:`build`.
+    """
+
+    axes: tuple[tuple[str, int], ...]
+
+    def __post_init__(self):
+        if not self.axes:
+            raise ValueError("MeshSpec needs at least one axis")
+        names = [a for a, _ in self.axes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate mesh axis names: {names}")
+        for name, size in self.axes:
+            if size < 1:
+                raise ValueError(f"mesh axis {name!r} has size {size}")
+
+    # ----------------------------------------------------------- builders
+    @classmethod
+    def from_world(cls, n_devices: int, dcn_ways: int = 0) -> "MeshSpec":
+        """The ONE resolution of (--n-devices, --dcn-ways) to a mesh shape.
+
+        ``dcn_ways`` <= 1 is the flat (or degenerate 1-device) data-parallel
+        mesh ``dpN``; ``dcn_ways`` > 1 is the two-tier ``dpK x ici(N/K)``
+        mesh the hierarchical schedules run on. The divisibility contract
+        matches the CLI preflight: K must divide N.
+        """
+        n = int(n_devices)
+        k = int(dcn_ways)
+        if n < 1:
+            raise ValueError(f"n_devices must be >= 1, got {n}")
+        if k > 1:
+            if n % k or not 1 < k <= n:
+                raise ValueError(
+                    f"dcn_ways {k} must divide n_devices {n} "
+                    "(outer slow-fabric groups x inner fast-fabric chips)"
+                )
+            return cls((("dp", k), ("ici", n // k)))
+        return cls((("dp", n),))
+
+    @classmethod
+    def from_shape_dict(cls, d) -> Optional["MeshSpec"]:
+        """Inverse of :meth:`shape_dict` for artifact round-trips.
+
+        Axis order in the artifact dict is meaningful (dp is outer);
+        returns None for a missing/empty/garbage document rather than
+        raising — resume code treats that as "old artifact, shape
+        unrecorded" and falls back to the n_devices check.
+        """
+        if not isinstance(d, dict) or not d:
+            return None
+        try:
+            axes = tuple((str(k), int(v)) for k, v in d.items())
+            return cls(axes)
+        except (TypeError, ValueError):
+            return None
+
+    # ---------------------------------------------------------- properties
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(a for a, _ in self.axes)
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for _, s in self.axes:
+            n *= s
+        return n
+
+    @property
+    def data_axes(self) -> tuple[str, ...]:
+        """The axes the batch (and the sharded update) spans: ``("dp",)``
+        flat, ``("dp", "ici")`` two-tier."""
+        return tuple(n for n in self.names if n in ("dp", "ici"))
+
+    @property
+    def inner_axis(self) -> Optional[str]:
+        return "ici" if "ici" in self.names else None
+
+    @property
+    def is_two_tier(self) -> bool:
+        return self.inner_axis is not None
+
+    @property
+    def is_degenerate(self) -> bool:
+        """One device: every collective is the identity and the sharded
+        update's slice is the whole vector — same program text, degenerate
+        shape."""
+        return self.n_devices == 1
+
+    @property
+    def is_flat(self) -> bool:
+        return not self.is_two_tier
+
+    # ----------------------------------------------------------- renderers
+    def shape_dict(self) -> dict:
+        """Artifact form: insertion-ordered ``{"dp": K, "ici": M}``."""
+        return {name: size for name, size in self.axes}
+
+    def describe(self) -> str:
+        """Human grammar: ``dp4``, ``dp2xici2`` — the string log lines and
+        bench rows print."""
+        return "x".join(f"{n}{s}" for n, s in self.axes)
+
+    def build(self, devices: Optional[Sequence["jax.Device"]] = None):
+        """Materialize the ``jax.sharding.Mesh`` (first ``n_devices`` of
+        the roster by default)."""
+        from atomo_tpu.parallel.mesh import make_mesh
+
+        return make_mesh(self.n_devices, axes=self.axes, devices=devices)
+
+
+def spec_of_mesh(mesh) -> MeshSpec:
+    """Recover the spec of an existing ``jax.sharding.Mesh`` (axis order
+    preserved) — the bridge for call sites that still hand a raw Mesh
+    around."""
+    return MeshSpec(
+        tuple((str(n), int(mesh.shape[n])) for n in mesh.axis_names)
+    )

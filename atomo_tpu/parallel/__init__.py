@@ -5,6 +5,11 @@ from atomo_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     replicated,
 )
+from atomo_tpu.parallel.compile import (  # noqa: F401
+    compile_global,
+    compile_step,
+    shardings_from_specs,
+)
 from atomo_tpu.parallel.launch import (  # noqa: F401
     HealthMonitor,
     HealthWatchdog,

@@ -1,0 +1,117 @@
+"""The ONE compile path for distributed step programs.
+
+Every program family — 1-device, dp-replicated, ZeRO-1, full
+sharded-update, two-tier hierarchical — used to assemble its own
+``jax.jit(jax.shard_map(...))`` stack inline. :func:`compile_step` is the
+single builder they now share; what varies per family is DATA (the
+PartitionSpec trees), not construction code.
+
+Two families, one function:
+
+  * **map-style** (default): ``jax.jit(jax.shard_map(fn, ...))`` with the
+    given in/out specs — exactly the construction the replicated program
+    has always used, byte-for-byte (tested: the helper's lowered text
+    equals the hand-rolled stack's). The replicated/legacy programs keep
+    their frozen HLO through this path.
+  * **explicit shardings** (``explicit_shardings=True``): the same mapped
+    body, jitted with ``in_shardings``/``out_shardings`` built from the
+    SAME spec trees as ``NamedSharding``s — the pjit form. This is the
+    sharded-update family's path: the jit boundary itself carries the
+    layout contract, so sharded-persistent master/optimizer slices stay
+    sharded across program boundaries (between superstep dispatches,
+    through donation) by annotation rather than by convention, and a
+    mis-placed input is an XLA layout error instead of a silent gather.
+
+A degenerate 1-device mesh needs no special case: ``shard_map`` over a
+size-1 axis traces the same program text with identity collectives — the
+degenerate mesh is a first-class shape of the one path (the
+:mod:`atomo_tpu.mesh` contract), not a separate single-device builder.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _is_spec(x) -> bool:
+    return isinstance(x, P)
+
+
+def shardings_from_specs(mesh: Mesh, specs) -> Any:
+    """Map a pytree of ``PartitionSpec``s (the shard_map vocabulary) to
+    the ``NamedSharding`` tree the jit boundary consumes — one spec
+    vocabulary for both halves of the compile path."""
+    return jax.tree_util.tree_map(
+        lambda sp: NamedSharding(mesh, sp), specs, is_leaf=_is_spec
+    )
+
+
+def compile_step(
+    fn,
+    mesh: Mesh,
+    *,
+    in_specs,
+    out_specs,
+    donate_argnums=(),
+    check_vma: bool = False,
+    explicit_shardings: bool = False,
+):
+    """Compile a per-chip SPMD body into the dispatchable step program.
+
+    ``in_specs``/``out_specs`` are the shard_map PartitionSpec trees.
+    With ``explicit_shardings`` the same trees additionally annotate the
+    jit boundary as ``NamedSharding``s (the pjit form — the
+    sharded-update family); without it the construction is the
+    historical ``jax.jit(jax.shard_map(...))`` byte-for-byte.
+    """
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=check_vma,
+    )
+    if not explicit_shardings:
+        return jax.jit(mapped, donate_argnums=donate_argnums)
+    return jax.jit(
+        mapped,
+        in_shardings=shardings_from_specs(mesh, in_specs),
+        out_shardings=shardings_from_specs(mesh, out_specs),
+        donate_argnums=donate_argnums,
+    )
+
+
+def compile_global(
+    fn,
+    mesh: Mesh,
+    *,
+    in_shardings=None,
+    out_shardings=None,
+    donate_argnums=(),
+):
+    """Compile a GLOBAL-view function (no per-chip body) with explicit
+    shardings — the pjit helper for whole-array programs such as
+    materializing replicated params from sharded master slices or
+    re-laying-out state between meshes. Spec trees are accepted and
+    resolved against ``mesh``; on a degenerate 1-device mesh this is a
+    plain jit (every sharding is trivial)."""
+
+    def resolve(t):
+        if t is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s) if _is_spec(s) else s,
+            t,
+            is_leaf=lambda x: _is_spec(x)
+            or isinstance(x, jax.sharding.Sharding),
+        )
+
+    kw: dict = {"donate_argnums": donate_argnums}
+    if in_shardings is not None:
+        kw["in_shardings"] = resolve(in_shardings)
+    if out_shardings is not None:
+        kw["out_shardings"] = resolve(out_shardings)
+    return jax.jit(fn, **kw)

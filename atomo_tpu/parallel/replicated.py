@@ -62,11 +62,18 @@ from atomo_tpu.codecs import (
     tree_nbytes,
 )
 from atomo_tpu.data.pipeline import augment_batch
+from atomo_tpu.mesh.update import (
+    ShardedUpdateSpecs,
+    ShardedUpdateState,
+    check_slice_invariant,
+    chunk_len,
+)
 from atomo_tpu.parallel.common import (
     pack_tree_buckets,
     plan_layer_buckets,
     unpack_tree_buckets,
 )
+from atomo_tpu.parallel.compile import compile_step
 from atomo_tpu.parallel.mesh import replicated
 from atomo_tpu.utils.tracing import PHASE_METRICS_HINT, named_phase
 from atomo_tpu.training.resilience import (
@@ -182,24 +189,30 @@ def _place_carry(
 
 
 def init_delayed_state(
-    mesh: Mesh, state: TrainState, codec, *, axis: str = "dp"
+    mesh: Mesh, state, codec, *, axis: str = "dp", params_host=None
 ) -> DelayedState:
-    """Wrap a (replicated or ZeRO-1) TrainState into the fresh
-    :class:`DelayedState` a ``--overlap delayed`` step consumes: zero
-    payload sharded over ``axis``, all-healthy flags, ``valid=0``."""
+    """Wrap a (replicated, ZeRO-1, or sharded-update) state into the
+    fresh :class:`DelayedState` a ``--overlap delayed`` step consumes:
+    zero payload sharded over ``axis``, all-healthy flags, ``valid=0``.
+    ``params_host`` supplies the parameter PYTREE when ``state`` does not
+    expose it as one (a sharded-update state's ``.params`` is the flat
+    master vector — pass ``specs.materialize_host(state.master)``)."""
     n_dev = mesh.shape[axis]
-    carry = _zero_carry_host(codec, jax.device_get(state.params), n_dev)
+    if params_host is None:
+        params_host = jax.device_get(state.params)
+    carry = _zero_carry_host(codec, params_host, n_dev)
     return DelayedState(
         train=state, carry=_place_carry(mesh, carry, axis=axis)
     )
 
 
 def _zero1_chunk(flat_size: int, n_dev: int) -> int:
-    """Per-chip slice length of the flat ZeRO-1 buffers. ONE definition:
+    """Per-chip slice length of the flat ZeRO-1 buffers. ONE definition
+    (mesh.update.chunk_len — shared with the full sharded-update family):
     the train step's dynamic slices and zero1_state's allocations must
     agree exactly or every momentum slice silently misaligns with its
     parameter slice."""
-    return -(-flat_size // n_dev)
+    return chunk_len(flat_size, n_dev)
 
 
 def _zero1_sliced_update(
@@ -226,6 +239,38 @@ def _zero1_sliced_update(
     new_sl = optax.apply_updates(p_sl, updates)
     new_flat = jax.lax.all_gather(new_sl, gather_axes, tiled=True)
     return unravel(new_flat[: flat_p.size]), new_opt
+
+
+def _sharded_slice_update(optimizer, master_sl, opt_state, mean_grads, my,
+                          su: ShardedUpdateSpecs):
+    """Cross-replica sharded weight update (mesh.update, 2004.13336):
+    slice the aggregated mean gradient to this chip's chunk and update
+    the PERSISTENTLY sharded (master-slice, opt-slice) pair — the ZeRO-1
+    sliced update without its closing param all_gather, because the next
+    step re-materializes the working params itself. Returns
+    (new_master_slice, new_opt_slice)."""
+    from jax.flatten_util import ravel_pytree
+
+    flat_g, _ = ravel_pytree(mean_grads)
+    pad = su.chunk * su.n_shards - su.d_flat
+    g_pad = jnp.pad(flat_g, (0, pad))
+    g_sl = jax.lax.dynamic_slice(g_pad, (my * su.chunk,), (su.chunk,))
+    updates, new_opt = optimizer.update(g_sl, opt_state, master_sl)
+    return optax.apply_updates(master_sl, updates), new_opt
+
+
+def _materialize_params(sstate: ShardedUpdateState,
+                        su: ShardedUpdateSpecs):
+    """In-graph transient materialization of the working params from the
+    sharded-persistent master slices: one tiled all_gather reassembles
+    the exact replicated bytes (slices concatenate losslessly), the
+    padding is trimmed, and the flat vector unravels to the tree the
+    forward consumes. The dense model exists only inside the step."""
+    with named_phase("materialize_params"):
+        full = jax.lax.all_gather(
+            sstate.master, su.gather_axes, tiled=True
+        )
+        return su.unravel(full[: su.d_flat])
 
 
 def _mask_gathered(gathered, okg):
@@ -317,7 +362,10 @@ def _ring_stream_mean(
     ok_buf = (
         ok.astype(jnp.float32).reshape(1) if guard_on else jnp.zeros((1,))
     )
-    perm = [(i, (i - 1) % n_dev) for i in range(n_dev)]
+    # the canonical rotation, ONE definition (mesh.collectives.ring_perm)
+    from atomo_tpu.mesh.collectives import ring_perm
+
+    perm = ring_perm(n_dev)
 
     def decode_slice(bufs_t, ok_t):
         payload_t = unpack_tree_buckets(bufs_t, spec)
@@ -631,9 +679,43 @@ def make_distributed_train_step(
     survivor_exact: bool = False,
     plan=None,
     hybrid=None,
+    sharded_update: Optional[ShardedUpdateSpecs] = None,
     _oracle_parts: bool = False,
 ):
     """Build the jitted SPMD train step over ``mesh``.
+
+    ``sharded_update`` (mesh.update.ShardedUpdateSpecs, from
+    :func:`atomo_tpu.mesh.sharded_update_state`) switches the program to
+    the cross-replica sharded weight update of Xu et al. 2004.13336: the
+    step takes and returns a :class:`~atomo_tpu.mesh.update
+    .ShardedUpdateState` whose master weights AND optimizer state live
+    persistently sharded over the data axes; the working params are
+    materialized transiently in-graph (one tiled all_gather of exact
+    slices — byte-identical to the replicated params), the gradient
+    compute/encode/exchange/decode chain is the IDENTICAL program text
+    as the replicated step's, and the optimizer update runs on this
+    chip's (grad, master, opt) slice triple (the ZeRO-1 sliced update
+    without its closing param gather). Trajectories are bit-identical
+    to the replicated program per codec in the CANONICAL decode order —
+    measured: psum/dense, gather and ring for qsgd, ring and unfused
+    gather for svd, superstep, stream-encode, two-tier hierarchical and
+    the delayed ring all match bit for bit; the fused-SVD gather and
+    the guarded / delayed-gather compositions track replicated to XLA's
+    last-mantissa cross-program fusion drift (~1e-8, the documented
+    ring-vs-gather / scan-vs-standalone class — the restructured
+    program fuses the same arithmetic differently). The
+    slice-invariance probe at state-build time is the validity
+    condition, exactly as for ZeRO-1 — which this mode supersedes as
+    its shard-state-only degenerate point. The program compiles through the explicit-sharding (pjit)
+    half of :func:`atomo_tpu.parallel.compile.compile_step`, so the
+    sharded layout is a jit-boundary annotation, not a convention.
+    Composes with gather/ring/psum/hierarchical aggregation, the guard,
+    chaos, superstep, grad_accum, num_aggregate, stream_encode and —
+    unlike ZeRO-1 — ``overlap='delayed'`` (the in-flight payload is just
+    another sharded carry leaf next to the master slices; checkpoints
+    hold both, so kill->restart->resume is bit-exact). Mutually
+    exclusive with ``zero1_specs``; hybrid/elastic modes are rejected
+    honestly below.
 
     ``hybrid`` (sparse.hybrid.HybridPlan; flat blocking gather/ring with
     a codec only) arms the per-layer hybrid exchange: sparse-assigned
@@ -1018,6 +1100,43 @@ def make_distributed_train_step(
                 "rotating replica subset is not wired into the row "
                 "exchange"
             )
+    su = sharded_update
+    if su is not None:
+        if zero1_specs is not None:
+            raise ValueError(
+                "sharded_update supersedes zero1 (ZeRO-1 is its "
+                "shard-state-only degenerate point); pass one, not both"
+            )
+        if hybrid is not None:
+            raise ValueError(
+                "sharded_update does not compose with hybrid= yet: the "
+                "per-layer row exchange is untested against the flat "
+                "master layout — run hybrid with the replicated or "
+                "zero1 update"
+            )
+        if track_ok_bits or survivor_exact:
+            raise ValueError(
+                "sharded_update does not compose with elastic membership "
+                "(track_ok_bits/survivor_exact): a reshape re-shards the "
+                "live state via mesh.reshard instead — the elastic loop "
+                "runs the replicated update"
+            )
+        if _oracle_parts:
+            raise ValueError(
+                "_oracle_parts drives the replicated delayed oracle; the "
+                "sharded-update delayed program is drilled against the "
+                "replicated trajectory instead (bit-identical per codec)"
+            )
+        expect_axes = (
+            (axis, inner_axis) if hierarchical and inner_axis else (axis,)
+        )
+        if tuple(su.axes) != tuple(expect_axes):
+            raise ValueError(
+                f"sharded_update specs shard over axes {su.axes} but this "
+                f"step's data axes are {expect_axes} — build the state "
+                "with sharded_update_state(mesh, ..., axis="
+                f"{expect_axes if len(expect_axes) > 1 else axis!r})"
+            )
     batch_axes = (axis, inner_axis) if hierarchical else axis
     metric_axes = batch_axes
 
@@ -1119,6 +1238,18 @@ def make_distributed_train_step(
         return jnp.sqrt(global_sq_norm(grads))
 
     def spmd_step(state: TrainState, key, images, labels):
+        sstate = None
+        if su is not None:
+            # sharded-persistent master: materialize the working params
+            # transiently (exact bytes of the replicated params), then
+            # run the UNCHANGED replicated program text on the view
+            sstate = state
+            state = TrainState(
+                step=sstate.step,
+                params=_materialize_params(sstate, su),
+                batch_stats=sstate.batch_stats,
+                opt_state=None,
+            )
         my, k_codec, grads, loss, prec1, prec5, new_stats = compute_grads(
             state, key, images, labels
         )
@@ -1339,7 +1470,17 @@ def make_distributed_train_step(
             from atomo_tpu.training.resilience import apply_remedy
 
             mean_grads = apply_remedy(remedy, state.step, mean_grads)
-        if zero1_specs is None:
+        new_params = None
+        if su is not None:
+            # cross-replica sharded weight update: this chip's slice
+            # triple only; no closing param gather — the next step's
+            # materialize is the reassembly point
+            with named_phase("sharded_update"):
+                new_master, new_opt = _sharded_slice_update(
+                    optimizer, sstate.master, sstate.opt_state,
+                    mean_grads, my, su,
+                )
+        elif zero1_specs is None:
             # replicated optimizer update == the PS-side momentum SGD step
             updates, new_opt = optimizer.update(
                 mean_grads, state.opt_state, state.params
@@ -1381,8 +1522,14 @@ def make_distributed_train_step(
                 lambda s: _healthy_mean(s, ok, kept_chips, metric_axes),
                 new_stats,
             )
-            new_params = select_state(ok_step, new_params, state.params)
-            new_opt = select_state(ok_step, new_opt, state.opt_state)
+            if su is not None:
+                # skip holds the sharded slices exactly as the replicated
+                # skip holds the full tree
+                new_master = select_state(ok_step, new_master, sstate.master)
+                new_opt = select_state(ok_step, new_opt, sstate.opt_state)
+            else:
+                new_params = select_state(ok_step, new_params, state.params)
+                new_opt = select_state(ok_step, new_opt, state.opt_state)
             new_stats = select_state(ok_step, new_stats, state.batch_stats)
             metrics = {
                 "loss": _healthy_mean(loss, ok, kept_chips, metric_axes),
@@ -1433,21 +1580,32 @@ def make_distributed_train_step(
                     if guard is None
                     else _healthy_mean(q_v, ok, kept_chips, metric_axes)
                 )
-        new_state = TrainState(
-            step=state.step + 1,
-            params=new_params,
-            batch_stats=new_stats,
-            opt_state=new_opt,
-        )
+        if su is not None:
+            new_state = ShardedUpdateState(
+                step=state.step + 1,
+                master=new_master,
+                batch_stats=new_stats,
+                opt_state=new_opt,
+            )
+        else:
+            new_state = TrainState(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=new_stats,
+                opt_state=new_opt,
+            )
         return new_state, metrics
 
-    state_spec = (
-        P()
-        if zero1_specs is None
-        else TrainState(
-            step=P(), params=P(), batch_stats=P(), opt_state=zero1_specs
+    if su is not None:
+        state_spec = su.state_spec()
+    else:
+        state_spec = (
+            P()
+            if zero1_specs is None
+            else TrainState(
+                step=P(), params=P(), batch_stats=P(), opt_state=zero1_specs
+            )
         )
-    )
     if overlap == "delayed":
         n_contrib_d = k_agg or n_dev
 
@@ -1522,7 +1680,8 @@ def make_distributed_train_step(
             return payload_x, ok_x, stats_x, pm
 
         def delayed_apply(
-            state: TrainState, prev_payload, prev_ok, valid, stats_x, ok_now_x
+            state: TrainState, prev_payload, prev_ok, valid, stats_x,
+            ok_now_x, master_sl=None, opt_sl=None,
         ):
             """Consume the carried payload: exchange -> decode-mean ->
             optimizer update, all computed from STEP-START values only.
@@ -1544,12 +1703,22 @@ def make_distributed_train_step(
             self-contained ZeRO-1 update block was safe to share
             (_zero1_sliced_update)."""
             my = jax.lax.axis_index(axis)
-            params, opt_state, prev_payload, prev_ok, valid = (
-                jax.lax.optimization_barrier(
-                    (state.params, state.opt_state, prev_payload, prev_ok,
-                     valid)
+            if su is not None:
+                # the sharded slices join the pinned step-start boundary:
+                # the consume chain reads ONLY carried values
+                params, opt_state, master_sl, prev_payload, prev_ok, valid = (
+                    jax.lax.optimization_barrier(
+                        (state.params, opt_sl, master_sl, prev_payload,
+                         prev_ok, valid)
+                    )
                 )
-            )
+            else:
+                params, opt_state, prev_payload, prev_ok, valid = (
+                    jax.lax.optimization_barrier(
+                        (state.params, state.opt_state, prev_payload, prev_ok,
+                         valid)
+                    )
+                )
             prev_ok_s = prev_ok[0]
             # the subset rotation follows the PRODUCING step's counter
             # (this payload was encoded at state.step - 1), matching the
@@ -1610,7 +1779,13 @@ def make_distributed_train_step(
                 # the update applied HERE is the remedy's subject, so the
                 # ramp follows this (consuming) step's counter
                 mean_grads = apply_remedy(remedy, state.step, mean_grads)
-            if zero1_specs is None:
+            new_params = None
+            if su is not None:
+                with named_phase("sharded_update"):
+                    new_master, new_opt = _sharded_slice_update(
+                        optimizer, master_sl, opt_state, mean_grads, my, su
+                    )
+            elif zero1_specs is None:
                 updates, new_opt = optimizer.update(
                     mean_grads, opt_state, params
                 )
@@ -1622,7 +1797,10 @@ def make_distributed_train_step(
             consume_ok = valid > 0  # step 0: nothing in flight -> skip
             if guard is not None:
                 consume_ok = jnp.logical_and(consume_ok, kept > 0)
-            new_params = select_state(consume_ok, new_params, params)
+            if su is not None:
+                new_master = select_state(consume_ok, new_master, master_sl)
+            else:
+                new_params = select_state(consume_ok, new_params, params)
             new_opt = select_state(consume_ok, new_opt, opt_state)
             # BN stats come from THIS step's forward; they apply when the
             # consumed update applies (and, under the guard, only if this
@@ -1652,12 +1830,20 @@ def make_distributed_train_step(
                     else jnp.float32(0.0)
                 ),
             }
-            new_train = TrainState(
-                step=state.step + 1,
-                params=new_params,
-                batch_stats=new_stats,
-                opt_state=new_opt,
-            )
+            if su is not None:
+                new_train = ShardedUpdateState(
+                    step=state.step + 1,
+                    master=new_master,
+                    batch_stats=new_stats,
+                    opt_state=new_opt,
+                )
+            else:
+                new_train = TrainState(
+                    step=state.step + 1,
+                    params=new_params,
+                    batch_stats=new_stats,
+                    opt_state=new_opt,
+                )
             return new_train, am
 
         if _oracle_parts:
@@ -1672,31 +1858,44 @@ def make_distributed_train_step(
                     state, prev, ok_x, valid, stats_x, ok_now_x
                 )
 
-            produce_j = jax.jit(jax.shard_map(
-                delayed_produce, mesh=mesh,
+            produce_j = compile_step(
+                delayed_produce, mesh,
                 in_specs=(state_spec, P(), P(axis), P(axis)),
                 out_specs=(P(axis), P(axis), P(axis), P()),
                 check_vma=False,
-            ))
-            apply_j = jax.jit(jax.shard_map(
-                apply_prog, mesh=mesh,
+            )
+            apply_j = compile_step(
+                apply_prog, mesh,
                 in_specs=(state_spec, P(axis), P(axis), P(), P(axis),
                           P(axis)),
                 out_specs=(state_spec, P()),
                 check_vma=False,
-            ))
+            )
             return {"produce": produce_j, "apply": apply_j}
 
         def spmd_delayed(d: DelayedState, key, images, labels):
+            train = d.train
+            master_sl = opt_sl = None
+            if su is not None:
+                # materialize once; produce and apply both read the same
+                # transient working params (exact replicated bytes)
+                sstate = train
+                train = TrainState(
+                    step=sstate.step,
+                    params=_materialize_params(sstate, su),
+                    batch_stats=sstate.batch_stats,
+                    opt_state=None,
+                )
+                master_sl, opt_sl = sstate.master, sstate.opt_state
             payload_x, ok_x, stats_x, pm = delayed_produce(
-                d.train, key, images, labels
+                train, key, images, labels
             )
             prev_payload = jax.tree_util.tree_map(
                 lambda a: jnp.squeeze(a, 0), d.carry.payload
             )
             new_train, am = delayed_apply(
-                d.train, prev_payload, d.carry.ok, d.carry.valid, stats_x,
-                ok_x,
+                train, prev_payload, d.carry.ok, d.carry.valid, stats_x,
+                ok_x, master_sl=master_sl, opt_sl=opt_sl,
             )
             new_d = DelayedState(
                 train=new_train,
@@ -1721,14 +1920,17 @@ def make_distributed_train_step(
         else:
             spmd_fn_d = spmd_delayed
             data_spec_d = P(axis)
-        sharded_d = jax.shard_map(
-            spmd_fn_d,
-            mesh=mesh,
+        # ONE compile path (parallel.compile): map-style construction is
+        # byte-for-byte the historical jit(shard_map) stack; the
+        # sharded-update family adds explicit pjit boundary shardings
+        return compile_step(
+            spmd_fn_d, mesh,
             in_specs=(d_spec, P(), data_spec_d, data_spec_d),
             out_specs=(d_spec, P()),
+            donate_argnums=(0,),
             check_vma=False,
+            explicit_shardings=su is not None,
         )
-        return jax.jit(sharded_d, donate_argnums=(0,))
     if superstep > 1:
         # fused block variant: scan the per-step SPMD body INSIDE the
         # shard_map, so the K steps (collectives included) compile into
@@ -1744,18 +1946,21 @@ def make_distributed_train_step(
     else:
         spmd_fn = spmd_step
         data_spec = P(batch_axes)
-    sharded = jax.shard_map(
-        spmd_fn,
-        mesh=mesh,
+    # ONE compile path (parallel.compile): map-style construction is
+    # byte-for-byte the historical jit(shard_map) stack; the
+    # sharded-update family adds explicit pjit boundary shardings.
+    # decoded-mean of identically gathered payloads is replicated by
+    # construction; the vma tracker cannot see that through all_gather,
+    # so replication checking is disabled (correctness is covered by
+    # tests/test_distributed.py::test_replicas_stay_identical).
+    return compile_step(
+        spmd_fn, mesh,
         in_specs=(state_spec, P(), data_spec, data_spec),
         out_specs=(state_spec, P()),
-        # decoded-mean of identically gathered payloads is replicated by
-        # construction; the vma tracker cannot see that through all_gather,
-        # so replication checking is disabled (correctness is covered by
-        # tests/test_distributed.py::test_replicas_stay_identical).
+        donate_argnums=(0,),
         check_vma=False,
+        explicit_shardings=su is not None,
     )
-    return jax.jit(sharded, donate_argnums=(0,))
 
 
 def make_delayed_oracle_steps(
@@ -1887,12 +2092,9 @@ def make_phase_train_steps(
         )
 
     def sm(fn, in_specs, out_specs, donate=()):
-        return jax.jit(
-            jax.shard_map(
-                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False,
-            ),
-            donate_argnums=donate,
+        return compile_step(
+            fn, mesh, in_specs=in_specs, out_specs=out_specs,
+            donate_argnums=donate, check_vma=False,
         )
 
     fns = {
@@ -1930,14 +2132,12 @@ def make_distributed_eval_step(model, mesh: Mesh, axis="dp"):
         }
 
     spec = P(tuple(axis)) if isinstance(axis, (tuple, list)) else P(axis)
-    return jax.jit(
-        jax.shard_map(
-            spmd_eval,
-            mesh=mesh,
-            in_specs=(P(), P(), spec, spec),
-            out_specs=P(),
-            check_vma=False,
-        )
+    return compile_step(
+        spmd_eval,
+        mesh,
+        in_specs=(P(), P(), spec, spec),
+        out_specs=P(),
+        check_vma=False,
     )
 
 
@@ -1968,6 +2168,7 @@ def distributed_train_loop(
     profile_steps: int = 3,
     compute_dtype=None,
     zero1: bool = False,
+    sharded_update: bool = False,
     grad_accum: int = 1,
     inner_axis: Optional[str] = None,
     guard=None,
@@ -2089,7 +2290,20 @@ def distributed_train_loop(
     conflict matrix); the doctor's densify window runs all-dense (dense
     psum has no per-leaf payload path — the stream-encode precedent),
     and the quality meta record gains the plan's per-layer density and
-    assignment columns."""
+    assignment columns.
+
+    ``sharded_update`` (``--partition sharded-update``) runs the
+    cross-replica sharded weight update (mesh.update, 2004.13336):
+    master weights AND optimizer state persist sharded over the data
+    axes, the update computation runs per-slice, and checkpoints hold
+    the gathered host layout so resume — INCLUDING a ``--overlap
+    delayed`` resume with its in-flight payload, the historical ZeRO-1
+    dead end — is bit-exact. Trajectories are bit-identical to the
+    replicated loop per codec in the canonical decode order (see
+    make_distributed_train_step for the fused-SVD/guarded-gather
+    fusion-drift caveat). Rejects --phase-metrics, --elastic,
+    --on-diverge and --sparse-rows honestly (see the in-loop messages);
+    supersedes ``zero1``."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import (
         SUPERVISED_ENV,
@@ -2124,8 +2338,11 @@ def distributed_train_loop(
         if zero1 and resume:
             raise ValueError(
                 "--overlap delayed cannot resume a --zero1 run (the "
-                "sharded optimizer template cannot carry the overlap "
-                "payload); drop --resume or --zero1"
+                "legacy sharded optimizer template cannot carry the "
+                "overlap payload); drop --resume or --zero1 — or use "
+                "--partition sharded-update, whose checkpoints hold the "
+                "in-flight payload as a sharded carry leaf and resume "
+                "bit-exact"
             )
     if tuner is not None and phase_metrics:
         raise ValueError(
@@ -2213,6 +2430,37 @@ def distributed_train_loop(
         )
         if reason:
             raise ValueError(reason)
+    if sharded_update:
+        if zero1:
+            raise ValueError(
+                "--partition sharded-update supersedes --zero1 (ZeRO-1 "
+                "is its shard-state-only degenerate point); pass one"
+            )
+        if phase_metrics:
+            raise ValueError(
+                "--partition sharded-update is not supported with "
+                "--phase-metrics (the phased update program assumes a "
+                "replicated optimizer state)" + PHASE_METRICS_HINT
+            )
+        if elastic is not None:
+            raise ValueError(
+                "--elastic runs the replicated update for now: a "
+                "membership reshape re-shards live state via "
+                "mesh.reshard, which the elastic loop does not drive "
+                "yet — drop --partition sharded-update"
+            )
+        if diverge is not None:
+            raise ValueError(
+                "--on-diverge rollback rebuilds replicated templates and "
+                "cannot re-thread the sharded master layout yet; drop "
+                "--partition sharded-update or --on-diverge"
+            )
+        if hybrid is not None:
+            raise ValueError(
+                "--partition sharded-update does not compose with "
+                "--sparse-rows yet (the row exchange is untested against "
+                "the flat master layout)"
+            )
     chaos = resolve_chaos(chaos)
     if chaos is not None:
         chaos.maybe_die_crashloop()  # crashloop@M: attempt-keyed death
@@ -2222,9 +2470,130 @@ def distributed_train_loop(
     )
     start_step = 0
     zero1_specs = None
+    su_specs = None
     delayed_carry_host = None  # restored in-flight payload (delayed resume)
     want_resume = resume and train_dir and latest_step(train_dir) is not None
-    if zero1:
+    if sharded_update:
+        from atomo_tpu.mesh.update import (
+            place_sharded_update,
+            sharded_state_from_params,
+            sharded_update_state,
+        )
+
+        su_axes = (
+            ("dp", inner_axis)
+            if aggregate == "hierarchical" and inner_axis
+            else "dp"
+        )
+        s_state, su_specs = sharded_update_state(
+            mesh, jax.device_get(state), optimizer, axis=su_axes
+        )
+        host_params_tpl = jax.device_get(state.params)
+        restored = None
+        if want_resume:
+            # the template a sharded-update checkpoint restores onto:
+            # the SAME state-dict layout the run saves (master slices
+            # gather to one flat host vector under device_get), with the
+            # in-flight payload alongside when delayed — this is what
+            # dissolves the zero1 x delayed dead end
+            template = jax.device_get(s_state)
+            if overlap == "delayed":
+                template = DelayedState(
+                    train=template,
+                    carry=_zero_carry_host(
+                        codec, host_params_tpl, mesh.shape["dp"]
+                    ),
+                )
+            master_shape = tuple(s_state.master.shape)
+
+            def _reject_master_shape(got):
+                raise ValueError(
+                    "--partition sharded-update resume: checkpoint master "
+                    f"vector has shape {tuple(got)} but this model/mesh "
+                    f"expects {master_shape} — the mesh shape changed; "
+                    "re-shard via mesh.reshard or restart without "
+                    "--resume"
+                )
+
+            try:
+                restored = load_checkpoint(train_dir, template)
+            except FileNotFoundError as exc:
+                log_fn(f"Resume requested but {exc}; starting fresh")
+            except (KeyError, ValueError) as exc:
+                # foreign layout. Three known shapes: (a) a sharded-family
+                # checkpoint whose carry wrapper mismatches (a delayed
+                # checkpoint resumed blocking, or vice versa) — restore
+                # the sharded train state, the carry re-zeros (a delayed
+                # resume then re-skips its first step, the blocking one
+                # discards the payload — warned either way); (b) a
+                # replicated-family checkpoint (plain or delayed) —
+                # params carry over, the sharded optimizer state
+                # re-initializes, the ZeRO-1 fallback out loud; (c)
+                # anything else is genuinely foreign and surfaces.
+                import warnings
+
+                from flax import serialization
+
+                from atomo_tpu.training.checkpoint import _read_state_dict
+
+                d = _read_state_dict(train_dir, None)
+                inner = d.get("train", d)
+                if "master" in inner:
+                    warnings.warn(
+                        "--partition sharded-update resume: checkpoint "
+                        f"overlap-carry layout does not match ({exc}); "
+                        "restoring the sharded train state only — any "
+                        "in-flight payload is discarded (a delayed "
+                        "resume re-skips its first step)"
+                    )
+                    train_restored = serialization.from_state_dict(
+                        jax.device_get(s_state), inner
+                    )
+                    if tuple(jnp.shape(train_restored.master)) != \
+                            master_shape:
+                        _reject_master_shape(
+                            jnp.shape(train_restored.master)
+                        )
+                    s_state = place_sharded_update(
+                        mesh, train_restored, su_specs
+                    )
+                    start_step = int(train_restored.step)
+                elif "params" in inner:
+                    warnings.warn(
+                        "--partition sharded-update resume: checkpoint "
+                        f"layout does not match ({exc}); restoring "
+                        "params only, optimizer state re-initialized "
+                        "sharded"
+                    )
+                    host_rep = jax.device_get(state)
+                    ck_params = serialization.from_state_dict(
+                        host_rep.params, inner["params"]
+                    )
+                    ck_stats = serialization.from_state_dict(
+                        host_rep.batch_stats, inner.get("batch_stats", {})
+                    )
+                    ck_step = int(inner.get("step", 0))
+                    s_state, su_specs = sharded_state_from_params(
+                        mesh, ck_params, ck_stats, ck_step, optimizer,
+                        axis=su_axes,
+                    )
+                    start_step = int(ck_step)
+                else:
+                    raise  # genuinely foreign layout: surface the original
+                log_fn(f"Resumed from {train_dir} at step {start_step}")
+        if restored is not None:
+            train_restored = (
+                restored.train if overlap == "delayed" else restored
+            )
+            if tuple(jnp.shape(train_restored.master)) != master_shape:
+                _reject_master_shape(jnp.shape(train_restored.master))
+            s_state = place_sharded_update(mesh, train_restored, su_specs)
+            if overlap == "delayed":
+                delayed_carry_host = restored.carry
+            start_step = int(train_restored.step)
+            log_fn(f"Resumed from {train_dir} at step {start_step}")
+        state = s_state
+    elif zero1:
         z_axes = (
             ("dp", inner_axis)
             if aggregate == "hierarchical" and inner_axis
@@ -2372,7 +2741,16 @@ def distributed_train_loop(
                 carry=_place_carry(mesh, delayed_carry_host),
             )
         else:
-            state = init_delayed_state(mesh, state, codec)
+            state = init_delayed_state(
+                mesh, state, codec,
+                # a sharded-update state's .params is the flat master
+                # vector; the carry template needs the parameter PYTREE
+                params_host=(
+                    su_specs.materialize_host(state.master)
+                    if su_specs is not None
+                    else None
+                ),
+            )
     if superstep < 1:
         raise ValueError(f"superstep must be >= 1, got {superstep}")
     if phase_metrics:
@@ -2441,7 +2819,8 @@ def distributed_train_loop(
                 None if densify else codec,
                 aggregate=agg_cell["mode"], augment=augment,
                 num_aggregate=num_aggregate, compute_dtype=compute_dtype,
-                zero1_specs=zero1_specs, grad_accum=grad_accum,
+                zero1_specs=zero1_specs, sharded_update=su_specs,
+                grad_accum=grad_accum,
                 inner_axis=inner_axis, guard=guard, chaos=chaos_now,
                 superstep=superstep, ring_bucket_size=ring_bucket_size,
                 overlap="off" if densify else overlap,
@@ -2467,6 +2846,16 @@ def distributed_train_loop(
         if test_iter is not None
         else None
     )
+    if eval_fn is not None and su_specs is not None:
+        # eval consumes the parameter PYTREE; a sharded-update state
+        # hands the loop its flat master vector — materialize at the
+        # (infrequent) eval boundary rather than persist a dense copy
+        _su_eval = eval_fn
+
+        def eval_fn(params, stats, si, sl):
+            return _su_eval(
+                su_specs.materialize_host(params), stats, si, sl
+            )
     key = jax.random.PRNGKey(seed + 1)
     timer = Timer()
     # replay: skip the batches the interrupted run consumed so the resumed
@@ -2581,7 +2970,13 @@ def distributed_train_loop(
             # its per-layer measured-density and assignment columns
             recorder.write_meta(
                 quality_meta(
-                    codec, jax.device_get(state.params), hybrid=hybrid
+                    codec,
+                    (
+                        su_specs.materialize_host(state.params)
+                        if su_specs is not None
+                        else jax.device_get(state.params)
+                    ),
+                    hybrid=hybrid,
                 )
             )
     # superstep mode beats the watchdog once per BLOCK: scale the budget
@@ -3162,32 +3557,10 @@ def _check_sliceable(optimizer, n_dev: int, dtype) -> None:
     wrong. The probe sweeps gradient SCALES (1, 1e4, 1e-4) because
     threshold-gated mixing only activates at some magnitudes — a
     clip_by_global_norm(10.0) is invisible to a unit-scale probe but fires
-    on the 1e4-scale one."""
-    probe_n = 8 * n_dev
-    pk, gk = jax.random.split(jax.random.PRNGKey(17))
-    p_full = jax.random.normal(pk, (probe_n,), dtype)
-    g_base = jax.random.normal(gk, (probe_n,), dtype)
-    chunk = probe_n // n_dev
-    for scale in (1.0, 1e4, 1e-4):
-        g_full = g_base * scale
-        u_full, _ = optimizer.update(g_full, optimizer.init(p_full), p_full)
-        parts = []
-        for i in range(n_dev):
-            p_i = p_full[i * chunk:(i + 1) * chunk]
-            g_i = g_full[i * chunk:(i + 1) * chunk]
-            u_i, _ = optimizer.update(g_i, optimizer.init(p_i), p_i)
-            parts.append(u_i)
-        ref = jnp.concatenate(parts)
-        tol = 1e-5 * float(jnp.max(jnp.abs(u_full))) + 1e-12
-        if not jnp.allclose(u_full, ref, rtol=1e-5, atol=tol):
-            raise ValueError(
-                "zero1_state: this optimizer's update is not slice-invariant "
-                f"(at gradient scale {scale:g}, a sliced update differs from "
-                "the slice of the full update — e.g. a global-norm clip in "
-                "the chain). ZeRO-1 sharding would train silently wrong; use "
-                "the replicated optimizer path or an elementwise chain "
-                "(sgd/momentum/adam/wd)."
-            )
+    on the 1e4-scale one. ONE definition for the whole sharded-update
+    family now (mesh.update.check_slice_invariant) — ZeRO-1 and the full
+    sharded-update share the same validity condition."""
+    check_slice_invariant(optimizer, n_dev, dtype)
 
 
 def zero1_state(
@@ -3219,6 +3592,8 @@ def zero1_state(
     """
     from jax.flatten_util import ravel_pytree
 
+    from atomo_tpu.mesh.update import flat_opt_state
+
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
     n = 1
     for a in axes:
@@ -3226,20 +3601,11 @@ def zero1_state(
     flat, _ = ravel_pytree(state.params)
     _check_sliceable(optimizer, n, flat.dtype)
     chunk = _zero1_chunk(flat.size, n)
-    local = optimizer.init(jnp.zeros((chunk,), flat.dtype))
-
-    def glob(leaf):
-        leaf = jnp.asarray(leaf)
-        if leaf.ndim == 0:  # counts etc.: replicated scalars
-            return jax.device_put(leaf, NamedSharding(mesh, P()))
-        # identical zero-init per shard; stored as one (n*chunk,) global
-        return jax.device_put(
-            jnp.tile(leaf, n), NamedSharding(mesh, P(axes))
-        )
-
-    opt_global = jax.tree_util.tree_map(glob, local)
-    opt_specs = jax.tree_util.tree_map(
-        lambda l: P(axes) if jnp.asarray(l).ndim else P(), local
+    # ONE construction of the flat sharded optimizer layout, shared with
+    # the full sharded-update family (mesh.update.flat_opt_state)
+    opt_global, opt_specs = flat_opt_state(
+        mesh, optimizer, chunk=chunk, n_shards=n, axes=axes,
+        dtype=flat.dtype,
     )
     new_state = TrainState(
         step=jax.device_put(state.step, replicated(mesh)),

@@ -108,6 +108,11 @@ def planned_two_level_mean(
     import jax.numpy as jnp
 
     from atomo_tpu.codecs import decode_mean_tree, encode_tree, tree_nbytes
+    # the named-axis collective vocabulary (mesh.collectives): plans place
+    # sharding-annotated collectives through ONE helper set rather than
+    # hand-rolled jax.lax calls — trace-identical wrappers, so the
+    # per-plan byte/bit-identity contracts are untouched (tested)
+    from atomo_tpu.mesh.collectives import all_gather, psum_mean
     from atomo_tpu.parallel.replicated import _mask_gathered, _ring_stream_mean
     from atomo_tpu.training.resilience import (
         grad_ok,
@@ -117,7 +122,7 @@ def planned_two_level_mean(
 
     # ---- inner stage: reduce over the fast tier ----------------------
     if plan.inner == "psum":
-        grads_in = jax.lax.pmean(grads, inner_axis)
+        grads_in = psum_mean(grads, inner_axis)
     else:  # cring: compressed ring over the fast tier, per-chip keys
         payloads_in, _ = encode_tree(codec, k_inner, grads)
         grads_in, _ = _ring_stream_mean(
@@ -146,7 +151,7 @@ def planned_two_level_mean(
             kept = jax.lax.psum(ok.astype(jnp.float32), axis)
             mean_grads = masked_mean(grads_in, ok, kept, axis)
         else:
-            mean_grads = jax.lax.pmean(grads_in, axis)
+            mean_grads = psum_mean(grads_in, axis)
         return mean_grads, ok, kept, dense_bytes
 
     # boundary re-encode: FRESH outer-keyed draw over the inner estimate
@@ -154,9 +159,9 @@ def planned_two_level_mean(
     payloads, stats = encode_tree(codec, k_outer, grads_in)
     msg_bytes = stats.payload_bytes
     if plan.outer == "gather":
-        gathered = jax.lax.all_gather(payloads, axis)
+        gathered = all_gather(payloads, axis)
         if guard is not None:
-            okg = jax.lax.all_gather(ok.astype(jnp.float32), axis)
+            okg = all_gather(ok.astype(jnp.float32), axis)
             kept = jnp.sum(okg)
             mean_grads = rescale_by_survivors(
                 decode_mean_tree(

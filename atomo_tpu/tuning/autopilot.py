@@ -190,6 +190,7 @@ def tune(
     probe_reps: int = 2,
     num_aggregate: int = 0,
     zero1: bool = False,
+    partition: str = "replicated",
     grad_accum: int = 1,
     compute_dtype=None,
     codec_tax_s: Optional[float] = None,
@@ -314,10 +315,23 @@ def tune(
             hybrid.leaf_budgets() if hybrid is not None else None
         ),
     )
+    from atomo_tpu.mesh import MeshSpec
+
     pb = probe_batch_size(batch, n_dev)
     meta = {
         "backend": backend,
         "n_devices": n_dev,
+        # the PROBED mesh's named-axis shape (insertion-ordered dict):
+        # decision_reusable compares it on resume — an n_devices-only
+        # check cannot tell dp4 from dp2 x ici2, which are different
+        # program families
+        "mesh_axes": MeshSpec.from_world(
+            n_dev, dcn_ways if two_tier else 0
+        ).shape_dict(),
+        # the weight-update partition the run trains with (recorded for
+        # the audit trail; candidates are partition-agnostic because
+        # partition families are trajectory-compatible per codec)
+        "partition": partition,
         "fabric": fabric,
         "fabric_gbps_per_chip": round(bw / 1e9, 3),
         # a measured fabric's per-tier GB/s, copied from the probe doc
@@ -446,7 +460,9 @@ def decision_path(train_dir: str) -> str:
     return os.path.join(train_dir, TUNE_DECISION_NAME)
 
 
-def decision_reusable(doc, *, n_dev: int) -> tuple[bool, str]:
+def decision_reusable(
+    doc, *, n_dev: int, mesh_axes: Optional[dict] = None
+) -> tuple[bool, str]:
     """Can a ``--resume`` reuse this recorded tune decision?
 
     A resumed run must NOT re-probe (probe timings vary run to run, and a
@@ -458,6 +474,16 @@ def decision_reusable(doc, *, n_dev: int) -> tuple[bool, str]:
     may be sized for a mesh that no longer exists — a ring plan for N
     chips, a superstep/bucket point picked from N-way probe timings — so
     a mismatch re-tunes instead of silently applying a stale config.
+
+    ``mesh_axes`` (the resuming run's named-axis shape,
+    ``MeshSpec.shape_dict()``) tightens the check to the MESH SHAPE: once
+    dp x ici axes exist, ``n_devices`` alone cannot tell ``dp4`` from
+    ``dp2 x ici2`` — a hierarchical winner probed on the two-tier mesh
+    is not valid for the flat one (and vice versa), so a recorded
+    ``meta.mesh_axes`` that differs refuses reuse. Artifacts that
+    predate the mesh record fall back to the n_devices check (said in
+    the reason, never silently).
+
     Returns ``(reusable, reason)``; the reason is logged either way and
     lands in incidents.jsonl on the re-tune path. A PURE function of the
     document (tested), like choose_winner."""
@@ -471,6 +497,47 @@ def decision_reusable(doc, *, n_dev: int) -> tuple[bool, str]:
             f"decision was tuned for n_devices={rec} but this run has "
             f"{n_dev} (elastic shrink/grow or a manual resize) — the "
             "recorded winner may be invalid for this world; re-tuning"
+        )
+    meta = doc.get("meta") or {}
+    if mesh_axes is not None:
+        rec_axes = meta.get("mesh_axes")
+        reconstructed = False
+        if rec_axes is None:
+            # legacy artifact: reconstruct the probed shape from the
+            # recorded dcn_ways (two-tier artifacts have carried it
+            # since the topology PR) — a legacy hierarchical decision
+            # must not be silently applied to a flat mesh of the same
+            # device count
+            from atomo_tpu.mesh import MeshSpec
+
+            try:
+                rec_axes = MeshSpec.from_world(
+                    rec, int(meta.get("dcn_ways") or 0)
+                ).shape_dict()
+                reconstructed = True
+            except (TypeError, ValueError):
+                rec_axes = None
+        if rec_axes is None:
+            return True, (
+                f"recorded decision matches this world size ({n_dev}); "
+                "artifact predates the mesh_axes record, so the shape "
+                "check falls back to n_devices only"
+            )
+        src = (
+            " (reconstructed from the legacy artifact's dcn_ways)"
+            if reconstructed
+            else ""
+        )
+        if dict(rec_axes) != dict(mesh_axes):
+            return False, (
+                f"decision was tuned on mesh {rec_axes}{src} but this "
+                f"run's mesh is {mesh_axes} (same device count, "
+                "different axis shape — different program family); "
+                "re-tuning"
+            )
+        return True, (
+            f"recorded decision matches this mesh shape ({mesh_axes})"
+            + src
         )
     return True, f"recorded decision matches this world size ({n_dev})"
 

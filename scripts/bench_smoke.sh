@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Bench smoke (~8 min): prove the bench entrypoint still emits parseable
-# evidence without burning the full-ladder window. Eleven checks:
+# evidence without burning the full-ladder window. Thirteen checks:
 #
 #   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
 #      the driver's last-line JSON contract, PLUS the partial-artifact
@@ -70,6 +70,18 @@
 #      bit-parity assert TRUE, zero row-budget overflow, and a measured
 #      wire-bytes reduction > 1 — the PR-12 sparse gradient exchange.
 #
+#  12. the measured-fabric contract (<60 s, forced 4-device CPU mesh):
+#      bench config 14 must leave a complete two-tier fabric_probe.json,
+#      record measured-vs-preset ratios per tier, and hold the
+#      pricing-only parity gate — the PR-13 fabric observatory.
+#
+#  13. the sharded-update contract (<60 s, forced 4-device CPU mesh):
+#      bench config 15 runs replicated vs zero1 vs sharded-update and
+#      must exit 0 with the in-row bit-parity gate TRUE (one trajectory,
+#      three partitions), strictly decreasing measured per-chip state
+#      bytes, and a recorded memory reduction — the PR-14 mesh
+#      subsystem's cross-replica sharded weight update (2004.13336).
+#
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
 cd "$(dirname "$0")/.." || exit 2
@@ -105,7 +117,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/12]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/13]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -134,7 +146,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/12]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/13]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -171,7 +183,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/12]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/13]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -202,7 +214,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/12]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/13]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -229,7 +241,7 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/12]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/13]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
 EOF
 [ $? -ne 0 ] && exit 1
@@ -262,7 +274,7 @@ for r in probed:
     assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
     assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
 assert doc.get("why"), doc
-print(f"bench_smoke OK[6/12]: --auto tune picked {win['name']} "
+print(f"bench_smoke OK[6/13]: --auto tune picked {win['name']} "
       f"({win.get('measured_ms_per_step')} ms/step measured, "
       f"{len(probed)}/{len(doc['rows'])} candidates probed); "
       "decision artifact parses")
@@ -306,7 +318,7 @@ for p in plans:
     assert isinstance(p.get("predicted_ms_per_step"), (int, float)), p
 td = row.get("tune_decision") or {}
 assert td.get("hierarchical_probed"), row
-print(f"bench_smoke OK[7/12]: two-tier plans "
+print(f"bench_smoke OK[7/13]: two-tier plans "
       f"{[p['plan'] for p in plans]} measured with per-tier "
       "predicted-vs-measured bytes matching, per-plan bit_parity=True; "
       f"mini-tune probed {td['hierarchical_probed']} "
@@ -354,7 +366,7 @@ sys.path.insert(0, ".")
 from atomo_tpu.training.checkpoint import latest_valid_step
 
 assert latest_valid_step(d) == 8, latest_valid_step(d)
-print("bench_smoke OK[8/12]: die@3:1 shrank 4 -> 3 at a checkpoint "
+print("bench_smoke OK[8/13]: die@3:1 shrank 4 -> 3 at a checkpoint "
       "boundary (planned reshape, restart budget untouched), finished at "
       f"step {latest_valid_step(d)} with membership epochs "
       f"{[w[0] for w in worlds]} recorded")
@@ -390,7 +402,7 @@ for k in ("compute_ms", "encode_monolithic_ms", "encode_streamed_ms",
           "encode_hidden_stream_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 assert int(ph.get("n_buckets", 0)) > 1, row
-print(f"bench_smoke OK[9/12]: stream {row['value']} vs off "
+print(f"bench_smoke OK[9/13]: stream {row['value']} vs off "
       f"{row['off_ms_per_step']} ms/step; exposed encode "
       f"{ph['encode_exposed_stream_ms']} (stream, {ph['n_buckets']} "
       f"buckets) vs {ph['encode_exposed_off_ms']} (off) ms; "
@@ -439,7 +451,7 @@ assert doc["consistent"] is True, doc["checks"]
 ran = [c["name"] for c in doc["checks"] if not c["skipped"]]
 segs = [e for e in doc["timeline"] if e["kind"] == "metrics"]
 assert segs and segs[0]["first_step"] == 1 and segs[-1]["last_step"] == 6
-print("bench_smoke OK[10/12]: recorder+quality run left "
+print("bench_smoke OK[10/13]: recorder+quality run left "
       f"{len(steps)} step records ({len(steps[0]['q_rel'])}-layer "
       "quality columns), report verb joined a consistent timeline "
       f"(checks ran: {ran})")
@@ -479,7 +491,7 @@ for l in layers:
     assert 0.0 <= l["density"] <= 1.0, l
     if l["assignment"] == "sparse":
         assert l["payload_bytes"] < l["dense_bytes"], l
-print(f"bench_smoke OK[11/12]: hybrid {row['hybrid_wire_bytes']} B vs "
+print(f"bench_smoke OK[11/13]: hybrid {row['hybrid_wire_bytes']} B vs "
       f"all-dense {row['alldense_wire_bytes']} B on the wire "
       f"({row['wire_reduction']}x reduction, "
       f"{len(plan['sparse_leaves'])}/{plan['n_leaves']} leaves sparse); "
@@ -523,7 +535,7 @@ assert set(ratios) == {"ici", "dcn"} and all(
 # even on a contended host
 assert row["fabric_parity"] is True, row
 assert row["run_artifact_complete"] is True, row
-print(f"bench_smoke OK[12/12]: probed ici {tiers['ici']['bandwidth_gbps']} "
+print(f"bench_smoke OK[12/13]: probed ici {tiers['ici']['bandwidth_gbps']} "
       f"/ dcn {tiers['dcn']['bandwidth_gbps']} GB/s/chip "
       f"({tiers['ici']['latency_us']} / {tiers['dcn']['latency_us']} "
       "us/hop); measured-vs-preset ratios recorded; measured-priced vs "
@@ -531,4 +543,45 @@ print(f"bench_smoke OK[12/12]: probed ici {tiers['ici']['bandwidth_gbps']} "
 EOF12
 [ $? -ne 0 ] && exit 1
 
-echo "bench_smoke: all 12 checks passed"
+# --- 13: config 15, sharded-update memory + bit-parity contract ----------
+out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+      ATOMO_COMPILE_CACHE="$art/xla" \
+      ATOMO_BENCH_ARTIFACT="$art/c15.json" \
+      python bench.py --config 15 --no-baseline 2>/dev/null)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: config 15 exited rc=$rc (timeout or crash)"
+  exit 1
+fi
+printf '%s\n' "$out" > "$art/c15.out"
+python - "$art/c15.out" <<'EOF13'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+assert lines, "bench_smoke FAIL: config 15 emitted no JSON"
+row = json.loads(lines[-1])
+assert row["metric"] == "sharded_update_memory", row
+assert row["measurement_valid"], row.get("invalid_reason")
+# the in-row bit-parity gate: all three partitions trained the SAME
+# trajectory (canonical decode order), so the memory columns describe
+# one program family
+assert row["bit_parity"] is True, row
+rep = row["replicated_state_bytes_per_chip"]
+z1 = row["zero1_state_bytes_per_chip"]
+shd = row["sharded_update_state_bytes_per_chip"]
+# the 2004.13336 memory claim, read off the actual device buffers:
+# strictly decreasing per-chip persistent state
+assert shd < z1 < rep, (rep, z1, shd)
+assert row["state_bytes_reduction"] > 1.5, row
+for part in ("replicated", "zero1", "sharded_update"):
+    assert row[f"{part}_ms_per_step"] > 0, row
+print(f"bench_smoke OK[13/13]: per-chip state {rep} -> {z1} (zero1) -> "
+      f"{shd} B (sharded-update, {row['state_bytes_reduction']}x); "
+      f"ms/step {row['replicated_ms_per_step']} / "
+      f"{row['zero1_ms_per_step']} / {row['sharded_update_ms_per_step']}; "
+      "bit_parity=True")
+EOF13
+[ $? -ne 0 ] && exit 1
+
+echo "bench_smoke: all 13 checks passed"

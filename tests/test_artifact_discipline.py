@@ -50,6 +50,33 @@ def test_lint_catches_a_package_bypass(tmp_path):
     ) == []
 
 
+def test_lint_covers_mesh_subsystem_by_construction(tmp_path):
+    """The walk covers every atomo_tpu/ subpackage with no allowlist to
+    forget — a json.dump smuggled into the NEW mesh/ subsystem must be
+    flagged exactly like the utils/ case (PR-14 satellite: new
+    subsystems inherit the artifact discipline for free)."""
+    mod = _load_checker()
+    pkg = tmp_path / "atomo_tpu" / "mesh"
+    pkg.mkdir(parents=True)
+    bad = pkg / "rogue.py"
+    bad.write_text(
+        "import json\n"
+        "def w(train_dir, obj):\n"
+        "    with open(train_dir + '/mesh.json', 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+    )
+    out = mod.scan_file(
+        str(bad), os.path.join("atomo_tpu", "mesh", "rogue.py")
+    )
+    assert len(out) == 1 and "write_json_atomic" in out[0]
+    # and the REAL mesh package is clean (collect_violations walks it)
+    real = os.path.join(_REPO, "atomo_tpu", "mesh")
+    assert os.path.isdir(real)
+    assert not [
+        v for v in mod.collect_violations(_REPO) if "atomo_tpu/mesh" in v
+    ]
+
+
 def test_lint_catches_a_script_train_dir_dump(tmp_path):
     mod = _load_checker()
     bad = tmp_path / "scripts" / "rogue.py"

@@ -434,12 +434,19 @@ def _qsgd():
     return QSGD
 
 
-@pytest.mark.parametrize("mode", ["gather", "ring", "stream"])
+@pytest.mark.parametrize(
+    "mode",
+    ["gather", "ring", "stream", "sharded_gather", "sharded_ring"],
+)
 def test_named_phase_scopes_survive_into_compiled_hlo(mode):
     """The timeline verb keys on the named_phase scopes inside the fused
     distributed step; a refactor that drops them would silently blind it.
     Assert the anchors appear in the compiled HLO's op metadata for the
-    gather, ring, and stream-encode programs."""
+    gather, ring, and stream-encode programs — AND for the pjit-compiled
+    sharded-update programs (the mesh-subsystem compile path must not
+    silently drop the timeline's anchors; it additionally plants its own
+    materialize_params / sharded_update scopes)."""
+    from atomo_tpu.mesh import sharded_update_state
     from atomo_tpu.models import get_model
     from atomo_tpu.parallel import (
         make_distributed_train_step,
@@ -454,24 +461,31 @@ def test_named_phase_scopes_survive_into_compiled_hlo(mode):
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
     images = jnp.zeros((8, 28, 28, 1), jnp.float32)
     labels = jnp.zeros((8,), jnp.int32)
-    state = replicate_state(
-        mesh, create_state(model, opt, jax.random.PRNGKey(0), images)
-    )
+    host = create_state(model, opt, jax.random.PRNGKey(0), images)
+    sharded = mode.startswith("sharded_")
+    if sharded:
+        state, su = sharded_update_state(mesh, jax.device_get(host), opt)
+    else:
+        state, su = replicate_state(mesh, host), None
     step = make_distributed_train_step(
         model, opt, mesh, _qsgd(),
-        aggregate="ring" if mode == "ring" else "gather",
+        aggregate="ring" if mode.endswith("ring") else "gather",
         stream_encode=mode == "stream",
         stream_bucket_bytes=1 << 16,
+        sharded_update=su,
     )
     si, sl = shard_batch(mesh, images, labels)
     txt = step.lower(
         state, jax.random.PRNGKey(1), si, sl
     ).compile().as_text()
     assert "encode" in txt, mode
-    if mode == "ring":
+    if mode.endswith("ring"):
         assert "ring_exchange_decode" in txt
     else:
         assert "exchange" in txt and "decode_mean" in txt
+    if sharded:
+        assert "materialize_params" in txt, mode
+        assert "sharded_update" in txt, mode
 
 
 # --------------------------------------------------------- the timeline

@@ -1,0 +1,702 @@
+"""The mesh subsystem (PR-14 tentpole): explicit sharding, one compile
+path, cross-replica sharded weight update (Xu et al. 2004.13336).
+
+Contracts pinned here:
+
+  * MeshSpec is the ONE mesh grammar: degenerate 1-device, flat dp and
+    two-tier dp x ici shapes round-trip through the artifact form.
+  * compile_step's map-style half is byte-identical to the hand-rolled
+    ``jax.jit(jax.shard_map(...))`` stack it replaced (lowered-text
+    equality on degenerate and multi-device meshes) — the replicated
+    program family kept its frozen HLO through the refactor BY
+    CONSTRUCTION.
+  * Sharded-update trajectories are bit-identical to replicated ones
+    per codec in the canonical decode order (qsgd gather/ring, svd ring
+    and unfused gather, dense psum; superstep and two-tier compose);
+    the fused-SVD gather tracks replicated to the documented ~1e-8
+    cross-program fusion-drift class.
+  * Per-chip persistent state actually shrinks: master+opt bytes on
+    chip 0 are ~1/n of the replicated run's (measured from the real
+    device buffers).
+  * ``--overlap delayed`` composes: the in-flight payload is a sharded
+    carry leaf, kill->restart->resume through the loop is bit-exact —
+    the historical zero1 x delayed x supervision dead end, dissolved
+    (satellite 1).
+  * decision_reusable refuses a resume whose MESH SHAPE changed even at
+    equal device count (satellite 2).
+  * Live re-shard (elastic's in-process reshape path) equals a fresh
+    build from the gathered host state, momentum carried exactly.
+"""
+
+import os
+import sys
+import subprocess
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from atomo_tpu.codecs import DenseCodec, QsgdCodec, SvdCodec
+from atomo_tpu.data import BatchIterator, SPECS, synthetic_dataset
+from atomo_tpu.mesh import (
+    MeshSpec,
+    reshard_sharded_update,
+    sharded_update_state,
+    spec_of_mesh,
+)
+from atomo_tpu.models import get_model
+from atomo_tpu.parallel import (
+    compile_step,
+    init_delayed_state,
+    make_distributed_train_step,
+    make_mesh,
+    replicate_state,
+    shard_batch,
+    shard_superbatch,
+)
+from atomo_tpu.training import (
+    GuardConfig,
+    create_state,
+    make_optimizer,
+    snapshot_state,
+)
+
+QSGD = QsgdCodec(bits=4, bucket_size=128)
+
+
+def _eq(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        )
+    )
+
+
+def _setup(n_dev=4, batch=8):
+    mesh = make_mesh(n_dev)
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    r = np.random.default_rng(0)
+    images = r.standard_normal((batch, 28, 28, 1)).astype(np.float32)
+    labels = r.integers(0, 10, batch).astype(np.int32)
+    host = snapshot_state(
+        create_state(model, opt, jax.random.PRNGKey(0), jnp.asarray(images))
+    )
+    return mesh, model, opt, host, jnp.asarray(images), jnp.asarray(labels)
+
+
+# ------------------------------------------------------------ MeshSpec
+
+
+def test_meshspec_grammar_and_roundtrip():
+    flat = MeshSpec.from_world(4)
+    assert flat.axes == (("dp", 4),) and flat.is_flat
+    assert not flat.is_degenerate and flat.describe() == "dp4"
+    one = MeshSpec.from_world(1)
+    assert one.is_degenerate and one.is_flat and one.shape_dict() == {"dp": 1}
+    two = MeshSpec.from_world(4, dcn_ways=2)
+    assert two.axes == (("dp", 2), ("ici", 2))
+    assert two.is_two_tier and two.inner_axis == "ici"
+    assert two.data_axes == ("dp", "ici")
+    assert two.describe() == "dp2xici2"
+    # artifact round-trip preserves order and sizes
+    assert MeshSpec.from_shape_dict(two.shape_dict()) == two
+    assert MeshSpec.from_shape_dict(flat.shape_dict()) == flat
+    # garbage documents resolve to None, not an exception
+    assert MeshSpec.from_shape_dict(None) is None
+    assert MeshSpec.from_shape_dict({}) is None
+    assert MeshSpec.from_shape_dict({"dp": "x"}) is None
+
+
+def test_meshspec_validation_and_of_mesh():
+    with pytest.raises(ValueError):
+        MeshSpec.from_world(4, dcn_ways=3)  # does not divide
+    with pytest.raises(ValueError):
+        MeshSpec.from_world(0)
+    with pytest.raises(ValueError):
+        MeshSpec((("dp", 2), ("dp", 2)))  # duplicate axis
+    mesh = make_mesh(4, axes=(("dp", 2), ("ici", 2)))
+    assert spec_of_mesh(mesh) == MeshSpec.from_world(4, dcn_ways=2)
+    assert MeshSpec.from_world(4).build().shape["dp"] == 4
+
+
+# ------------------------------------------- one compile path, frozen HLO
+
+
+@pytest.mark.parametrize("n_dev", [1, 4])
+def test_compile_step_map_style_is_byte_identical_to_hand_rolled(n_dev):
+    """The replicated family's byte-identity through the refactor, by
+    construction: compile_step without explicit shardings must lower to
+    the EXACT text of the jit(shard_map) stack it replaced — on the
+    degenerate 1-device mesh and a real multi-device one alike."""
+    mesh = make_mesh(n_dev)
+
+    def body(x, y):
+        g = jax.lax.pmean(x * y, "dp")
+        return g + jax.lax.axis_index("dp").astype(jnp.float32) * 0.0
+
+    x = jnp.arange(4 * n_dev, dtype=jnp.float32).reshape(n_dev * 2, 2)
+    helper = compile_step(
+        body, mesh, in_specs=(P("dp"), P("dp")), out_specs=P("dp"),
+        donate_argnums=(0,), check_vma=False,
+    )
+    hand = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P("dp"), P("dp")),
+            out_specs=P("dp"), check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+    a = helper.lower(x, x).as_text()
+    b = hand.lower(x, x).as_text()
+    assert a == b
+
+
+def test_compile_step_explicit_shardings_constrains_boundary():
+    """The pjit half: explicit shardings appear at the jit boundary (the
+    compiled program's input layout is the annotated one, so sharded
+    state stays sharded by contract, not convention)."""
+    mesh = make_mesh(4)
+
+    def body(x):
+        return x * 2.0
+
+    step = compile_step(
+        body, mesh, in_specs=(P("dp"),), out_specs=P("dp"),
+        explicit_shardings=True,
+    )
+    x = jnp.arange(8, dtype=jnp.float32)
+    out = step(x)
+    assert out.sharding.spec == P("dp")
+    np.testing.assert_array_equal(np.asarray(out), np.arange(8) * 2.0)
+
+
+# ------------------------------- sharded update vs replicated, per codec
+
+
+def _run_traj(mesh, model, opt, host, images, labels, codec, *, su_mode,
+              n_steps=3, **kw):
+    si, sl = shard_batch(mesh, images, labels)
+    if su_mode:
+        st, su = sharded_update_state(mesh, host, opt)
+        step = make_distributed_train_step(
+            model, opt, mesh, codec, sharded_update=su, **kw
+        )
+    else:
+        st, su = replicate_state(mesh, host), None
+        step = make_distributed_train_step(model, opt, mesh, codec, **kw)
+    m = None
+    for _ in range(n_steps):
+        st, m = step(st, jax.random.PRNGKey(1), si, sl)
+    params = (
+        su.materialize_host(st.master) if su_mode
+        else jax.device_get(st.params)
+    )
+    return params, m
+
+
+@pytest.mark.parametrize(
+    "codec,kw",
+    [
+        (QSGD, dict(aggregate="gather")),
+        (QSGD, dict(aggregate="ring")),
+        (None, dict(aggregate="psum")),
+        (SvdCodec(rank=2), dict(aggregate="ring")),
+        (SvdCodec(rank=2), dict(aggregate="gather", unfused_decode=True)),
+    ],
+    ids=["qsgd-gather", "qsgd-ring", "dense-psum", "svd-ring",
+         "svd-gather-unfused"],
+)
+def test_sharded_update_bit_identical_to_replicated(codec, kw):
+    """The house acceptance bar: sharded-update trajectories ==
+    replicated trajectories, bit for bit, per codec in the canonical
+    decode order."""
+    mesh, model, opt, host, images, labels = _setup()
+    pr, mr = _run_traj(mesh, model, opt, host, images, labels, codec,
+                       su_mode=False, **kw)
+    ps, ms = _run_traj(mesh, model, opt, host, images, labels, codec,
+                       su_mode=True, **kw)
+    assert _eq(pr, ps)
+    assert float(mr["loss"]) == float(ms["loss"])
+
+
+def test_sharded_update_fused_svd_gather_within_drift_class():
+    """The fused-SVD gather program restructures around the transient
+    materialize and XLA fuses the decode matmul differently: the
+    documented cross-program fusion-drift class (~1e-8 allclose), NOT
+    bit-identity — stated and pinned, never silent."""
+    mesh, model, opt, host, images, labels = _setup()
+    codec = SvdCodec(rank=2)
+    pr, _ = _run_traj(mesh, model, opt, host, images, labels, codec,
+                      su_mode=False, aggregate="gather")
+    ps, _ = _run_traj(mesh, model, opt, host, images, labels, codec,
+                      su_mode=True, aggregate="gather")
+    for a, b in zip(jax.tree_util.tree_leaves(pr),
+                    jax.tree_util.tree_leaves(ps)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        )
+
+
+@pytest.mark.slow
+def test_sharded_update_superstep_and_guard_compose():
+    mesh, model, opt, host, images, labels = _setup()
+    # superstep scan carries the sharded state — bit-identical to rep
+    K = 2
+    im2, lb2 = jnp.stack([images] * K), jnp.stack([labels] * K)
+    si2, sl2 = shard_superbatch(mesh, im2, lb2)
+    st_r = replicate_state(mesh, host)
+    step_r = make_distributed_train_step(
+        model, opt, mesh, QSGD, aggregate="gather", superstep=K
+    )
+    st_r, _ = step_r(st_r, jax.random.PRNGKey(1), si2, sl2)
+    st_s, su = sharded_update_state(mesh, host, opt)
+    step_s = make_distributed_train_step(
+        model, opt, mesh, QSGD, aggregate="gather", superstep=K,
+        sharded_update=su,
+    )
+    st_s, _ = step_s(st_s, jax.random.PRNGKey(1), si2, sl2)
+    assert _eq(jax.device_get(st_r.params), su.materialize_host(st_s.master))
+    # guarded compositions restructure the select/rescale tail and land
+    # in the documented cross-program fusion-drift class — pinned as
+    # allclose, not bit-identity (the make_distributed_train_step
+    # docstring states this)
+    pr, _ = _run_traj(mesh, model, opt, host, images, labels, QSGD,
+                      su_mode=False, aggregate="ring", guard=GuardConfig())
+    ps, _ = _run_traj(mesh, model, opt, host, images, labels, QSGD,
+                      su_mode=True, aggregate="ring", guard=GuardConfig())
+    for a, b in zip(jax.tree_util.tree_leaves(pr),
+                    jax.tree_util.tree_leaves(ps)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        )
+
+
+@pytest.mark.slow
+def test_sharded_update_two_tier_hierarchical():
+    """The one compile path serves the two-tier program: master sharded
+    over BOTH data axes, hierarchical aggregation unchanged, bit-identical
+    to the replicated two-tier run."""
+    mesh = make_mesh(4, axes=(("dp", 2), ("ici", 2)))
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    r = np.random.default_rng(0)
+    images = jnp.asarray(
+        r.standard_normal((8, 28, 28, 1)).astype(np.float32)
+    )
+    labels = jnp.asarray(r.integers(0, 10, 8).astype(np.int32))
+    host = snapshot_state(
+        create_state(model, opt, jax.random.PRNGKey(0), images)
+    )
+    si, sl = shard_batch(mesh, images, labels, axis=("dp", "ici"))
+    st_r = replicate_state(mesh, host)
+    step_r = make_distributed_train_step(
+        model, opt, mesh, QSGD, aggregate="hierarchical", inner_axis="ici"
+    )
+    st_s, su = sharded_update_state(mesh, host, opt, axis=("dp", "ici"))
+    step_s = make_distributed_train_step(
+        model, opt, mesh, QSGD, aggregate="hierarchical", inner_axis="ici",
+        sharded_update=su,
+    )
+    for _ in range(3):
+        st_r, _ = step_r(st_r, jax.random.PRNGKey(1), si, sl)
+        st_s, _ = step_s(st_s, jax.random.PRNGKey(1), si, sl)
+    assert _eq(jax.device_get(st_r.params), su.materialize_host(st_s.master))
+
+
+def test_degenerate_one_device_mesh_is_first_class():
+    """dp1 runs the same sharded-update program text with identity
+    collectives: the chunk is the whole padded vector and the trajectory
+    equals the replicated one exactly."""
+    mesh, model, opt, host, images, labels = _setup(n_dev=1)
+    pr, _ = _run_traj(mesh, model, opt, host, images, labels, QSGD,
+                      su_mode=False, aggregate="gather")
+    ps, _ = _run_traj(mesh, model, opt, host, images, labels, QSGD,
+                      su_mode=True, aggregate="gather")
+    assert _eq(pr, ps)
+
+
+# --------------------------------------------------- per-chip memory
+
+
+def _chip0_bytes(tree):
+    dev0 = jax.devices()[0]
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        for s in leaf.addressable_shards:
+            if s.device == dev0:
+                total += int(np.prod(s.data.shape)) * s.data.dtype.itemsize
+    return total
+
+
+def test_per_chip_persistent_state_shrinks_by_world_size():
+    """The 2004.13336 memory claim, measured from device buffers: chip
+    0's persistent (master + optimizer) bytes under sharded-update are
+    ~1/n of the replicated run's (exact up to flat padding)."""
+    mesh, model, opt, host, images, labels = _setup()
+    st_r = replicate_state(mesh, host)
+    rep = _chip0_bytes((st_r.params, st_r.opt_state))
+    st_s, su = sharded_update_state(mesh, host, opt)
+    shd = _chip0_bytes((st_s.master, st_s.opt_state))
+    n = mesh.shape["dp"]
+    assert shd < rep / (n - 0.5)  # 1/n up to padding + scalar counts
+    # and the master really is distributed: every chip holds one chunk
+    assert len(st_s.master.addressable_shards) == n
+    assert st_s.master.addressable_shards[0].data.shape == (su.chunk,)
+
+
+# ------------------------------------------- delayed overlap, resume drill
+
+
+def test_sharded_delayed_matches_replicated_delayed_ring():
+    """The in-flight payload as a sharded carry leaf: the su delayed-ring
+    trajectory is bit-identical to the replicated delayed-ring one."""
+    mesh, model, opt, host, images, labels = _setup()
+    si, sl = shard_batch(mesh, images, labels)
+
+    def run(su_mode):
+        if su_mode:
+            st, su = sharded_update_state(mesh, host, opt)
+            step = make_distributed_train_step(
+                model, opt, mesh, QSGD, aggregate="ring",
+                overlap="delayed", sharded_update=su,
+            )
+            st = init_delayed_state(
+                mesh, st, QSGD,
+                params_host=su.materialize_host(st.master),
+            )
+        else:
+            st, su = replicate_state(mesh, host), None
+            step = make_distributed_train_step(
+                model, opt, mesh, QSGD, aggregate="ring", overlap="delayed"
+            )
+            st = init_delayed_state(mesh, st, QSGD)
+        for _ in range(4):
+            st, m = step(st, jax.random.PRNGKey(1), si, sl)
+        tr = st.train
+        return (
+            su.materialize_host(tr.master) if su_mode
+            else jax.device_get(tr.params)
+        ), m
+
+    pr, mr = run(False)
+    ps, ms = run(True)
+    assert _eq(pr, ps)
+    assert float(mr["skipped"]) == float(ms["skipped"]) == 0.0
+
+
+@pytest.mark.slow
+def test_sharded_delayed_kill_restart_resume_bit_exact(tmp_path):
+    """Satellite 1's drill: the zero1 x delayed x supervision dead end is
+    LIFTED on the sharded path — a sharded-update + delayed run killed at
+    a checkpoint resumes (in-flight payload restored from the sharded
+    carry leaf) and finishes bit-identical to the uninterrupted run."""
+    from atomo_tpu.parallel import distributed_train_loop
+
+    mesh, model, opt, _host, _im, _lb = _setup(n_dev=2, batch=8)
+
+    def make_iter():
+        return BatchIterator(
+            synthetic_dataset(SPECS["mnist"], True, size=64), 16, seed=0
+        )
+
+    common = dict(
+        codec=QSGD, aggregate="gather", overlap="delayed",
+        sharded_update=True, log_every=0, eval_freq=0, seed=0,
+    )
+    oracle = distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=6, **common
+    )
+    distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=4,
+        train_dir=str(tmp_path), save_freq=2, **common
+    )
+    logs = []
+    resumed = distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=6,
+        train_dir=str(tmp_path), resume=True, log_fn=logs.append,
+        **common
+    )
+    assert any("Resumed" in l and "step 4" in l for l in logs), logs
+    # both are DelayedState-over-ShardedUpdateState: flat master compare
+    assert _eq(
+        jax.device_get(resumed.train.master),
+        jax.device_get(oracle.train.master),
+    )
+    assert int(jax.device_get(resumed.step)) == 6
+
+
+@pytest.mark.slow
+def test_sharded_blocking_loop_resume_and_replicated_fallback(tmp_path):
+    """Blocking-mode loop resume restores the sharded layout; resuming a
+    REPLICATED checkpoint into a sharded-update run falls back to
+    params-only out loud (the ZeRO-1 fallback, inherited)."""
+    from atomo_tpu.parallel import distributed_train_loop
+
+    mesh, model, opt, _host, _im, _lb = _setup(n_dev=2, batch=8)
+
+    def make_iter():
+        return BatchIterator(
+            synthetic_dataset(SPECS["mnist"], True, size=64), 16, seed=0
+        )
+
+    common = dict(codec=QSGD, aggregate="gather", log_every=0,
+                  eval_freq=0, seed=0)
+    oracle = distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=6, sharded_update=True,
+        **common
+    )
+    distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=3, sharded_update=True,
+        train_dir=str(tmp_path), save_freq=3, **common
+    )
+    logs = []
+    resumed = distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=6, sharded_update=True,
+        train_dir=str(tmp_path), resume=True, log_fn=logs.append, **common
+    )
+    assert any("Resumed" in l and "step 3" in l for l in logs), logs
+    assert _eq(
+        jax.device_get(resumed.master), jax.device_get(oracle.master)
+    )
+    # replicated checkpoint -> sharded run: params-only fallback, warned
+    rep_dir = tmp_path / "rep"
+    distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=2,
+        train_dir=str(rep_dir), save_freq=2, **common
+    )
+    with pytest.warns(UserWarning, match="sharded-update resume"):
+        st = distributed_train_loop(
+            model, opt, mesh, make_iter(), max_steps=3,
+            sharded_update=True, train_dir=str(rep_dir), resume=True,
+            **common
+        )
+    assert int(jax.device_get(st.step)) == 3
+
+
+@pytest.mark.slow
+def test_sharded_resume_across_overlap_layouts(tmp_path, recwarn):
+    """Cross-layout resume fallbacks (code-review hardening): a
+    sharded-update DELAYED checkpoint resumed by a BLOCKING sharded run
+    restores the sharded train state (payload discarded, warned), and a
+    REPLICATED delayed checkpoint resumed by a sharded run falls back to
+    params-only — neither path may crash on flax's key mismatch."""
+    from atomo_tpu.parallel import distributed_train_loop
+
+    mesh, model, opt, _host, _im, _lb = _setup(n_dev=2, batch=8)
+
+    def make_iter():
+        return BatchIterator(
+            synthetic_dataset(SPECS["mnist"], True, size=64), 16, seed=0
+        )
+
+    common = dict(codec=QSGD, aggregate="gather", log_every=0,
+                  eval_freq=0, seed=0)
+    # (a) sharded delayed checkpoint -> blocking sharded resume
+    d_a = str(tmp_path / "a")
+    distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=2, sharded_update=True,
+        overlap="delayed", train_dir=d_a, save_freq=2, **common
+    )
+    st = distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=3, sharded_update=True,
+        train_dir=d_a, resume=True, **common
+    )
+    assert int(jax.device_get(st.step)) == 3
+    assert any(
+        "overlap-carry layout" in str(w.message) for w in recwarn.list
+    ), [str(w.message) for w in recwarn.list]
+    # (b) replicated delayed checkpoint -> sharded resume (params-only)
+    d_b = str(tmp_path / "b")
+    distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=2, overlap="delayed",
+        train_dir=d_b, save_freq=2, **common
+    )
+    st = distributed_train_loop(
+        model, opt, mesh, make_iter(), max_steps=3, sharded_update=True,
+        train_dir=d_b, resume=True, **common
+    )
+    assert int(jax.device_get(st.step)) == 3
+    assert any(
+        "restoring params only" in str(w.message)
+        or "params only" in str(w.message)
+        for w in recwarn.list
+    ), [str(w.message) for w in recwarn.list]
+
+
+# --------------------------------------------------- live re-shard
+
+
+def test_reshard_live_state_equals_fresh_build():
+    """Elastic's in-process reshape path: re-sharding a LIVE sharded
+    state onto a smaller mesh carries params AND momentum exactly — the
+    resharded run continues the same optimizer trajectory a fresh build
+    from the gathered host state would."""
+    mesh, model, opt, host, images, labels = _setup(n_dev=4)
+    si, sl = shard_batch(mesh, images, labels)
+    st, su = sharded_update_state(mesh, host, opt)
+    step = make_distributed_train_step(
+        model, opt, mesh, QSGD, aggregate="gather", sharded_update=su
+    )
+    for _ in range(2):
+        st, _ = step(st, jax.random.PRNGKey(1), si, sl)
+    mesh2 = make_mesh(2)
+    st2, su2 = reshard_sharded_update(st, su, mesh2, opt)
+    # params carried bit-exact
+    assert _eq(su.materialize_host(st.master), su2.materialize_host(st2.master))
+    # momentum carried bit-exact (vector buffers re-sliced, not re-init)
+    old_mom = np.asarray(jax.device_get(
+        [l for l in jax.tree_util.tree_leaves(st.opt_state) if l.ndim][0]
+    ))[: su.d_flat]
+    new_mom = np.asarray(jax.device_get(
+        [l for l in jax.tree_util.tree_leaves(st2.opt_state) if l.ndim][0]
+    ))[: su2.d_flat]
+    np.testing.assert_array_equal(old_mom, new_mom)
+    # and the resharded state steps on the new mesh
+    step2 = make_distributed_train_step(
+        model, opt, mesh2, QSGD, aggregate="gather", sharded_update=su2
+    )
+    si2, sl2 = shard_batch(mesh2, images, labels)
+    st2, m2 = step2(st2, jax.random.PRNGKey(1), si2, sl2)
+    assert np.isfinite(float(m2["loss"]))
+
+
+# ------------------------------------------------ decision_reusable mesh
+
+
+def test_decision_reusable_refuses_changed_mesh_shape():
+    """Satellite 2: same n_devices, different axis shape -> refuse."""
+    from atomo_tpu.tuning.autopilot import decision_reusable
+
+    doc = {
+        "complete": True,
+        "winner": {"knobs": {"aggregate": "gather"}},
+        "meta": {"n_devices": 4, "mesh_axes": {"dp": 2, "ici": 2}},
+    }
+    ok, why = decision_reusable(doc, n_dev=4, mesh_axes={"dp": 4})
+    assert not ok and "different axis shape" in why
+    ok, why = decision_reusable(
+        doc, n_dev=4, mesh_axes={"dp": 2, "ici": 2}
+    )
+    assert ok, why
+    # old artifact without the record: the shape is RECONSTRUCTED from
+    # the recorded dcn_ways, so a legacy flat artifact matches a flat
+    # mesh and a legacy two-tier one refuses a flat resume
+    legacy_flat = {
+        "complete": True,
+        "winner": {"knobs": {"aggregate": "gather"}},
+        "meta": {"n_devices": 4},
+    }
+    ok, why = decision_reusable(legacy_flat, n_dev=4, mesh_axes={"dp": 4})
+    assert ok and "reconstructed" in why
+    legacy_2t = {
+        "complete": True,
+        "winner": {"knobs": {"aggregate": "hier[legacy]"}},
+        "meta": {"n_devices": 4, "dcn_ways": 2},
+    }
+    ok, why = decision_reusable(legacy_2t, n_dev=4, mesh_axes={"dp": 4})
+    assert not ok and "reconstructed" in why
+    # the n_devices mismatch still dominates
+    ok, _ = decision_reusable(doc, n_dev=3, mesh_axes={"dp": 3})
+    assert not ok
+
+
+def test_tune_records_mesh_axes_and_partition(tmp_path):
+    """The decision artifact carries the probed mesh's named-axis shape
+    and the weight-update partition."""
+    from atomo_tpu.tuning.autopilot import tune
+
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+
+    def init_fn():
+        return create_state(
+            model, opt, jax.random.PRNGKey(0),
+            jnp.zeros((1, 28, 28, 1), jnp.float32),
+        ).params
+
+    doc = tune(
+        model=model, optimizer=opt, codec=QSGD, model_init_fn=init_fn,
+        n_dev=2, sample_shape=(28, 28, 1), num_classes=10, batch=8,
+        fabric="ici", probe_top=1, probe_steps=1, probe_reps=1,
+        superstep_options=(1,), bucket_options=(65536,),
+        partition="sharded_update", log_fn=lambda *a, **k: None,
+    )
+    assert doc["meta"]["mesh_axes"] == {"dp": 2}
+    assert doc["meta"]["partition"] == "sharded_update"
+
+
+# ------------------------------------------------ CLI preflight (sat. 1)
+
+
+def _base_args(**over):
+    from atomo_tpu.cli import build_parser
+
+    argv = over.pop("argv")
+    args = build_parser().parse_args(argv)
+    args._argv = argv
+    return args
+
+
+def test_preflight_zero1_delayed_supervised_still_rejected():
+    """The legacy dead end keeps its reject (message now names the way
+    out)."""
+    from atomo_tpu.cli import _argv_preflight
+
+    args = _base_args(argv=[
+        "train", "--synthetic", "--code", "qsgd", "--n-devices", "2",
+        "--overlap", "delayed", "--zero1", "--max-restarts", "2",
+        "--train-dir", "/tmp/x",
+    ])
+    with pytest.raises(SystemExit, match="sharded-update"):
+        _argv_preflight(args)
+
+
+def test_preflight_sharded_update_delayed_supervised_allowed():
+    """Satellite 1: the SAME flag triple passes preflight on the sharded
+    path — the in-flight payload is a sharded carry leaf now."""
+    from atomo_tpu.cli import _argv_preflight
+
+    args = _base_args(argv=[
+        "train", "--synthetic", "--code", "qsgd", "--n-devices", "2",
+        "--overlap", "delayed", "--partition", "sharded-update",
+        "--max-restarts", "2", "--train-dir", "/tmp/x",
+    ])
+    _argv_preflight(args)  # must not raise
+
+
+def test_preflight_sharded_update_conflicts():
+    from atomo_tpu.cli import _argv_preflight
+
+    base = ["train", "--synthetic", "--code", "qsgd", "--n-devices", "2",
+            "--partition", "sharded-update"]
+    with pytest.raises(SystemExit, match="--zero1 conflicts"):
+        _argv_preflight(_base_args(argv=base + ["--zero1"]))
+    with pytest.raises(SystemExit, match="on-diverge|rollback"):
+        _argv_preflight(_base_args(argv=base + [
+            "--on-diverge", "skip", "--train-dir", "/tmp/x",
+            "--save-freq", "2", "--keep-ckpts", "2",
+        ]))
+
+
+@pytest.mark.slow
+def test_cli_sharded_update_trains_and_resumes(tmp_path):
+    """End to end through the CLI: --partition sharded-update trains on
+    the forced multi-device mesh, checkpoints, and a supervised-style
+    resume continues from the saved sharded layout."""
+    from atomo_tpu.cli import main
+
+    d = str(tmp_path / "run")
+    argv = ["train", "--synthetic", "--code", "qsgd",
+            "--n-devices", "2", "--network", "lenet", "--dataset", "mnist",
+            "--batch-size", "8", "--max-steps", "2", "--eval-freq", "0",
+            "--partition", "sharded-update", "--overlap", "delayed",
+            "--train-dir", d, "--save-freq", "2"]
+    main(argv)
+    assert os.path.exists(os.path.join(d, "model_step_2"))
+    main(argv[:argv.index("--max-steps") + 1] + ["4"]
+         + argv[argv.index("--max-steps") + 2:] + ["--resume"])
+    assert os.path.exists(os.path.join(d, "model_step_4"))
