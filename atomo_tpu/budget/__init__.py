@@ -1,0 +1,66 @@
+"""Adaptive variance-budget codecs — ATOMO's allocation, finally closed.
+
+The source paper's core contribution (Wang et al., 1806.04090) is
+variance-minimizing atom allocation under a communication budget — yet
+until this package the repo spent a FIXED per-layer budget: one global
+``--svd-rank`` knob, every layer padded to the same atom count. This
+package closes the loop:
+
+  * :mod:`~atomo_tpu.budget.allocator` — per-layer gradient spectra
+    (measured from a probe gradient, or folded online from the
+    ``--obs-quality`` q_err2 series) and the water-filling solver that
+    distributes a GLOBAL wire-byte budget across layers to minimize
+    total estimator variance. The existing fixed budget is the
+    degenerate "uniform" point of the dial; ``--on-diverge densify``'s
+    spend-everything remedy is its other end (an unbounded budget drives
+    every layer into the codec's exact dense fallback).
+  * :mod:`~atomo_tpu.budget.codec` — :class:`PerLeafCodec`, the wrapper
+    that threads the allocation's per-layer ranks through
+    ``encode_tree``/``encode_leaf_subset``/``decode_tree`` as STATIC
+    per-leaf values (trace-time constant shapes under jit/scan/
+    stream-encode; the codecs.base group keys carry the resolved
+    per-leaf codec so vmap buckets stay shape-sound).
+  * :mod:`~atomo_tpu.budget.artifact` — ``budget_alloc.json``: the
+    allocation as a first-class run artifact (written atomically,
+    reused on ``--resume`` like ``tune_decision.json``) so
+    kill->restart->resume replays bit-exact from the recorded epochs.
+  * :mod:`~atomo_tpu.budget.retune` — the checkpoint-boundary
+    re-allocator: folds the recorded per-layer q_err2 series into fresh
+    spectra estimates and re-solves; a changed allocation lands as a
+    ``budget_realloc`` incident quoting old/new per-layer splits and
+    predicted variance both ways.
+  * :mod:`~atomo_tpu.budget.feedback` — error-feedback residual
+    accumulation (``--error-feedback``) documentation lives with the
+    carry implementation in ``parallel.replicated`` (EfState); this
+    package states the bias contract the tests assert.
+
+Grounding: SparCML (1802.08021) treats representation choice as a
+per-layer priced decision rather than a global constant; the q_err2
+probe (PR 11) makes the per-layer variance signal observable in-graph;
+streamed encode (PR 10) and ``--svd-mode randomized`` make repeated
+per-layer small SVDs affordable.
+"""
+
+from atomo_tpu.budget.allocator import (  # noqa: F401
+    Allocation,
+    LayerSpectrum,
+    allocation_leaf_budgets,
+    measure_spectra,
+    predicted_variance,
+    solve_allocation,
+    spectra_from_qerr2,
+    uniform_ks,
+)
+from atomo_tpu.budget.artifact import (  # noqa: F401
+    BUDGET_ALLOC_NAME,
+    alloc_path,
+    alloc_reusable,
+    allocation_meta,
+    append_epoch,
+    latest_epoch,
+    new_alloc_doc,
+    read_alloc,
+    write_alloc,
+)
+from atomo_tpu.budget.codec import PerLeafCodec, budgeted_codec  # noqa: F401
+from atomo_tpu.budget.retune import BudgetRetuner  # noqa: F401

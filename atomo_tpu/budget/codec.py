@@ -1,0 +1,81 @@
+"""PerLeafCodec — an allocation's per-layer ranks as a codec wrapper.
+
+The codecs.base tree walkers (``encode_tree`` / ``encode_leaf_subset`` /
+``encode_tree_streamed`` / ``decode_tree`` / ``decode_mean_tree``)
+resolve the codec PER LEAF through ``codecs.base.leaf_codec``; this
+wrapper is the thing they resolve. Design constraints it satisfies:
+
+  * STATIC per-leaf knobs: ``codec_for(i)`` returns a frozen dataclass
+    whose rank is a Python int, so every payload shape is a trace-time
+    constant — jit, the superstep ``lax.scan``, and the streamed
+    per-bucket encode all see fixed shapes (tested under all three).
+  * Key discipline untouched: the per-leaf fold_in keys are a function
+    of (key, global leaf index) alone, exactly as before — the wrapper
+    only swaps which static codec consumes them. With uniform ranks the
+    resolved codecs compare EQUAL to the base codec, the vmap group
+    keys coincide, and payloads are bit-identical to the unwrapped path
+    (the degenerate-point identity, tested byte-for-byte).
+  * Subset re-indexing: consumers that walk a partial leaf list with
+    local indices (the layered ring's per-bucket decode) re-index via
+    ``subset`` (see ``codecs.base.codec_subset``).
+
+The wrapper intentionally has NO whole-tensor ``encode``/``decode`` of
+its own: a per-leaf codec without a leaf index is a bug, and surfacing
+it as an AttributeError at the call site beats silently encoding every
+leaf at some default rank.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class PerLeafCodec:
+    """A base codec + one resolved (frozen) codec per canonical leaf."""
+
+    base: Any
+    codecs: tuple  # per-leaf frozen codec instances, canonical order
+    name: str = "svd+ab"
+
+    @property
+    def n_leaves(self) -> int:
+        return len(self.codecs)
+
+    @property
+    def ks(self) -> tuple:
+        return tuple(int(c.rank) for c in self.codecs)
+
+    def codec_for(self, i: int):
+        """The codec for GLOBAL leaf index ``i`` (codecs.base.leaf_codec
+        dispatch point)."""
+        if not 0 <= int(i) < len(self.codecs):
+            raise IndexError(
+                f"PerLeafCodec covers {len(self.codecs)} leaves but leaf "
+                f"{i} was requested — the allocation and the gradient "
+                "tree must come from the same model"
+            )
+        return self.codecs[int(i)]
+
+    def subset(self, idxs: tuple) -> "PerLeafCodec":
+        """Re-indexed wrapper for a sub-list of leaves (local position j
+        resolves to global leaf idxs[j] — codecs.base.codec_subset)."""
+        return PerLeafCodec(
+            base=self.base,
+            codecs=tuple(self.codecs[int(i)] for i in idxs),
+            name=self.name,
+        )
+
+
+def budgeted_codec(base, ks) -> PerLeafCodec:
+    """Wrap ``base`` with an allocation's per-leaf ranks (canonical
+    flatten order). Rank values must be static Python ints — they size
+    the wire payloads at trace time."""
+    return PerLeafCodec(
+        base=base,
+        codecs=tuple(
+            dataclasses.replace(base, rank=int(k)) for k in ks
+        ),
+        name=f"{getattr(base, 'name', 'codec')}+ab",
+    )

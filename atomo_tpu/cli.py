@@ -266,6 +266,46 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                    help="factor dtype on the wire: bfloat16 halves u/vt "
                         "bytes via stochastic rounding (E[wire] == factor, "
                         "so the codec stays unbiased); coeffs stay f32")
+    t.add_argument("--budget-alloc", type=str, default="uniform",
+                   choices=["uniform", "variance"],
+                   help="per-layer byte allocation (atomo_tpu.budget): "
+                        "uniform (default) = today's fixed --svd-rank on "
+                        "every layer, byte-identical HLO to the pre-budget "
+                        "programs; variance = solve ATOMO's water-filling "
+                        "allocation — measure per-layer gradient spectra "
+                        "from a startup probe, distribute the global wire "
+                        "budget to minimize total estimator variance, "
+                        "record it in train_dir/budget_alloc.json (reused "
+                        "on --resume; re-solved at checkpoint boundaries "
+                        "from the recorded q_err2 series when "
+                        "--obs-quality --obs-record are armed). Needs "
+                        "--code svd --sample fixed_k (the stated variance "
+                        "law A/k)")
+    t.add_argument("--budget-bytes", type=float, default=0.0, metavar="B",
+                   help="global wire-byte budget per replica for "
+                        "--budget-alloc variance (bytes; 0 = spend exactly "
+                        "the uniform allocation's total, the "
+                        "equal-wire-bytes comparison bench config 16 "
+                        "publishes). Large enough and every layer reaches "
+                        "the exact dense fallback — the --on-diverge "
+                        "densify remedy as the dial's spend-everything "
+                        "limit")
+    t.add_argument("--error-feedback", action="store_true", default=False,
+                   help="accumulate each replica's compression residual "
+                        "and feed it into the next step's encode "
+                        "(e' = (g+e) - decode(encode(g+e)); the residual "
+                        "rides the step carry and checkpoints like the "
+                        "overlap payload). BIAS CONTRACT: EF trades the "
+                        "codec's unbiasedness invariant for lower "
+                        "variance — intended pairing is the deterministic "
+                        "contraction sampler (--sample topk), whose bias "
+                        "the carry compensates (the standard EF "
+                        "guarantee); with the unbiased random samplers "
+                        "the residual is unbounded (measured divergent) "
+                        "and the CLI warns. Rejected for compositions "
+                        "whose carry semantics are unproven: delayed "
+                        "overlap, hierarchical re-encode, guard/elastic, "
+                        "sparse rows, num-aggregate, zero1/sharded-update")
     t.add_argument("--optimizer", type=str, default="sgd", choices=["sgd", "adam"])
     t.add_argument("--weight-decay", type=float, default=0.0)
     t.add_argument("--nesterov", action="store_true", default=False)
@@ -1048,6 +1088,156 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "the hierarchical boundary re-encode composes two "
                 "estimators per layer and is not probe-aware yet"
             )
+    if (
+        getattr(args, "budget_bytes", 0.0)
+        and getattr(args, "budget_alloc", "uniform") != "variance"
+    ):
+        raise SystemExit(
+            "--budget-bytes sizes the variance allocation's global wire "
+            "budget and needs --budget-alloc variance (uniform spends "
+            "the fixed --svd-rank budget per layer by definition)"
+        )
+    if getattr(args, "budget_alloc", "uniform") == "variance":
+        # the adaptive-budget conflict matrix, argv-knowable half: the
+        # water-filling solver implements the fixed_k variance law
+        # V(k) = A/k — every other pairing is rejected honestly until
+        # its law is stated too (allocator module docstring)
+        if args.code.lower() in DENSE_CODES:
+            raise SystemExit(
+                "--budget-alloc variance allocates a compressing codec's "
+                "per-layer budget; dense training has no budget to "
+                "allocate"
+            )
+        if args.code.lower() != "svd":
+            raise SystemExit(
+                f"--budget-alloc variance needs --code svd: the solver "
+                "implements the fixed_k rank-allocation variance law "
+                f"(A/k); per-layer bit allocation for {args.code!r} is "
+                "the same machinery with a different pricing/variance "
+                "pair and is not stated yet — rejected honestly"
+            )
+        if args.sample != "fixed_k":
+            raise SystemExit(
+                f"--budget-alloc variance needs --sample fixed_k (the "
+                f"stated variance law is the with-replacement sampler's "
+                f"A/k; --sample {args.sample} has a different law)"
+            )
+        if args.aggregate == "hierarchical" or plan_flag != "auto":
+            raise SystemExit(
+                "--budget-alloc variance needs flat gather/ring/psum "
+                "aggregation: the hierarchical boundary re-encode is not "
+                "allocation-aware yet"
+            )
+        if getattr(args, "sparse_rows", "off") != "off":
+            raise SystemExit(
+                "--budget-alloc variance does not compose with "
+                "--sparse-rows yet: the hybrid planner prices the dense "
+                "sub-list at the base codec's budget"
+            )
+        if args.phase_metrics:
+            raise SystemExit(
+                "--budget-alloc variance shapes the fused step's per-leaf "
+                "payloads; --phase-metrics has no fused step"
+                + _TIMELINE_HINT
+            )
+        if (
+            args.on_diverge != "off"
+            and getattr(args, "obs_quality", False)
+            and getattr(args, "obs_record", False)
+        ):
+            raise SystemExit(
+                "--budget-alloc variance with --obs-quality --obs-record "
+                "arms online re-allocation at checkpoint boundaries, "
+                "which cannot compose with --on-diverge: a rollback "
+                "would replay pre-reallocation steps under the "
+                "post-reallocation program — drop --on-diverge, or "
+                "freeze the allocation by dropping --obs-record or "
+                "--obs-quality"
+            )
+    if getattr(args, "error_feedback", False):
+        # the EfState bias-contract conflict matrix, argv-knowable half
+        # (parallel.replicated re-checks in the builder and the loop)
+        if args.code.lower() in DENSE_CODES:
+            raise SystemExit(
+                "--error-feedback accumulates the codec's compression "
+                "residual; dense training (--code sgd) has none"
+            )
+        if args.n_devices == 1:
+            raise SystemExit(
+                "--error-feedback needs a multi-device mesh: the "
+                "residual compensates the exchanged estimator's error, "
+                "and single-device training has no exchange"
+            )
+        if args.overlap == "delayed":
+            raise SystemExit(
+                "--error-feedback does not compose with --overlap "
+                "delayed: the stale carry's residual semantics are "
+                "unproven — rejected honestly"
+            )
+        if args.aggregate == "hierarchical" or plan_flag != "auto":
+            raise SystemExit(
+                "--error-feedback needs flat gather/ring/psum "
+                "aggregation: the hierarchical boundary re-encode's "
+                "unbiased-by-composition argument does not survive the "
+                "EF bias"
+            )
+        if getattr(args, "sparse_rows", "off") != "off":
+            raise SystemExit(
+                "--error-feedback does not compose with --sparse-rows "
+                "(the mixed per-leaf residual carry is untested)"
+            )
+        if args.num_aggregate is not None:
+            raise SystemExit(
+                "--error-feedback does not compose with --num-aggregate: "
+                "an unconsumed encode's residual would be mis-attributed"
+            )
+        if (
+            args.grad_guard or args.max_grad_norm > 0
+            or getattr(args, "elastic", False)
+        ):
+            raise SystemExit(
+                "--error-feedback does not compose with the gradient "
+                "guard (--grad-guard / --max-grad-norm) or --elastic: "
+                "skip-and-rescale rests on the unbiasedness EF trades "
+                "away"
+            )
+        if args.on_diverge != "off":
+            raise SystemExit(
+                "--error-feedback does not compose with --on-diverge: "
+                "the rollback reload does not rebuild the residual "
+                "template yet"
+            )
+        if _partition(args) != "replicated":
+            raise SystemExit(
+                "--error-feedback does not compose with --zero1 / "
+                "--partition sharded-update yet: the residual carry is "
+                "untested against the sharded state templates"
+            )
+        if args.phase_metrics:
+            raise SystemExit(
+                "--error-feedback needs the fused step (the residual "
+                "rides its carry); --phase-metrics has no fused step"
+                + _TIMELINE_HINT
+            )
+        if getattr(args, "auto", "off") == "tune":
+            raise SystemExit(
+                "--error-feedback does not compose with --auto tune "
+                "yet: the probe ladder does not build the residual-carry "
+                "program, so its timings would describe a different "
+                "step — pick knobs explicitly"
+            )
+        if not (args.code.lower() == "svd" and args.sample == "topk"):
+            # svd+topk is the one contraction estimator in the registry;
+            # every other compressing code (svd random samplers, qsgd,
+            # terngrad — unbiased stochastic quantizers) carries the
+            # same random-walk residual risk the bias contract states
+            warnings.warn(
+                "--error-feedback pairs with a CONTRACTION compressor "
+                "(--code svd --sample topk): the unbiased random "
+                "estimators make the residual a random walk (measured "
+                "divergent on the LeNet recipe); proceeding, but "
+                "svd+topk is the supported pairing"
+            )
     import os
 
     chaos_specs = [args.chaos] if args.chaos else []
@@ -1233,7 +1423,7 @@ def _real_stream_buckets(model_init_fn, bucket_bytes: int) -> int:
 
 
 def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
-                   save_freq, sparse_plan=None):
+                   save_freq, sparse_plan=None, budget_ctx=None):
     """``--auto tune``: run the startup probe ladder, apply the winning
     knob vector onto ``args`` (aggregate / overlap / ring bucket) and
     return ``(superstep, tuner)`` — the chosen fused-block size plus the
@@ -1410,6 +1600,16 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
                 and getattr(args, "sparse_rows", "off") == "auto"
             ),
             hybrid=sparse_plan,
+            # the +ab adaptive-budget variants: explored when
+            # --budget-alloc variance armed an allocation — priced from
+            # its clamped per-leaf pairs and probed with the wrapped
+            # codec swapped into the real step builder; the measured
+            # winner's budget_alloc knob decides (applied below)
+            allow_budget=budget_ctx is not None and n_dev > 1,
+            budget_leaf_budgets=(
+                budget_ctx["leaf_budgets"] if budget_ctx else None
+            ),
+            budget_codec=budget_ctx["codec"] if budget_ctx else None,
             stream_bucket_bytes=_stream_bucket_bytes(args),
             stream_buckets=_real_stream_buckets(
                 _init_params, _stream_bucket_bytes(args)
@@ -1476,6 +1676,8 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
     )
     # a +sp winner pins the hybrid plan on; cmd_train applies it
     args._tuned_sparse = knobs.get("sparse_rows", "off")
+    # a +ab winner pins the adaptive allocation on; cmd_train applies it
+    args._tuned_budget = knobs.get("budget_alloc", "off")
     superstep = max(int(knobs.get("superstep", 1)), 1)
     print(f"--auto tune -> {win.get('name')} ({doc.get('why')})", flush=True)
 
@@ -1491,14 +1693,22 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         and args.aggregate in ("gather", "ring")
     ):
         base = dict(knobs)
+        # a +ab winner's gather<->ring re-probe must time the wrapped-
+        # codec program the run actually dispatches
+        run_codec = (
+            budget_ctx["codec"]
+            if budget_ctx is not None
+            and knobs.get("budget_alloc") == "variance"
+            else codec
+        )
 
-        def probe_fn(mode, _base=base):
+        def probe_fn(mode, _base=base, _codec=run_codec):
             from atomo_tpu.utils.comm_model import candidate_name
 
             cand = {**_base, "aggregate": mode}
             cand["name"] = candidate_name(cand)
             row = probe_candidate(
-                cand, model=model, optimizer=optimizer, codec=codec,
+                cand, model=model, optimizer=optimizer, codec=_codec,
                 n_dev=n_dev, sample_shape=sample_shape,
                 num_classes=num_classes,
                 batch=probe_batch_size(args.batch_size, n_dev),
@@ -1847,11 +2057,116 @@ def cmd_train(args: argparse.Namespace) -> int:
                     "sparse — running all-dense",
                     flush=True,
                 )
+    budget_ctx = None  # --budget-alloc variance: allocation + wrapped codec
+    if args.budget_alloc == "variance":
+        from atomo_tpu.budget import (
+            Allocation,
+            alloc_reusable,
+            allocation_leaf_budgets,
+            budgeted_codec,
+            latest_epoch,
+            measure_spectra,
+            new_alloc_doc,
+            read_alloc,
+            solve_allocation,
+            write_alloc,
+        )
+        from atomo_tpu.sparse.hybrid import probe_gradient
+
+        # spectra from a probe gradient over a DIRECT slice of the
+        # training arrays (never epoch(): pulling a batch would advance
+        # the shuffle RNG — the sparse-rows/--aggregate auto precedent)
+        probe_n = min(max(args.batch_size, 8), len(train_iter.images))
+        spectra = measure_spectra(
+            codec,
+            probe_gradient(
+                model, train_iter.images[:probe_n],
+                train_iter.labels[:probe_n],
+            ),
+        )
+        budget_b = int(args.budget_bytes) if args.budget_bytes > 0 else None
+        alloc = None
+        doc = None
+        if args.resume and args.train_dir:
+            # the determinism contract: a resume replays bit-exact from
+            # the RECORDED allocation artifact — never a fresh probe
+            # solve (the tune_decision.json reuse precedent)
+            prior = read_alloc(args.train_dir)
+            ok_reuse, why = alloc_reusable(
+                prior, codec_name=codec.name, n_leaves=len(spectra)
+            )
+            if ok_reuse:
+                ep = latest_epoch(prior)
+                alloc = Allocation(
+                    mode=str(ep.get("mode", "variance")),
+                    ks=tuple(int(k) for k in ep["ks"]),
+                    payload_bytes=int(ep["payload_bytes"]),
+                    budget_bytes=int(
+                        ep.get("budget_bytes", prior["budget_bytes"])
+                    ),
+                    predicted_variance=float(
+                        ep.get("predicted_variance", 0.0)
+                    ),
+                    epoch=int(ep["epoch"]),
+                )
+                doc = prior
+                print(f"Budget: {why} (budget_alloc.json)", flush=True)
+            elif prior is not None:
+                print(f"Budget: NOT reusing budget_alloc.json: {why}",
+                      flush=True)
+        if alloc is None:
+            alloc = solve_allocation(
+                codec, spectra, budget_bytes=budget_b, mode="variance"
+            )
+            doc = new_alloc_doc(codec, spectra, alloc)
+            if args.train_dir:
+                path = write_alloc(args.train_dir, doc)
+                print(f"Budget: allocation artifact -> {path}", flush=True)
+        wrapped = budgeted_codec(codec, alloc.ks)
+        print(alloc.describe(), flush=True)
+        for l in spectra:
+            print(
+                f"  [{l.index}] {l.name}: k={alloc.ks[l.index]}"
+                + ("" if l.adaptive else " (dense at any rank — fixed)"),
+                flush=True,
+            )
+        budget_ctx = {
+            "base_codec": codec,
+            "codec": wrapped,
+            "spectra": spectra,
+            "alloc": alloc,
+            "doc": doc,
+            "leaf_budgets": allocation_leaf_budgets(
+                codec, spectra, alloc.ks
+            ),
+        }
+        if args.auto != "tune":
+            # pinned variance mode: the wrapped codec IS the run's codec
+            # (under --auto tune the +ab candidates compete and the
+            # measured winner decides below)
+            codec = wrapped
     tuner = None
     if args.auto == "tune":
         superstep, tuner = _run_autopilot(args, model, optimizer, codec,
                                           train_iter, n_dev, save_freq,
-                                          sparse_plan=sparse_plan)
+                                          sparse_plan=sparse_plan,
+                                          budget_ctx=budget_ctx)
+        if budget_ctx is not None:
+            if getattr(args, "_tuned_budget", "off") == "variance":
+                codec = budget_ctx["codec"]
+                print(
+                    "Budget: +ab winner — training with the adaptive "
+                    "allocation",
+                    flush=True,
+                )
+            else:
+                budget_ctx = None  # measured loser: uniform stays, out loud
+                print(
+                    "Budget: the measured ladder kept the uniform "
+                    "allocation (+ab lost or was not probed); "
+                    "--budget-alloc variance stands down",
+                    flush=True,
+                )
     hybrid_plan = None
     if sparse_plan is not None:
         if args.auto == "tune":
@@ -1916,6 +2231,13 @@ def cmd_train(args: argparse.Namespace) -> int:
             "--stream-encode needs a multi-device mesh: single-device "
             "training has no exchange whose encode is on the critical path"
         )
+    if args.error_feedback and n_dev <= 1:
+        # same resolved-count half of the preflight check
+        raise SystemExit(
+            "--error-feedback needs a multi-device mesh: this host "
+            "resolved to 1 device, so there is no exchanged estimator "
+            "whose error the residual would compensate"
+        )
     elastic_cfg = None
     if args.elastic:
         if n_dev <= 1:
@@ -1966,6 +2288,55 @@ def cmd_train(args: argparse.Namespace) -> int:
             predicted_ms=pred_ms,
             predicted_tier_ms=tier_ms,
         )
+    budget_tuner = None
+    if budget_ctx is not None:
+        from atomo_tpu.budget import allocation_meta, latest_epoch
+
+        if recorder is not None:
+            # the per-layer budget columns in metrics.jsonl: one meta
+            # line per allocation epoch + the budget_epoch context
+            # column on every step record (report's
+            # budget_alloc_consistent check audits both against
+            # budget_alloc.json)
+            ep = latest_epoch(budget_ctx["doc"])
+            recorder.write_meta(allocation_meta(ep))
+            recorder.set_context(budget_epoch=int(ep["epoch"]))
+        if (
+            n_dev > 1
+            and args.obs_quality and args.obs_record
+            and recorder is not None
+            and args.train_dir and save_freq
+            and args.on_diverge == "off"
+        ):
+            # online re-allocation: armed only when its signal (the
+            # recorded q_err2 series) actually lands on disk — a
+            # frozen allocation otherwise, said here
+            from atomo_tpu.budget import BudgetRetuner
+
+            budget_tuner = BudgetRetuner(
+                train_dir=args.train_dir,
+                base_codec=budget_ctx["base_codec"],
+                spectra=budget_ctx["spectra"],
+                alloc=budget_ctx["alloc"],
+                doc=budget_ctx["doc"],
+            )
+            print(
+                "Budget: online re-allocation armed (q_err2-fed re-solve "
+                "at checkpoint boundaries; decisions land in "
+                "incidents.jsonl as budget_realloc)",
+                flush=True,
+            )
+        else:
+            print(
+                "Budget: allocation frozen for this run"
+                + (
+                    ""
+                    if args.obs_quality and args.obs_record
+                    else " (arm --obs-quality --obs-record with a "
+                         "checkpoint cadence to re-solve at boundaries)"
+                ),
+                flush=True,
+            )
     if n_dev > 1:
         from atomo_tpu.parallel import distributed_train_loop, make_mesh
         from atomo_tpu.training import stepwise_shrink
@@ -2158,6 +2529,8 @@ def cmd_train(args: argparse.Namespace) -> int:
                 track_quality=args.obs_quality,
                 recorder=recorder,
                 hybrid=hybrid_plan,
+                error_feedback=args.error_feedback,
+                budget_tuner=budget_tuner,
             )
         except DivergenceError as exc:
             return _diverged_exit(exc)

@@ -9,11 +9,13 @@ are reachable: sgd (dense), svd, qsgd, terngrad.
 from atomo_tpu.codecs.base import (  # noqa: F401
     Codec,
     CodecStats,
+    codec_subset,
     decode_mean_tree,
     decode_tree,
     encode_leaf_subset,
     encode_tree,
     encode_tree_streamed,
+    leaf_codec,
     payload_nbytes,
     tree_nbytes,
 )

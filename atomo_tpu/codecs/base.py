@@ -46,6 +46,34 @@ class Codec(Protocol):
     ) -> jax.Array: ...
 
 
+def leaf_codec(codec: Codec, i: int) -> Codec:
+    """Resolve the codec encoding/decoding leaf ``i`` of the canonical
+    flatten order. Plain codecs are index-independent (returned as-is —
+    the historical behavior, byte-for-byte); a PER-LEAF wrapper (one with
+    a ``codec_for`` method, e.g. the adaptive budget allocator's
+    ``atomo_tpu.budget.PerLeafCodec``) dispatches on the GLOBAL leaf
+    index — the same index the fold_in key discipline uses, so a leaf's
+    (key, codec) pair is a function of the leaf alone and every bucket
+    partition / vmap grouping below stays bit-identical."""
+    fn = getattr(codec, "codec_for", None)
+    return codec if fn is None else fn(i)
+
+
+def codec_subset(codec: Codec, idxs) -> Codec:
+    """The codec for a SUB-LIST of leaves named by global indices
+    ``idxs`` (a stream-encode layer bucket, a hybrid dense sub-list):
+    per-leaf wrappers re-index so that local position ``j`` of the
+    sub-list resolves to global leaf ``idxs[j]``'s codec; plain codecs
+    pass through untouched. Needed wherever a consumer iterates a
+    partial leaf list with local indices (e.g. the layered ring's
+    per-bucket decode) — without this, a per-leaf wrapper would silently
+    decode bucket leaves with the wrong ranks."""
+    fn = getattr(codec, "subset", None)
+    if fn is None or getattr(codec, "codec_for", None) is None:
+        return codec
+    return fn(tuple(int(i) for i in idxs))
+
+
 def payload_nbytes(payload: Payload) -> int:
     """Static byte size of a payload pytree — the Msg(MB) analogue.
 
@@ -128,19 +156,28 @@ def encode_leaf_subset(
     out: list = [None] * len(idxs)
     if not bucketed:
         for j, i in enumerate(idxs):
-            out[j] = codec.encode(jax.random.fold_in(key, i), leaves[i])
+            out[j] = leaf_codec(codec, i).encode(
+                jax.random.fold_in(key, i), leaves[i]
+            )
         return out
+    # group key includes the RESOLVED per-leaf codec: a per-leaf wrapper
+    # may give two same-shaped leaves different static knobs (ranks), and
+    # vmapping those together would be a shape error — while for a plain
+    # codec the resolved object is one constant and the historical
+    # (shape, dtype) grouping is reproduced exactly
     groups: dict = {}
     for j, i in enumerate(idxs):
         leaf = leaves[i]
-        groups.setdefault((tuple(leaf.shape), str(leaf.dtype)), []).append(j)
-    for local in groups.values():
+        groups.setdefault(
+            (tuple(leaf.shape), str(leaf.dtype), leaf_codec(codec, i)), []
+        ).append(j)
+    for (_, _, g_codec), local in groups.items():
         keys = jnp.stack([jax.random.fold_in(key, idxs[j]) for j in local])
         if len(local) == 1:
-            out[local[0]] = codec.encode(keys[0], leaves[idxs[local[0]]])
+            out[local[0]] = g_codec.encode(keys[0], leaves[idxs[local[0]]])
             continue
         stacked = jnp.stack([leaves[idxs[j]] for j in local])
-        batch = jax.vmap(codec.encode)(keys, stacked)
+        batch = jax.vmap(g_codec.encode)(keys, stacked)
         for p, j in enumerate(local):
             out[j] = jax.tree.map(lambda a, p=p: a[p], batch)
     return out
@@ -182,14 +219,24 @@ def encode_tree_streamed(
     return jax.tree_util.tree_unflatten(treedef, payloads), stats
 
 
-def _shape_groups(leaves) -> dict:
-    """Group leaf indices by (shape, dtype) — the same bucketing key
-    ``encode_tree(bucketed=True)`` uses: same-shaped gradient leaves have
-    structurally identical payloads, so one vmapped decode serves them
-    all. Dict preserves insertion order, so grouping is deterministic."""
+def _shape_groups(leaves, codec=None, idxs=None) -> dict:
+    """Group leaf indices by (shape, dtype[, per-leaf codec]) — the same
+    bucketing key ``encode_tree(bucketed=True)`` uses: same-shaped
+    gradient leaves have structurally identical payloads, so one vmapped
+    decode serves them all. With ``codec`` given, the RESOLVED per-leaf
+    codec joins the key (``idxs`` maps local positions to global leaf
+    indices; identity when omitted) so a per-leaf wrapper's
+    differently-ranked payloads never share a vmap — a plain codec
+    resolves to one constant and reproduces the historical grouping
+    exactly. Dict preserves insertion order, so grouping is
+    deterministic."""
     groups: dict = {}
     for i, leaf in enumerate(leaves):
-        groups.setdefault((tuple(leaf.shape), str(leaf.dtype)), []).append(i)
+        gi = i if idxs is None else idxs[i]
+        key = (tuple(leaf.shape), str(leaf.dtype))
+        if codec is not None:
+            key = key + (leaf_codec(codec, gi),)
+        groups.setdefault(key, []).append(i)
     return groups
 
 
@@ -235,7 +282,8 @@ def decode_mean_tree(
     out: list = [None] * len(leaves)
     pending: list = []  # indices taking the vmap-decode + mean path
     for i, (p, g) in enumerate(zip(p_leaves, leaves)):
-        fused_fn = getattr(codec, "decode_mean", None) if fused else None
+        c_i = leaf_codec(codec, i)
+        fused_fn = getattr(c_i, "decode_mean", None) if fused else None
         if fused_fn is not None:
             decoded = fused_fn(p, tuple(g.shape), g.dtype, n_replicas)
             if decoded is not None:
@@ -243,30 +291,35 @@ def decode_mean_tree(
                 continue
         pending.append(i)
 
-    def vmap_mean(p, shape, dtype):
-        decoded = jax.vmap(lambda q: codec.decode(q, shape, dtype))(p)
+    def vmap_mean(c, p, shape, dtype):
+        decoded = jax.vmap(lambda q: c.decode(q, shape, dtype))(p)
         return jnp.mean(decoded, axis=0)
 
     if bucketed and pending:
-        groups = _shape_groups([leaves[i] for i in pending])
-        for (shape, _), local in groups.items():
+        groups = _shape_groups(
+            [leaves[i] for i in pending], codec=codec, idxs=pending
+        )
+        for gkey, local in groups.items():
             idxs = [pending[j] for j in local]
             g0 = leaves[idxs[0]]
+            c0 = leaf_codec(codec, idxs[0])
             if len(idxs) == 1:
                 out[idxs[0]] = vmap_mean(
-                    p_leaves[idxs[0]], tuple(g0.shape), g0.dtype
+                    c0, p_leaves[idxs[0]], tuple(g0.shape), g0.dtype
                 )
                 continue
             stacked = _stack_payloads([p_leaves[i] for i in idxs])
             batch = jax.vmap(
-                lambda q: vmap_mean(q, tuple(g0.shape), g0.dtype)
+                lambda q: vmap_mean(c0, q, tuple(g0.shape), g0.dtype)
             )(stacked)
             for j, i in enumerate(idxs):
                 out[i] = batch[j]
     else:
         for i in pending:
             g = leaves[i]
-            out[i] = vmap_mean(p_leaves[i], tuple(g.shape), g.dtype)
+            out[i] = vmap_mean(
+                leaf_codec(codec, i), p_leaves[i], tuple(g.shape), g.dtype
+            )
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
@@ -286,21 +339,22 @@ def decode_tree(
     p_leaves = treedef.flatten_up_to(payloads)
     if not bucketed:
         decoded = [
-            codec.decode(p, tuple(g.shape), g.dtype)
-            for p, g in zip(p_leaves, leaves)
+            leaf_codec(codec, i).decode(p, tuple(g.shape), g.dtype)
+            for i, (p, g) in enumerate(zip(p_leaves, leaves))
         ]
         return jax.tree_util.tree_unflatten(treedef, decoded)
     out: list = [None] * len(leaves)
-    for (shape, _), idxs in _shape_groups(leaves).items():
+    for gkey, idxs in _shape_groups(leaves, codec=codec).items():
         g0 = leaves[idxs[0]]
+        c0 = leaf_codec(codec, idxs[0])
         if len(idxs) == 1:
-            out[idxs[0]] = codec.decode(
+            out[idxs[0]] = c0.decode(
                 p_leaves[idxs[0]], tuple(g0.shape), g0.dtype
             )
             continue
         stacked = _stack_payloads([p_leaves[i] for i in idxs])
         batch = jax.vmap(
-            lambda q: codec.decode(q, tuple(g0.shape), g0.dtype)
+            lambda q: c0.decode(q, tuple(g0.shape), g0.dtype)
         )(stacked)
         for j, i in enumerate(idxs):
             out[i] = batch[j]

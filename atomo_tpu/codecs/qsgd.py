@@ -138,15 +138,19 @@ class QsgdCodec:
         inside the otherwise-jnp path (ops.qsgd_kernels.pallas_pack_bucketed
         / pallas_unpack_bucketed — the bit-pack behind ``--stream-encode``'s
         per-bucket boundary, with the jnp pack_bucketed/unpack_bucketed as
-        the bit-parity oracle). None = the jnp path, same as False — the
-        use_pallas precedent applies (round 4 flipped kernel auto-selection
-        OFF after the fused kernel measured slower than XLA's fusion on
-        v5e, and THIS kernel has no hardware measurement yet; a measured
-        TPU win flips the default with evidence, like that one would).
-        True opts in: compiled on real TPU, interpreted off-TPU (the
-        automatic fallback — tests drive it there against the jnp oracle).
-        Bit-identical wire either way. Moot when the full ``use_pallas``
-        kernel runs (that path packs inside its own kernel already).
+        the bit-parity oracle). None = consult the MEASURED-WIN DECISION
+        RECORD (ops.qsgd_kernels.PACK_KERNEL_MEASURED_WINS, resolved by
+        pack_kernel_default): the use_pallas precedent codified — the
+        kernel is default-ON exactly on TPU device kinds with a recorded
+        measured hardware win (none yet; bench.py measures both paths
+        each round and the first win graduates it by adding one evidence
+        entry), and the jnp oracle everywhere else, with every off-TPU
+        backend falling back automatically by construction.
+        True opts in unconditionally: compiled on real TPU, interpreted
+        off-TPU (tests drive it there against the jnp oracle); False
+        forces jnp. Bit-identical wire every way. Moot when the full
+        ``use_pallas`` kernel runs (that path packs inside its own
+        kernel already).
     """
 
     bits: int = 2
@@ -182,11 +186,16 @@ class QsgdCodec:
         return not is_tpu()
 
     def _pack_kernel(self) -> bool:
-        """Resolve ``pack_kernel``: None = jnp (the use_pallas precedent —
-        no kernel auto-selects without a measured hardware win; see the
-        field docstring); True = the fused kernel, interpreted off-TPU."""
+        """Resolve ``pack_kernel``: None consults the measured-win
+        decision record (ops.qsgd_kernels.pack_kernel_default — the
+        use_pallas precedent as a MECHANISM: default-on exactly on TPU
+        device kinds with a recorded measured win, the jnp oracle
+        everywhere else including every off-TPU backend); True forces
+        the kernel (interpreted off-TPU); False forces jnp."""
         if self.pack_kernel is None:
-            return False
+            from atomo_tpu.ops.qsgd_kernels import pack_kernel_default
+
+            return pack_kernel_default()
         return bool(self.pack_kernel)
 
     def _pack(self, codes_p: jax.Array) -> jax.Array:

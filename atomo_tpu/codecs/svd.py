@@ -478,6 +478,42 @@ class SvdCodec:
             vt=stochastic_round(kv, payload.vt),
         )
 
+    def leaf_payload_bytes(self, grad_shape: tuple[int, ...]) -> int:
+        """Static wire bytes of ``encode``'s payload for one gradient leaf
+        — the CLAMPED actual, priced without tracing.
+
+        This is the analytic twin of ``jax.eval_shape`` over ``encode``
+        (pinned equal per sampler/algorithm/wire-dtype in
+        tests/test_comm_model.py): every slot count is the one the encode
+        path really ships — ``_payload_k`` clamps ``rank`` (and
+        ``rank + budget_slack`` for the Bernoulli budget) to the matrix's
+        full rank, the sketch's probe atoms appear exactly when the
+        randomized algorithm resolves, and the dense fallback prices the
+        exact DensePayload. The adaptive budget allocator
+        (atomo_tpu.budget) prices every candidate rank through this, so
+        a predicted allocation total and the executed program's
+        ``msg_bytes`` agree to the byte."""
+        shape = tuple(int(d) for d in grad_shape)
+        total = 1
+        for d in shape:
+            total *= d
+        if self._dense_fallback(shape):
+            return total * 4  # exact DensePayload, f32 values
+        m, n = (
+            _square_dims(total, self.max_min_dim)
+            if self.reshape == "square"
+            else resize_to_2d(jnp.zeros(shape), self.reshape)[0].shape
+        )
+        m, n = int(m), int(n)
+        wire = 2 if self.wire_dtype == "bfloat16" else 4
+        if self.sample == "bernoulli":
+            # full-width masked factors: u (m, r) + s (r,) f32 + vt (r, n)
+            r = min(m, n)
+            return (m * r + r * n) * wire + r * 4
+        k = self._payload_k(min(m, n)) + self._n_probes(m, n)
+        # u (m, k) + coeff (k,) f32 + vt (k, n)
+        return (m * k + k * n) * wire + k * 4
+
     # -- encode ------------------------------------------------------------
     def encode(self, key: PRNGKey, grad: jax.Array):
         if self._dense_fallback(tuple(grad.shape)):

@@ -69,6 +69,7 @@ _SCALAR_KEYS = (
     "dropped",
     "grad_norm",
     "ok_bits",
+    "ef_res_norm",
 )
 # per-layer vector columns (the --obs-quality probes): recorded as lists
 _VECTOR_KEYS = ("q_err2", "q_rel")

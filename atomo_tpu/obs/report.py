@@ -33,6 +33,10 @@ artifacts audit each other instead of being trusted independently:
   * ``drift_blame_present`` — every ``perf_drift`` retune incident
     carries the quantified blame record (step-ms pair always; per-tier
     baseline/measured GB/s on a fabric verdict).
+  * ``budget_alloc_consistent`` — the per-layer budget columns in
+    metrics.jsonl (the ``budget_alloc_epochN`` meta lines and the
+    per-step ``budget_epoch`` column) match the recorded allocation
+    epochs in ``budget_alloc.json``, byte for byte and span for span.
 
 A check whose source artifact is absent is SKIPPED (reported, not
 failed): a run without elastic has no membership to agree with.
@@ -394,6 +398,97 @@ def _check_fabric_probe(tune, fabric_probe, incidents=()) -> dict:
     )
 
 
+def _check_budget_alloc(steps: list[dict], metas: list[dict],
+                        budget_doc) -> dict:
+    """``budget_alloc_consistent`` — the per-layer budget columns in
+    metrics.jsonl must match the recorded allocation artifact: every
+    ``budget_alloc_epochN`` meta line's epoch exists in
+    budget_alloc.json with the SAME per-layer payload sum, and every
+    step record's ``budget_epoch`` column matches the epoch whose span
+    covers that step (re-allocations snap to checkpoint boundaries, so
+    the column must switch exactly at each recorded ``start_step`` —
+    the retunes_visible discipline applied to the budget dial). Skipped
+    when no allocation was recorded (non-adaptive runs)."""
+    name = "budget_alloc_consistent"
+    b_metas = [
+        m for m in metas
+        if str(m.get("what", "")).startswith("budget_alloc_epoch")
+    ]
+    if not budget_doc and not b_metas:
+        return _check(
+            name, True, "no budget allocation recorded", skipped=True
+        )
+    if not budget_doc:
+        return _check(
+            name, False,
+            "metrics.jsonl carries budget_alloc meta lines but "
+            "budget_alloc.json is missing or unparseable — the "
+            "allocation source is gone",
+        )
+    epochs = {
+        int(e.get("epoch", -1)): e for e in budget_doc.get("epochs", [])
+    }
+    bad = []
+    if not epochs:
+        bad.append("budget_alloc.json records no allocation epochs")
+    for m in b_metas:
+        ep = m.get("budget_epoch")
+        if ep not in epochs:
+            bad.append(
+                f"meta line records allocation epoch {ep!r} but the "
+                f"artifact holds {sorted(epochs) or 'none'}"
+            )
+            continue
+        meta_sum = sum(
+            int(l.get("payload_bytes", 0))
+            for l in (m.get("layers") or [])
+        )
+        art = int(epochs[ep].get("payload_bytes", -1))
+        if meta_sum != art:
+            bad.append(
+                f"epoch {ep}: meta per-layer payload sum {meta_sum} B "
+                f"!= artifact's {art} B — the recorded columns and the "
+                "allocation disagree about a byte"
+            )
+    recs = [r for r in steps if r.get("budget_epoch") is not None]
+    if epochs and recs:
+        starts = sorted(
+            (int(e.get("start_step", 0)), ep)
+            for ep, e in epochs.items()
+        )
+
+        def active(step: int) -> int:
+            cur = starts[0][1]
+            for s0, ep in starts:
+                if s0 < step:
+                    cur = ep
+                else:
+                    break
+            return cur
+
+        wrong = [
+            (int(r["step"]), int(r["budget_epoch"]),
+             active(int(r["step"])))
+            for r in recs
+            if int(r["budget_epoch"]) != active(int(r["step"]))
+        ]
+        if wrong:
+            bad.append(
+                f"step {wrong[0][0]} records budget_epoch "
+                f"{wrong[0][1]} but the artifact's spans say "
+                f"{wrong[0][2]} (+{len(wrong) - 1} more)"
+            )
+    return _check(
+        name,
+        not bad,
+        "; ".join(bad[:5])
+        or (
+            f"{len(b_metas)} allocation epoch meta(s) and "
+            f"{len(recs)} step record(s) agree with budget_alloc.json"
+        ),
+    )
+
+
 def _check_drift_blame(incidents) -> dict:
     """``drift_blame_present`` — every ``perf_drift`` RETUNE incident
     (action ``retune->X`` / ``retune_keep``) must carry the blame record
@@ -469,6 +564,9 @@ def build_report(train_dir: str) -> dict:
     from atomo_tpu.obs.fabric import read_fabric_probe
 
     fabric_probe = read_fabric_probe(train_dir)
+    from atomo_tpu.budget.artifact import read_alloc
+
+    budget_doc = read_alloc(train_dir)
 
     events: list[dict] = []
     events.extend(_segments(steps))
@@ -527,6 +625,7 @@ def build_report(train_dir: str) -> dict:
         _check_quality_density(metas),
         _check_fabric_probe(tune, fabric_probe, incidents),
         _check_drift_blame(incidents),
+        _check_budget_alloc(steps, metas, budget_doc),
     ]
     consistent = all(c["ok"] for c in checks)
     summary = {
@@ -548,6 +647,7 @@ def build_report(train_dir: str) -> dict:
             "membership_json": len(epochs),
             "tune_decision_json": tune is not None,
             "fabric_probe_json": fabric_probe is not None,
+            "budget_alloc_json": budget_doc is not None,
         },
         "summary": summary,
         "timeline": events,

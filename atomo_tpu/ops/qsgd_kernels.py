@@ -57,6 +57,48 @@ def is_tpu() -> bool:
         return False
 
 
+# ---------------------------------------------------------------------------
+# Pack-kernel default decision record (the use_pallas precedent, codified)
+# ---------------------------------------------------------------------------
+#
+# The round-4 rule for every hand kernel in this repo: NO kernel
+# auto-selects without a measured hardware win on record (the fused
+# use_pallas quantize kernel measured SLOWER than XLA's fusion on v5e —
+# encode 2.68/2.79 ms pallas vs 2.52/2.59 ms jnp, 8.4M values — and its
+# auto-selection was flipped OFF with those numbers quoted). This table
+# makes the rule a MECHANISM instead of a docstring: ``pack_kernel=None``
+# resolves default-ON exactly for the device kinds listed here with a
+# measured win, and to the jnp oracle everywhere else — including every
+# non-TPU backend, which stays the automatic fallback unconditionally.
+# A future bench round that records a pack-kernel win on real hardware
+# graduates the kernel by adding one entry with its evidence pointer; no
+# code-path change, and the decision is auditable in-place.
+PACK_KERNEL_MEASURED_WINS: dict = {
+    # device-kind substring (lowercase) -> {"win": bool, "evidence": str}
+    #
+    # No entry yet: the bucketed pack/unpack kernels (PR 10) have no
+    # real-TPU measurement on record — bench.py measures both paths each
+    # round, and the first recorded win lands here with its artifact.
+}
+
+
+def pack_kernel_default() -> bool:
+    """Resolve ``QsgdCodec.pack_kernel=None``: True only on a real TPU
+    whose device kind has a measured win recorded in
+    :data:`PACK_KERNEL_MEASURED_WINS`; False (the jnp oracle) everywhere
+    else — off-TPU backends fall back automatically by construction."""
+    if not is_tpu():
+        return False
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return False
+    for tag, rec in PACK_KERNEL_MEASURED_WINS.items():
+        if tag in kind and rec.get("win"):
+            return True
+    return False
+
+
 def _interpret_mode(interpret: bool):
     """True → the TPU-semantics interpreter (generic interpret mode has no
     CPU lowering for pltpu.prng_* primitives). On jax versions without

@@ -18,9 +18,11 @@ from atomo_tpu.parallel.launch import (  # noqa: F401
 )
 from atomo_tpu.parallel.replicated import (  # noqa: F401
     DelayedState,
+    EfState,
     OverlapCarry,
     distributed_train_loop,
     init_delayed_state,
+    init_ef_state,
     make_delayed_oracle_steps,
     make_distributed_eval_step,
     make_distributed_train_step,

@@ -206,6 +206,87 @@ def init_delayed_state(
     )
 
 
+@flax.struct.dataclass
+class EfState:
+    """``TrainState`` + the error-feedback residual (``--error-feedback``).
+
+    ``residual`` holds each chip's accumulated compression error with a
+    leading per-chip axis (global shape ``(n_dev,) + param_shape``
+    sharded over the dp axis — the :class:`OverlapCarry` layout), so it
+    rides the step carry through superstep scans, program boundaries
+    and checkpoints: kill->restart->resume restores the residual and
+    replays bit-exact.
+
+    THE BIAS CONTRACT, stated (and asserted in tests/test_budget.py):
+    error feedback TRADES the codec's unbiasedness invariant for lower
+    variance. Each step encodes ``g_t + e_t`` and carries
+    ``e_{t+1} = (g_t + e_t) - decode(encode(g_t + e_t))`` — the
+    single-step estimator is BIASED toward the residual, and every
+    contract in this codebase that rests on E[decode] == g (the guard's
+    n/kept rescale, the hierarchical boundary re-encode's composition
+    argument, the delayed carry's stale-mean semantics) no longer holds
+    by that argument. What holds instead is the telescoping identity:
+    the sum of applied updates equals the sum of true gradients minus
+    the one in-flight residual, so the error is bounded, not compounding
+    — the standard EF guarantee. Compositions whose carry semantics are
+    unproven under that weaker contract (delayed overlap, hierarchical
+    re-encode, the guard's skip-and-rescale, hybrid rows, num_aggregate
+    subsets, the sharded state families) are rejected honestly by the
+    builder and the CLI preflight."""
+
+    train: TrainState
+    residual: Any
+
+    @property
+    def step(self):
+        return self.train.step
+
+    @property
+    def params(self):
+        return self.train.params
+
+    @property
+    def batch_stats(self):
+        return self.train.batch_stats
+
+
+def _zero_ef_residual_host(params, n_dev: int):
+    """Host-side all-zero residual (the step-0 value and the resume
+    template): one zero gradient-shaped tree per chip, leading (n_dev,)
+    axis. Zero is the honest start — the first step's encode input is
+    exactly the raw gradient, so an EF run's step 1 equals the plain
+    run's step 1 bit for bit."""
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros((n_dev,) + tuple(jnp.shape(p)), jnp.float32),
+        params,
+    )
+
+
+def _place_ef_residual(mesh: Mesh, residual, *, axis: str = "dp"):
+    """Place a host-side residual onto the mesh, sharded over ``axis``
+    (the _place_carry discipline: fresh init and --resume must place
+    identically or a restored trajectory drifts)."""
+    sh = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(jnp.asarray(a), sh), residual
+    )
+
+
+def init_ef_state(mesh: Mesh, state, *, axis: str = "dp") -> EfState:
+    """Wrap a replicated state into the fresh :class:`EfState` an
+    ``--error-feedback`` step consumes (zero residual per chip)."""
+    return EfState(
+        train=state,
+        residual=_place_ef_residual(
+            mesh,
+            _zero_ef_residual_host(
+                jax.device_get(state.params), mesh.shape[axis]
+            ),
+            axis=axis,
+        ),
+    )
+
+
 def _zero1_chunk(flat_size: int, n_dev: int) -> int:
     """Per-chip slice length of the flat ZeRO-1 buffers. ONE definition
     (mesh.update.chunk_len — shared with the full sharded-update family):
@@ -477,9 +558,13 @@ def _ring_stream_mean_layered(
     p_leaves = treedef.flatten_up_to(payloads)
     out: list = [None] * len(leaves)
     ok_stage = None
+    from atomo_tpu.codecs.base import codec_subset
+
     for idxs in plan.buckets:
         mean_b, ok_b = _ring_stream_mean(
-            codec,
+            # per-leaf wrappers (adaptive budgets) re-index to the
+            # bucket's global leaves; plain codecs pass through untouched
+            codec_subset(codec, idxs),
             [p_leaves[i] for i in idxs],
             [leaves[i] for i in idxs],
             axis=axis, n_dev=n_dev, my=my,
@@ -680,9 +765,28 @@ def make_distributed_train_step(
     plan=None,
     hybrid=None,
     sharded_update: Optional[ShardedUpdateSpecs] = None,
+    error_feedback: bool = False,
     _oracle_parts: bool = False,
 ):
     """Build the jitted SPMD train step over ``mesh``.
+
+    ``error_feedback`` (``--error-feedback``; flat blocking gather/ring/
+    psum with a codec) arms error-feedback residual accumulation: the
+    step takes and returns an :class:`EfState` whose per-chip residual
+    rides the carry like :class:`OverlapCarry` does. Each chip encodes
+    ``g + e`` instead of ``g``, decodes its OWN payload once more
+    (per-chip extra decode — the obs-quality probe's cost class, stated)
+    and carries ``e' = (g + e) - decode(encode(g + e))``. The BIAS
+    CONTRACT is stated on :class:`EfState`: EF trades the unbiasedness
+    invariant for lower variance, so every composition whose carry
+    semantics rest on unbiasedness — delayed overlap, the hierarchical
+    boundary re-encode, the guard's skip-and-rescale (and therefore
+    elastic), hybrid rows, num_aggregate, zero1/sharded-update — is
+    rejected honestly here and at preflight. Superstep (the residual
+    rides the scan carry, bit-identical for any block partition),
+    stream-encode (only the encode INPUT changes) and the quality
+    probes (q_err2 then describes the residual-fed estimator, which is
+    the estimator actually shipped) compose.
 
     ``sharded_update`` (mesh.update.ShardedUpdateSpecs, from
     :func:`atomo_tpu.mesh.sharded_update_state`) switches the program to
@@ -1060,6 +1164,57 @@ def make_distributed_train_step(
                 "rejected honestly rather than silently mis-attributed"
             )
 
+    if error_feedback:
+        # the EfState bias contract's conflict matrix (see the class
+        # docstring): every reject below is a composition whose carry
+        # semantics rest on the unbiasedness EF trades away
+        if codec is None:
+            raise ValueError(
+                "error_feedback accumulates the codec's compression "
+                "residual; dense training has no residual to accumulate"
+            )
+        if hierarchical or planned:
+            raise ValueError(
+                "error_feedback needs flat aggregation: the hierarchical "
+                "boundary re-encode composes two estimators per layer "
+                "and its unbiased-by-composition argument does not "
+                "survive the EF bias — rejected honestly"
+            )
+        if overlap == "delayed":
+            raise ValueError(
+                "error_feedback does not compose with overlap='delayed': "
+                "the carried payload is consumed one step late, so the "
+                "residual would describe a stale encode — the carry "
+                "semantics are unproven; rejected honestly"
+            )
+        if guard is not None:
+            raise ValueError(
+                "error_feedback does not compose with the guard (and "
+                "therefore elastic membership): skip-and-rescale rests "
+                "on the unbiasedness EF trades away, and a skipped "
+                "step's residual semantics are unproven — run EF "
+                "unguarded"
+            )
+        if hybrid is not None:
+            raise ValueError(
+                "error_feedback does not compose with hybrid= (the "
+                "sparse rows are lossless — a zero residual — but the "
+                "mixed per-leaf carry is untested); run one or the other"
+            )
+        if k_agg:
+            raise ValueError(
+                "error_feedback does not compose with num_aggregate: a "
+                "rotating subset consumes only some replicas' payloads, "
+                "so the residual of an unconsumed encode would be "
+                "mis-attributed"
+            )
+        if zero1_specs is not None or sharded_update is not None:
+            raise ValueError(
+                "error_feedback does not compose with zero1/"
+                "sharded-update yet: the residual carry is untested "
+                "against the sharded state templates"
+            )
+
     if hybrid is not None:
         if aggregate == "hierarchical":
             raise ValueError(
@@ -1239,6 +1394,15 @@ def make_distributed_train_step(
 
     def spmd_step(state: TrainState, key, images, labels):
         sstate = None
+        ef_res = None
+        new_ef_res = None
+        if error_feedback:
+            # unwrap the EfState; this chip's residual drops its leading
+            # per-chip axis (the OverlapCarry layout convention)
+            ef_state, state = state, state.train
+            ef_res = jax.tree_util.tree_map(
+                lambda a: jnp.squeeze(a, 0), ef_state.residual
+            )
         if su is not None:
             # sharded-persistent master: materialize the working params
             # transiently (exact bytes of the replicated params), then
@@ -1253,6 +1417,14 @@ def make_distributed_train_step(
         my, k_codec, grads, loss, prec1, prec5, new_stats = compute_grads(
             state, key, images, labels
         )
+        if ef_res is not None:
+            # error feedback: the estimator's input is g + e — the raw
+            # gradient plus this chip's accumulated compression error
+            # (EfState bias contract; guard/diverge are rejected with
+            # EF, so every downstream consumer sees the fed gradient)
+            grads = jax.tree_util.tree_map(
+                lambda g, e: g + e.astype(g.dtype), grads, ef_res
+            )
         gnorm = _local_grad_norm(grads) if track_grad_norm else None
 
         ok = kept = None  # guard-mode: local health flag / surviving count
@@ -1352,6 +1524,18 @@ def make_distributed_train_step(
                 else:
                     payloads, stats = encode_tree(codec, k_codec, grads)
             msg_bytes = stats.payload_bytes
+            if ef_res is not None:
+                # this chip's OWN decode once more (the obs-quality cost
+                # class — XLA dedups what it can against the psum
+                # branch's decode): the next step's residual is the part
+                # of the fed gradient the wire did NOT carry
+                decoded_self = decode_tree(codec, payloads, grads)
+                new_ef_res = jax.tree_util.tree_map(
+                    lambda g, d: g.astype(jnp.float32)
+                    - d.astype(jnp.float32),
+                    grads,
+                    decoded_self,
+                )
             if track_quality:
                 from atomo_tpu.obs.quality import quality_probe
 
@@ -1594,6 +1778,23 @@ def make_distributed_train_step(
                 batch_stats=new_stats,
                 opt_state=new_opt,
             )
+        if error_feedback:
+            # the residual's global L2 — the bounded-error half of the
+            # EF contract, observable live (a compounding residual would
+            # mean the telescoping argument broke)
+            res_sq = sum(
+                jnp.sum(jnp.square(r.astype(jnp.float32)))
+                for r in jax.tree_util.tree_leaves(new_ef_res)
+            )
+            metrics["ef_res_norm"] = jax.lax.pmean(
+                jnp.sqrt(res_sq), metric_axes
+            )
+            new_state = EfState(
+                train=new_state,
+                residual=jax.tree_util.tree_map(
+                    lambda a: a[None], new_ef_res
+                ),
+            )
         return new_state, metrics
 
     if su is not None:
@@ -1606,6 +1807,11 @@ def make_distributed_train_step(
                 step=P(), params=P(), batch_stats=P(), opt_state=zero1_specs
             )
         )
+    if error_feedback:
+        # the EF family's state spec: replicated train state + the
+        # per-chip residual sharded over the data axis (the
+        # OverlapCarry layout)
+        state_spec = EfState(train=state_spec, residual=P(axis))
     if overlap == "delayed":
         n_contrib_d = k_agg or n_dev
 
@@ -2187,6 +2393,8 @@ def distributed_train_loop(
     track_quality: bool = False,
     recorder=None,
     hybrid=None,
+    error_feedback: bool = False,
+    budget_tuner=None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -2292,6 +2500,25 @@ def distributed_train_loop(
     and the quality meta record gains the plan's per-layer density and
     assignment columns.
 
+    ``error_feedback`` (``--error-feedback``) threads an
+    :class:`EfState` through the loop: the per-chip residual rides the
+    step carry, checkpoints hold it (kill->restart->resume replays
+    bit-exact — drilled in tests/test_budget.py), and the EfState bias
+    contract's conflict matrix is enforced here and in the builder.
+
+    ``budget_tuner`` (budget.BudgetRetuner; needs ``--budget-alloc
+    variance`` with the q series recorded: ``--obs-quality`` +
+    ``--obs-record``) arms checkpoint-boundary budget re-allocation:
+    the retune hook consults it at every save boundary; a changed
+    allocation appends an epoch to ``budget_alloc.json``, lands a
+    ``budget_realloc`` incident quoting old/new per-layer splits and
+    predicted variance both ways, and the step program is rebuilt with
+    the new per-leaf codec — a program-family boundary snapped to the
+    checkpoint exactly, so a resume replays bit-exact from the
+    recorded epoch. Not supported with ``--on-diverge`` (a rollback
+    would replay pre-reallocation steps under the post-reallocation
+    program).
+
     ``sharded_update`` (``--partition sharded-update``) runs the
     cross-replica sharded weight update (mesh.update, 2004.13336):
     master weights AND optimizer state persist sharded over the data
@@ -2350,6 +2577,72 @@ def distributed_train_loop(
             "has no fused step to re-pick — drop one"
             + PHASE_METRICS_HINT
         )
+    if error_feedback:
+        # loop-level half of the EfState conflict matrix (the builder
+        # re-checks; these need the loop's own knobs)
+        if codec is None or aggregate == "hierarchical":
+            raise ValueError(
+                "--error-feedback needs a compressing codec with flat "
+                "gather/ring/psum aggregation (the hierarchical boundary "
+                "re-encode's composition argument does not survive the "
+                "EF bias)"
+            )
+        if overlap == "delayed":
+            raise ValueError(
+                "--error-feedback does not compose with --overlap "
+                "delayed: the stale carry's residual semantics are "
+                "unproven — rejected honestly"
+            )
+        if guard is not None or elastic is not None:
+            raise ValueError(
+                "--error-feedback does not compose with --grad-guard / "
+                "--elastic: skip-and-rescale rests on the unbiasedness "
+                "EF trades away"
+            )
+        if diverge is not None:
+            raise ValueError(
+                "--error-feedback does not compose with --on-diverge: "
+                "the rollback reload does not rebuild the residual "
+                "template yet — drop one"
+            )
+        if zero1 or sharded_update:
+            raise ValueError(
+                "--error-feedback does not compose with --zero1 / "
+                "--partition sharded-update yet: the residual carry is "
+                "untested against the sharded state templates"
+            )
+        if phase_metrics:
+            raise ValueError(
+                "--error-feedback needs the fused step (the residual "
+                "rides its carry); --phase-metrics has no fused step"
+                + PHASE_METRICS_HINT
+            )
+        if hybrid is not None or num_aggregate:
+            raise ValueError(
+                "--error-feedback does not compose with --sparse-rows / "
+                "--num-aggregate (see make_distributed_train_step's "
+                "conflict matrix)"
+            )
+    if budget_tuner is not None:
+        if diverge is not None:
+            raise ValueError(
+                "--budget-alloc variance online re-allocation does not "
+                "compose with --on-diverge: a rollback would replay "
+                "pre-reallocation steps under the post-reallocation "
+                "program — freeze the allocation (drop --obs-record or "
+                "--obs-quality) or drop --on-diverge"
+            )
+        if not (track_quality and recorder is not None and train_dir):
+            raise ValueError(
+                "budget_tuner needs its signal on disk: --obs-quality + "
+                "--obs-record + a --train-dir (the recorded q_err2 "
+                "series is what the boundary re-solve folds)"
+            )
+        if not save_freq:
+            raise ValueError(
+                "budget_tuner re-allocates at checkpoint boundaries and "
+                "needs a save cadence (--save-freq or --eval-freq > 0)"
+            )
     if track_quality and phase_metrics:
         raise ValueError(
             "--obs-quality probes the fused step's encode in-graph; "
@@ -2472,6 +2765,7 @@ def distributed_train_loop(
     zero1_specs = None
     su_specs = None
     delayed_carry_host = None  # restored in-flight payload (delayed resume)
+    ef_residual_host = None  # restored EF residual (--error-feedback resume)
     want_resume = resume and train_dir and latest_step(train_dir) is not None
     if sharded_update:
         from atomo_tpu.mesh.update import (
@@ -2663,7 +2957,45 @@ def distributed_train_loop(
             )
         state = z_state
     else:
-        if want_resume and overlap == "delayed":
+        if want_resume and error_feedback:
+            # EF checkpoints hold TrainState + the per-chip residual:
+            # restore BOTH so the resumed trajectory is the
+            # uninterrupted one bit-for-bit (the delayed-carry resume
+            # discipline applied to the EF carry)
+            template = EfState(
+                train=jax.device_get(state),
+                residual=_zero_ef_residual_host(
+                    jax.device_get(state.params), mesh.shape["dp"]
+                ),
+            )
+            try:
+                restored = load_checkpoint(train_dir, template)
+                state = restored.train
+                ef_residual_host = restored.residual
+                start_step = int(state.step)
+                log_fn(f"Resumed from {train_dir} at step {start_step}")
+            except FileNotFoundError as exc:
+                log_fn(f"Resume requested but {exc}; starting fresh")
+            except (KeyError, ValueError) as exc:
+                # a residual-less (plain) checkpoint: restore the train
+                # state alone and re-zero the carry — the first resumed
+                # step then runs without its accumulated residual, an
+                # honest one-step divergence from the uninterrupted EF
+                # run, said out loud
+                import warnings
+
+                warnings.warn(
+                    "--error-feedback resume: checkpoint has no residual "
+                    f"carry ({exc}); restoring the train state only — "
+                    "the first resumed step starts from a zero residual"
+                )
+                state = load_checkpoint(train_dir, create_state(
+                    model, optimizer, jax.random.PRNGKey(seed),
+                    jnp.asarray(sample_images),
+                ))
+                start_step = int(state.step)
+                log_fn(f"Resumed from {train_dir} at step {start_step}")
+        elif want_resume and overlap == "delayed":
             # delayed checkpoints hold TrainState + the in-flight payload:
             # restore BOTH so the resumed trajectory is the uninterrupted
             # one bit-for-bit (the carry is what step start_step+1 consumes)
@@ -2734,6 +3066,14 @@ def distributed_train_loop(
                 start_step = int(state.step)
                 log_fn(f"Resumed from {train_dir} at step {start_step}")
         state = replicate_state(mesh, state)
+    if error_feedback:
+        if ef_residual_host is not None:
+            state = EfState(
+                train=state,
+                residual=_place_ef_residual(mesh, ef_residual_host),
+            )
+        else:
+            state = init_ef_state(mesh, state)
     if overlap == "delayed":
         if delayed_carry_host is not None:
             state = DelayedState(
@@ -2807,6 +3147,12 @@ def distributed_train_loop(
         # the doctor's rollback rebuilds — reads the CURRENT mode from
         # this cell so a later rollback cannot silently revert a re-tune
         agg_cell = {"mode": aggregate}
+        # the budget retuner may re-allocate per-leaf ranks mid-run (a
+        # new PerLeafCodec): every step (re)build reads the CURRENT
+        # codec from this cell — the agg_cell discipline applied to the
+        # codec knob, so a later retune rebuild cannot silently revert
+        # a re-allocation
+        codec_cell = {"codec": codec}
 
         def build_step(generation=0, remedy_cfg=None, densify=False):
             chaos_now = (
@@ -2816,7 +3162,7 @@ def distributed_train_loop(
             )
             return make_distributed_train_step(
                 model, optimizer, mesh,
-                None if densify else codec,
+                None if densify else codec_cell["codec"],
                 aggregate=agg_cell["mode"], augment=augment,
                 num_aggregate=num_aggregate, compute_dtype=compute_dtype,
                 zero1_specs=zero1_specs, sharded_update=su_specs,
@@ -2837,6 +3183,7 @@ def distributed_train_loop(
                 # the densify window's dense psum has no per-leaf payload
                 # path: the hybrid plan stands down with the codec
                 hybrid=None if densify else hybrid,
+                error_feedback=error_feedback,
             )
 
         step_fn = build_step()
@@ -2929,12 +3276,17 @@ def distributed_train_loop(
             lambda target: train_iter.restream(rng_snapshot, skip=target),
             build_step,
         )
+    if budget_tuner is not None:
+        budget_tuner.bind(
+            incidents=incidents, recorder=recorder, log_fn=log_fn
+        )
     retune = None
-    if tuner is not None:
+    if tuner is not None or budget_tuner is not None:
 
         def retune(step):
             """Checkpoint-boundary re-probe: returns a rebuilt step_fn
-            when the tuner switched the aggregation mode, else None. The
+            when the tuner switched the aggregation mode OR the budget
+            retuner re-allocated the per-leaf ranks, else None. The
             rebuild happens at the doctor's CURRENT chaos generation so a
             re-tune cannot re-arm faults a rollback disarmed. While a
             rollback remedy is still shaping the program (rewarm ramp
@@ -2944,14 +3296,27 @@ def distributed_train_loop(
             and densify-window step times are not the config's anyway."""
             if rig is not None and rig.remedy_active(step):
                 return None
-            new_mode = tuner.maybe_retune(step, agg_cell["mode"])
-            if new_mode is None:
+            rebuilt = False
+            if budget_tuner is not None:
+                new_codec = budget_tuner.maybe_realloc(step)
+                if new_codec is not None:
+                    # spectrum-drift re-allocation (budget.retune): the
+                    # incident + artifact epoch landed there; here the
+                    # program follows at the same boundary
+                    codec_cell["codec"] = new_codec
+                    rebuilt = True
+            if tuner is not None:
+                new_mode = tuner.maybe_retune(step, agg_cell["mode"])
+                if new_mode is not None:
+                    agg_cell["mode"] = new_mode
+                    if recorder is not None:
+                        # the aggregate-mode column must switch WITH the
+                        # program: the report's retunes_visible check
+                        # audits exactly this
+                        recorder.set_context(aggregate=new_mode)
+                    rebuilt = True
+            if not rebuilt:
                 return None
-            agg_cell["mode"] = new_mode
-            if recorder is not None:
-                # the aggregate-mode column must switch WITH the program:
-                # the report's retunes_visible check audits exactly this
-                recorder.set_context(aggregate=new_mode)
             return build_step(
                 rig.doctor.generation if rig is not None else 0
             )

@@ -108,7 +108,7 @@ def winner_knobs(row: dict) -> dict:
         k: row[k]
         for k in ("aggregate", "overlap", "superstep", "ring_bucket_size",
                   "plan", "stream_encode", "stream_bucket_bytes",
-                  "sparse_rows")
+                  "sparse_rows", "budget_alloc")
         if k in row
     }
 
@@ -181,6 +181,9 @@ def tune(
     stream_buckets: int = 0,
     allow_sparse: bool = False,
     hybrid=None,
+    allow_budget: bool = False,
+    budget_leaf_budgets=None,
+    budget_codec=None,
     superstep_options=(1, 8),
     bucket_options=(65536,),
     dcn_ways: int = 0,
@@ -218,6 +221,15 @@ def tune(
     bytes (``comm_model.leaf_budget_totals`` — the same sums the
     executed program reports) and probed through the SAME step builder
     with the plan attached.
+
+    ``allow_budget`` + ``budget_codec`` (a ``budget.PerLeafCodec`` built
+    from the run's solved allocation) + ``budget_leaf_budgets`` (its
+    per-leaf pairs, ``budget.allocation_leaf_budgets``) add a ``+ab``
+    variant of every plain blocking gather/ring candidate: priced from
+    the allocation's clamped per-leaf sums and probed through the SAME
+    step builder with the WRAPPED codec swapped in — the measured ladder
+    decides whether the adaptive split beats the uniform one on this
+    deployment, and the winner's ``budget_alloc`` knob records it.
 
     ``fabric_probe`` (the ``fabric_probe.json`` document) is required
     when ``fabric == "measured"``: the ONE parsers resolve the token
@@ -295,6 +307,8 @@ def tune(
         sparse_leaf_budgets=(
             hybrid.leaf_budgets() if hybrid is not None else None
         ),
+        allow_budget=bool(allow_budget and budget_codec is not None),
+        budget_leaf_budgets=budget_leaf_budgets,
         superstep_options=superstep_options,
         bucket_options=bucket_options,
         dcn_ways=int(dcn_ways) if two_tier else 0,
@@ -314,6 +328,9 @@ def tune(
         sparse_leaf_budgets=(
             hybrid.leaf_budgets() if hybrid is not None else None
         ),
+        # prices the +ab candidates from the allocation's per-leaf
+        # pairs — held once here, like the sparse budgets above
+        budget_leaf_budgets=budget_leaf_budgets,
     )
     from atomo_tpu.mesh import MeshSpec
 
@@ -380,14 +397,20 @@ def tune(
             if k in ("aggregate", "overlap", "superstep",
                      "ring_bucket_size", "plan", "name",
                      "stream_encode", "stream_bucket_bytes",
-                     "sparse_rows")
+                     "sparse_rows", "budget_alloc")
         }
         try:
             row = probe_candidate(
                 knobs,
                 model=model,
                 optimizer=optimizer,
-                codec=codec,
+                # +ab candidates probe the REAL program the run would
+                # dispatch: the per-leaf wrapped codec swaps in
+                codec=(
+                    budget_codec
+                    if knobs.get("budget_alloc") == "variance"
+                    else codec
+                ),
                 n_dev=n_dev,
                 sample_shape=sample_shape,
                 num_classes=num_classes,

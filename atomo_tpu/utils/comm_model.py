@@ -213,6 +213,37 @@ def leaf_budget_totals(leaf_budgets) -> tuple[float, float]:
     return d, p
 
 
+def codec_leaf_payload_bytes(codec, shape, dtype="float32") -> int:
+    """One leaf's wire bytes under ``codec`` — the CLAMPED actual.
+
+    The fixed-budget honesty rule: a layer whose full rank is below the
+    configured atom budget (``rank``, or ``rank + budget_slack`` for the
+    Bernoulli-budget sampler) pays only its clamped slot count, and a
+    layer the codec ships dense pays exactly its DensePayload — never
+    the nominal ``rank + slack`` slots. Codecs that publish their static
+    accounting (``SvdCodec.leaf_payload_bytes``) are priced analytically;
+    anything else falls back to ``jax.eval_shape`` over the real encode
+    (zero cost, nothing materializes). The two paths are pinned equal in
+    tests/test_comm_model.py, so every comm-model consumer — the byte
+    budgets, ``predict_step_s``, the adaptive budget allocator's
+    candidate pricing — and the executed program agree to the byte."""
+    fn = getattr(codec, "leaf_payload_bytes", None)
+    if fn is not None:
+        return int(fn(tuple(int(d) for d in shape)))
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs.base import payload_nbytes
+
+    shapes = jax.eval_shape(
+        lambda: codec.encode(
+            jax.random.PRNGKey(0),
+            jnp.zeros(tuple(int(d) for d in shape), dtype),
+        )
+    )
+    return int(payload_nbytes(shapes))
+
+
 def ring_allreduce_wire_bytes(dense_bytes: float, ways: int) -> float:
     """Per-chip one-direction wire traffic of a ring all-reduce."""
     return 2.0 * dense_bytes * (ways - 1) / ways
@@ -481,6 +512,8 @@ def candidate_name(cand: dict) -> str:
         bits.append("se")  # backward-interleaved layer-streamed encode
     if cand.get("sparse_rows") == "on":
         bits.append("sp")  # per-layer sparse-row hybrid exchange
+    if cand.get("budget_alloc") == "variance":
+        bits.append("ab")  # adaptive variance-budget per-layer ranks
     bits.append(f"k{cand.get('superstep', 1)}")
     if cand.get("aggregate") == "ring":
         bits.append(f"b{cand.get('ring_bucket_size', 65536)}")
@@ -499,6 +532,8 @@ def enumerate_candidates(
     stream_buckets: int = 0,
     allow_sparse: bool = False,
     sparse_leaf_budgets=None,
+    allow_budget: bool = False,
+    budget_leaf_budgets=None,
     superstep_options=(1, 8),
     bucket_options=(65536,),
     dcn_ways: int = 0,
@@ -535,7 +570,17 @@ def enumerate_candidates(
     the +se variants, sparse candidates change the trajectory only on
     lossy-codec tables (the row path is lossless), and compose with
     neither delayed overlap nor stream-encode (the in-run conflict
-    matrix), so only the plain blocking points gain variants."""
+    matrix), so only the plain blocking points gain variants.
+
+    ``allow_budget`` emits a ``--budget-alloc variance`` variant (suffix
+    ``+ab``) of every plain blocking gather/ring candidate, priced from
+    the adaptive allocation's per-leaf pairs
+    (``budget.allocation_leaf_budgets`` — the clamped-actual sums the
+    wrapped codec's executed program reports, the bench config 16
+    wire-match gate); the sparse-candidate restrictions apply for the
+    same reason until the delayed/streamed compositions are probed.
+    ``+sp`` and ``+ab`` do not cross (the hybrid planner prices the
+    dense sub-list at the base codec's budget)."""
     ks = sorted({max(int(k), 1) for k in superstep_options})
     out: list[dict] = []
     if ways <= 1:
@@ -598,6 +643,19 @@ def enumerate_candidates(
                                 # not duplicated into every candidate
                                 # row of the decision artifact
                                 out.append({**c, "sparse_rows": "on"})
+                            if (
+                                allow_budget
+                                and budget_leaf_budgets
+                                and agg in ("gather", "ring")
+                                and ov == "off"
+                                and sb is None
+                            ):
+                                # same discipline as +sp: the flag
+                                # alone; the allocation's per-leaf pairs
+                                # live once at the ranking call
+                                out.append(
+                                    {**c, "budget_alloc": "variance"}
+                                )
     if (
         has_codec
         and ways > 1
@@ -635,6 +693,7 @@ def predict_step_s(
     fabric2=None,
     leaf_budgets=None,
     sparse_leaf_budgets=None,
+    budget_leaf_budgets=None,
 ) -> float:
     """Model one candidate's synchronous step time (seconds).
 
@@ -673,6 +732,11 @@ def predict_step_s(
     lb = cand.get("leaf_budgets")
     if lb is None and cand.get("sparse_rows") == "on":
         lb = sparse_leaf_budgets
+    if lb is None and cand.get("budget_alloc") == "variance":
+        # the +ab candidates' wire: the adaptive allocation's clamped
+        # per-leaf pairs (budget.allocation_leaf_budgets) — the same
+        # sums the wrapped codec's executed program reports
+        lb = budget_leaf_budgets
     if lb is None:
         lb = leaf_budgets
     if lb is None:
@@ -754,13 +818,15 @@ def rank_candidates(
     dispatch_s: float = 0.0,
     fabric2=None,
     sparse_leaf_budgets=None,
+    budget_leaf_budgets=None,
 ) -> list[dict]:
     """Candidates + their predicted ms/step, best first (ties broken by
     name so the order — and therefore which candidates get probed — is
     deterministic for a given context). ``fabric2`` prices any
     hierarchical candidates per tier; ``sparse_leaf_budgets`` prices any
-    ``+sp`` candidates from the hybrid plan's per-leaf pairs (see
-    :func:`predict_step_s`)."""
+    ``+sp`` candidates from the hybrid plan's per-leaf pairs and
+    ``budget_leaf_budgets`` any ``+ab`` candidates from the adaptive
+    allocation's (see :func:`predict_step_s`)."""
     rows = []
     for c in cands:
         s = predict_step_s(
@@ -774,6 +840,7 @@ def rank_candidates(
             dispatch_s=dispatch_s,
             fabric2=fabric2,
             sparse_leaf_budgets=sparse_leaf_budgets,
+            budget_leaf_budgets=budget_leaf_budgets,
         )
         rows.append({**c, "predicted_ms_per_step": round(s * 1e3, 4)})
     rows.sort(key=lambda r: (r["predicted_ms_per_step"], r["name"]))

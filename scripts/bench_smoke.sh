@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Bench smoke (~8 min): prove the bench entrypoint still emits parseable
-# evidence without burning the full-ladder window. Thirteen checks:
+# evidence without burning the full-ladder window. Fourteen checks:
 #
 #   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
 #      the driver's last-line JSON contract, PLUS the partial-artifact
@@ -82,6 +82,17 @@
 #      bytes, and a recorded memory reduction — the PR-14 mesh
 #      subsystem's cross-replica sharded weight update (2004.13336).
 #
+#  14. the adaptive-budget contract (<60 s, forced 4-device CPU mesh):
+#      bench config 16 runs ATOMO's variance-minimizing byte allocation
+#      vs the uniform fixed-rank budget on the power-law embedding
+#      workload and must exit 0 with the exact wire-match gate TRUE
+#      (executed msg_bytes == the allocator's predicted per-leaf sum,
+#      variance wire <= uniform wire), the uniform degenerate identity
+#      (byte-identical HLO + bit-identical params vs the plain codec),
+#      a measured estimator-variance reduction, the seed-ensemble loss
+#      Pareto gate, and the bit-exact resume-from-allocation drill —
+#      the PR-15 adaptive variance-budget codecs.
+#
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
 cd "$(dirname "$0")/.." || exit 2
@@ -117,7 +128,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/13]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/14]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -146,7 +157,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/13]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/14]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -183,7 +194,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/13]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/14]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -214,7 +225,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/13]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/14]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -241,7 +252,7 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/13]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/14]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
 EOF
 [ $? -ne 0 ] && exit 1
@@ -274,7 +285,7 @@ for r in probed:
     assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
     assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
 assert doc.get("why"), doc
-print(f"bench_smoke OK[6/13]: --auto tune picked {win['name']} "
+print(f"bench_smoke OK[6/14]: --auto tune picked {win['name']} "
       f"({win.get('measured_ms_per_step')} ms/step measured, "
       f"{len(probed)}/{len(doc['rows'])} candidates probed); "
       "decision artifact parses")
@@ -318,7 +329,7 @@ for p in plans:
     assert isinstance(p.get("predicted_ms_per_step"), (int, float)), p
 td = row.get("tune_decision") or {}
 assert td.get("hierarchical_probed"), row
-print(f"bench_smoke OK[7/13]: two-tier plans "
+print(f"bench_smoke OK[7/14]: two-tier plans "
       f"{[p['plan'] for p in plans]} measured with per-tier "
       "predicted-vs-measured bytes matching, per-plan bit_parity=True; "
       f"mini-tune probed {td['hierarchical_probed']} "
@@ -366,7 +377,7 @@ sys.path.insert(0, ".")
 from atomo_tpu.training.checkpoint import latest_valid_step
 
 assert latest_valid_step(d) == 8, latest_valid_step(d)
-print("bench_smoke OK[8/13]: die@3:1 shrank 4 -> 3 at a checkpoint "
+print("bench_smoke OK[8/14]: die@3:1 shrank 4 -> 3 at a checkpoint "
       "boundary (planned reshape, restart budget untouched), finished at "
       f"step {latest_valid_step(d)} with membership epochs "
       f"{[w[0] for w in worlds]} recorded")
@@ -402,7 +413,7 @@ for k in ("compute_ms", "encode_monolithic_ms", "encode_streamed_ms",
           "encode_hidden_stream_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 assert int(ph.get("n_buckets", 0)) > 1, row
-print(f"bench_smoke OK[9/13]: stream {row['value']} vs off "
+print(f"bench_smoke OK[9/14]: stream {row['value']} vs off "
       f"{row['off_ms_per_step']} ms/step; exposed encode "
       f"{ph['encode_exposed_stream_ms']} (stream, {ph['n_buckets']} "
       f"buckets) vs {ph['encode_exposed_off_ms']} (off) ms; "
@@ -451,7 +462,7 @@ assert doc["consistent"] is True, doc["checks"]
 ran = [c["name"] for c in doc["checks"] if not c["skipped"]]
 segs = [e for e in doc["timeline"] if e["kind"] == "metrics"]
 assert segs and segs[0]["first_step"] == 1 and segs[-1]["last_step"] == 6
-print("bench_smoke OK[10/13]: recorder+quality run left "
+print("bench_smoke OK[10/14]: recorder+quality run left "
       f"{len(steps)} step records ({len(steps[0]['q_rel'])}-layer "
       "quality columns), report verb joined a consistent timeline "
       f"(checks ran: {ran})")
@@ -491,7 +502,7 @@ for l in layers:
     assert 0.0 <= l["density"] <= 1.0, l
     if l["assignment"] == "sparse":
         assert l["payload_bytes"] < l["dense_bytes"], l
-print(f"bench_smoke OK[11/13]: hybrid {row['hybrid_wire_bytes']} B vs "
+print(f"bench_smoke OK[11/14]: hybrid {row['hybrid_wire_bytes']} B vs "
       f"all-dense {row['alldense_wire_bytes']} B on the wire "
       f"({row['wire_reduction']}x reduction, "
       f"{len(plan['sparse_leaves'])}/{plan['n_leaves']} leaves sparse); "
@@ -535,7 +546,7 @@ assert set(ratios) == {"ici", "dcn"} and all(
 # even on a contended host
 assert row["fabric_parity"] is True, row
 assert row["run_artifact_complete"] is True, row
-print(f"bench_smoke OK[12/13]: probed ici {tiers['ici']['bandwidth_gbps']} "
+print(f"bench_smoke OK[12/14]: probed ici {tiers['ici']['bandwidth_gbps']} "
       f"/ dcn {tiers['dcn']['bandwidth_gbps']} GB/s/chip "
       f"({tiers['ici']['latency_us']} / {tiers['dcn']['latency_us']} "
       "us/hop); measured-vs-preset ratios recorded; measured-priced vs "
@@ -576,7 +587,7 @@ assert shd < z1 < rep, (rep, z1, shd)
 assert row["state_bytes_reduction"] > 1.5, row
 for part in ("replicated", "zero1", "sharded_update"):
     assert row[f"{part}_ms_per_step"] > 0, row
-print(f"bench_smoke OK[13/13]: per-chip state {rep} -> {z1} (zero1) -> "
+print(f"bench_smoke OK[13/14]: per-chip state {rep} -> {z1} (zero1) -> "
       f"{shd} B (sharded-update, {row['state_bytes_reduction']}x); "
       f"ms/step {row['replicated_ms_per_step']} / "
       f"{row['zero1_ms_per_step']} / {row['sharded_update_ms_per_step']}; "
@@ -584,4 +595,47 @@ print(f"bench_smoke OK[13/13]: per-chip state {rep} -> {z1} (zero1) -> "
 EOF13
 [ $? -ne 0 ] && exit 1
 
-echo "bench_smoke: all 13 checks passed"
+# --- 14: config 16, adaptive-budget Pareto + wire-match contract ---------
+out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=10 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+      ATOMO_BENCH_ARTIFACT="$art/c16.json" \
+      python bench.py --config 16 --no-baseline 2>/dev/null)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: config 16 exited rc=$rc (timeout or crash)"
+  exit 1
+fi
+printf '%s\n' "$out" > "$art/c16.out"
+python - "$art/c16.out" <<'EOF14'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+assert lines, "bench_smoke FAIL: config 16 emitted no JSON"
+row = json.loads(lines[-1])
+assert row["metric"] == "adaptive_budget_pareto", row
+assert row["measurement_valid"], row.get("invalid_reason")
+# gate 1: the exact wire match — allocator prediction == executed bytes
+assert row["wire_bytes_match"] is True, row
+alloc = row["allocation"]
+assert alloc["variance_payload_bytes"] <= alloc["uniform_payload_bytes"], alloc
+assert alloc["variance_ks"] != alloc["uniform_ks"], alloc
+# gate 2: the uniform degenerate identity (--budget-alloc uniform == today)
+assert row["uniform_hlo_identical"] is True, row
+assert row["uniform_bit_parity"] is True, row
+# gate 3: the Pareto — measured estimator variance AND ensemble loss
+assert row["measured_variance_reduction"] > 0, row
+assert row["pareto_loss_ok"] is True, row
+# gate 4: bit-exact resume from the recorded allocation artifact
+assert row["resume_bit_exact"] is True, row
+print(f"bench_smoke OK[14/14]: variance alloc {alloc['variance_ks']} vs "
+      f"uniform {alloc['uniform_ks']} at "
+      f"{row['variance_row']['wire_bytes']} <= "
+      f"{row['uniform_row']['wire_bytes']} B wire; measured q_err2 "
+      f"-{row['measured_variance_reduction']:.1%}, ensemble loss "
+      f"{row['variance_row']['mean_loss']:.4f} <= "
+      f"{row['uniform_row']['mean_loss']:.4f}; uniform HLO identical; "
+      "resume bit-exact")
+EOF14
+[ $? -ne 0 ] && exit 1
+
+echo "bench_smoke: all 14 checks passed"

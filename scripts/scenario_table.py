@@ -230,6 +230,82 @@ def sparse_recs(ways: int) -> dict:
     return {"embedding(zipf)": out}
 
 
+def adaptive_recs(ways: int) -> dict:
+    """``--adaptive``: the lenet scenario re-ranked with the adaptive
+    variance-budget candidate (``+ab``) in the space — the svd3 codec's
+    per-layer allocation solved from a PROBE gradient over a fixed
+    synthetic batch (deterministic: fixed keys, no data files), priced
+    from the allocation's clamped per-leaf pairs
+    (``budget.allocation_leaf_budgets`` — the same sums the wrapped
+    codec's executed program reports, bench config 16's wire-match
+    gate). Opt-in so the published historical table is stable; the +ab
+    wire at the default budget EQUALS the uniform wire (the solver
+    spends the same total), so the predicted ms/step ties the flat svd3
+    candidate and the column's value is the variance split it buys —
+    bench config 16 carries the measured Pareto evidence."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.budget import (
+        allocation_leaf_budgets,
+        measure_spectra,
+        solve_allocation,
+    )
+    from atomo_tpu.codecs import SvdCodec
+    from atomo_tpu.models import get_model
+    from atomo_tpu.sparse.hybrid import probe_gradient
+    from atomo_tpu.utils.comm_model import (
+        FABRICS,
+        enumerate_candidates,
+        estimate_codec_tax_s,
+        estimate_compute_s,
+        leaf_budget_totals,
+        rank_candidates,
+    )
+
+    model = get_model("lenet", 10)
+    codec = SvdCodec(rank=3)
+    images = jax.random.uniform(
+        jax.random.PRNGKey(0), (16, 28, 28, 1), jnp.float32
+    )
+    labels = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 10)
+    import numpy as np
+
+    spectra = measure_spectra(
+        codec, probe_gradient(model, np.asarray(images), np.asarray(labels))
+    )
+    alloc = solve_allocation(codec, spectra, mode="variance")
+    lb = allocation_leaf_budgets(codec, spectra, alloc.ks)
+    dense_b, payload_b = leaf_budget_totals(lb)
+    compute_ms = estimate_compute_s(dense_b) * 1e3
+    tax_ms = estimate_codec_tax_s(dense_b) * 1e3
+    out = {}
+    for label, bw in sorted(FABRICS.items()):
+        ab = [
+            c for c in enumerate_candidates(
+                has_codec=True, ways=ways, allow_overlap=False,
+                allow_budget=True, budget_leaf_budgets=lb,
+            )
+            if c.get("budget_alloc") == "variance"
+        ]
+        ranked = [
+            {
+                "code": "svd3+ab",
+                "candidate": c["name"],
+                "predicted_ms_per_step": c["predicted_ms_per_step"],
+                "measured_1chip_ms": None,
+                "codec_tax_ms": round(tax_ms, 3),
+            }
+            for c in rank_candidates(
+                ab, dense_bytes=dense_b, payload_bytes=payload_b,
+                ways=ways, fabric_bw=bw, compute_s=compute_ms / 1e3,
+                tax_s=tax_ms / 1e3, budget_leaf_budgets=lb,
+            )
+        ]
+        out[label] = {"winner": ranked[0], "ranked": ranked}
+    return {"lenet (adaptive budget)": out}
+
+
 def render(recs: dict, ways: int, source: str) -> str:
     lines = [
         f"| scenario | fabric | recommended config | predicted ms/step "
@@ -276,6 +352,14 @@ def main() -> int:
                          "default so the published table's historical "
                          "candidate space is stable; bench config 12 "
                          "carries the measured streamed-encode evidence")
+    ap.add_argument("--adaptive", action="store_true", default=False,
+                    help="add the lenet scenario re-ranked with the "
+                         "adaptive variance-budget (+ab) candidates, "
+                         "priced from a real allocation's clamped "
+                         "per-leaf wire bytes. Off by default so the "
+                         "published table's historical rows are stable; "
+                         "bench config 16 carries the measured Pareto "
+                         "evidence")
     ap.add_argument("--sparse", action="store_true", default=False,
                     help="add the embedding x zipf scenario with the "
                          "per-layer hybrid sparse-row (+sp) candidate, "
@@ -321,6 +405,8 @@ def main() -> int:
                            fabric_probe=fabric_probe)
     if args.sparse:
         recs.update(sparse_recs(args.ways))
+    if args.adaptive:
+        recs.update(adaptive_recs(args.ways))
     source = (
         f"measured fabric, {args.from_probe} (compute/tax anchors stay "
         "the stated model-only estimates)"

@@ -77,6 +77,33 @@ def test_lint_covers_mesh_subsystem_by_construction(tmp_path):
     ]
 
 
+def test_lint_covers_budget_subsystem_by_construction(tmp_path):
+    """The mesh/obs precedent applied to the NEW budget/ subsystem: the
+    walk covers it with no allowlist to forget — a json.dump smuggled
+    into atomo_tpu/budget/ is flagged, and the real package (which
+    writes budget_alloc.json through write_json_atomic) is clean."""
+    mod = _load_checker()
+    pkg = tmp_path / "atomo_tpu" / "budget"
+    pkg.mkdir(parents=True)
+    bad = pkg / "rogue.py"
+    bad.write_text(
+        "import json\n"
+        "def w(train_dir, obj):\n"
+        "    with open(train_dir + '/budget_alloc.json', 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+    )
+    out = mod.scan_file(
+        str(bad), os.path.join("atomo_tpu", "budget", "rogue.py")
+    )
+    assert len(out) == 1 and "write_json_atomic" in out[0]
+    real = os.path.join(_REPO, "atomo_tpu", "budget")
+    assert os.path.isdir(real)
+    assert not [
+        v for v in mod.collect_violations(_REPO)
+        if "atomo_tpu/budget" in v
+    ]
+
+
 def test_lint_catches_a_script_train_dir_dump(tmp_path):
     mod = _load_checker()
     bad = tmp_path / "scripts" / "rogue.py"

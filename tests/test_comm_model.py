@@ -160,3 +160,123 @@ def test_overlap_report_models_both_modes():
         ring_stream_wire_bytes(P, D, 8) / 1e6, 3
     )
     json.dumps(rep, allow_nan=False)
+
+
+def test_codec_leaf_payload_bytes_prices_clamped_actual():
+    """The fixed-budget honesty regression (ISSUE-15 satellite): analytic
+    per-leaf pricing must equal jax.eval_shape over the REAL encode for
+    every sampler/algorithm/wire-dtype — including the layers whose full
+    rank CLAMPS the configured budget (r_full < rank, and r_full <
+    rank + budget_slack for the Bernoulli-budget sampler) and the
+    dense-fallback layers. A nominal rank+slack slot count would
+    overprice exactly those layers."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import SvdCodec, payload_nbytes
+    from atomo_tpu.utils.comm_model import codec_leaf_payload_bytes
+
+    # shapes chosen to hit every branch: tiny (dense fallback), small
+    # (clamped full rank below rank+slack), mid (gram), large
+    # (randomized sketch + probe atoms)
+    shapes = [(10,), (4, 3), (50,), (5, 5, 10, 20), (320, 50), (800, 500)]
+    codecs = [
+        SvdCodec(rank=3),
+        SvdCodec(rank=3, algorithm="exact"),
+        SvdCodec(rank=3, algorithm="randomized"),
+        SvdCodec(rank=3, sample="bernoulli_budget", budget_slack=4),
+        SvdCodec(rank=3, sample="bernoulli"),
+        SvdCodec(rank=3, sample="topk"),
+        SvdCodec(rank=3, wire_dtype="bfloat16"),
+        SvdCodec(rank=12, sample="bernoulli_budget", budget_slack=6),
+    ]
+    for codec in codecs:
+        for shape in shapes:
+            analytic = codec_leaf_payload_bytes(codec, shape)
+            ev = payload_nbytes(jax.eval_shape(
+                lambda c=codec, s=shape: c.encode(
+                    jax.random.PRNGKey(0), jnp.zeros(s, jnp.float32)
+                )
+            ))
+            assert analytic == ev, (codec.sample, codec.algorithm,
+                                    codec.wire_dtype, shape, analytic, ev)
+    # the clamp is REAL for the bernoulli budget on a small matrix:
+    # (50,) resizes to (8, 7) — full rank 7, far below 12 + 6 = 18
+    # nominal slots. Under the near-square matricization a payload
+    # clamped to full rank always REACHES the dense fallback
+    # (r_full*(m+n+1) >= m*n whenever min(m,n) <= r_full), so the
+    # clamped actual IS the exact 200-byte DensePayload — a nominal
+    # 18-slot pricing would charge ~6x that
+    bb = SvdCodec(rank=12, sample="bernoulli_budget", budget_slack=6)
+    m, n, k_nom = 8, 7, 12 + 6
+    nominal = (m * k_nom + k_nom * n) * 4 + k_nom * 4
+    actual = codec_leaf_payload_bytes(bb, (50,))
+    assert actual == 50 * 4  # the dense fallback: the clamped actual
+    assert actual < nominal
+    # eval_shape fallback path for codecs without analytic pricing
+    from atomo_tpu.codecs import QsgdCodec
+
+    q = QsgdCodec(bits=4, bucket_size=128)
+    ev = payload_nbytes(jax.eval_shape(
+        lambda: q.encode(
+            jax.random.PRNGKey(0), jnp.zeros((320, 50), jnp.float32)
+        )
+    ))
+    assert codec_leaf_payload_bytes(q, (320, 50)) == ev
+
+
+def test_budget_candidates_emitted_and_priced():
+    """The +ab candidate family: emitted only for plain blocking
+    gather/ring points, named with the ab suffix, priced from the
+    allocation's per-leaf pairs through the one honest accounting
+    function."""
+    from atomo_tpu.utils.comm_model import (
+        enumerate_candidates,
+        leaf_budget_totals,
+        predict_step_s,
+        rank_candidates,
+    )
+
+    lb = [(1000.0, 100.0), (2000.0, 150.0)]
+    cands = enumerate_candidates(
+        has_codec=True, ways=4, allow_budget=True,
+        budget_leaf_budgets=lb, allow_stream=True,
+    )
+    ab = [c for c in cands if c.get("budget_alloc") == "variance"]
+    assert ab and all("+ab" in c["name"] for c in ab)
+    # only plain blocking gather/ring variants gain +ab
+    for c in ab:
+        assert c["aggregate"] in ("gather", "ring")
+        assert c.get("overlap", "off") == "off"
+        assert c.get("stream_encode") != "on"
+    # pricing: the +ab candidate's wire comes from the allocation pairs
+    d, p = leaf_budget_totals(lb)
+    plain = dict(ab[0])
+    plain.pop("budget_alloc")
+    t_ab = predict_step_s(
+        ab[0], dense_bytes=d, payload_bytes=9e9, ways=4, fabric_bw=1e9,
+        compute_s=1e-3, tax_s=0.0, budget_leaf_budgets=lb,
+    )
+    t_plain = predict_step_s(
+        plain, dense_bytes=d, payload_bytes=p, ways=4, fabric_bw=1e9,
+        compute_s=1e-3, tax_s=0.0,
+    )
+    assert t_ab == t_plain  # same bytes -> same prediction; the bogus
+    # whole-tree payload_bytes=9e9 was ignored for the +ab candidate
+    rows = rank_candidates(
+        cands, dense_bytes=d, payload_bytes=p, ways=4, fabric_bw=1e9,
+        compute_s=1e-3, tax_s=0.0, budget_leaf_budgets=lb,
+    )
+    assert all("predicted_ms_per_step" in r for r in rows)
+    # no budgets supplied -> no +ab variants (the flag alone is not
+    # enough, the sparse precedent)
+    none = enumerate_candidates(has_codec=True, ways=4, allow_budget=True)
+    assert not [c for c in none if c.get("budget_alloc") == "variance"]
+
+
+def test_winner_knobs_carries_budget_alloc():
+    from atomo_tpu.tuning.autopilot import winner_knobs
+
+    row = {"aggregate": "gather", "overlap": "off", "superstep": 1,
+           "budget_alloc": "variance", "name": "gather+off+ab+k1"}
+    assert winner_knobs(row)["budget_alloc"] == "variance"
