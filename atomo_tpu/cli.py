@@ -335,11 +335,54 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
     t.add_argument("--chaos", type=str, default="", metavar="SPEC",
                    help="fault-injection spec for drills, e.g. "
                         "'nan@3,kill@6,truncate@4,spike@5:3,crashloop@2,"
-                        "die@5:1' (die@S:R = replica R stops contributing "
-                        "from step S onward — the elastic membership "
-                        "drill; needs --grad-guard and a multi-device "
-                        "mesh; see utils/chaos.py); defaults to the "
-                        "ATOMO_CHAOS env var")
+                        "die@5:1,slow@4:2:0.3' (die@S:R = replica R stops "
+                        "contributing from step S onward — the elastic "
+                        "membership drill; needs --grad-guard and a "
+                        "multi-device mesh; slow@S:R:SEC = replica R "
+                        "delivers every payload SEC seconds late from "
+                        "step S onward — the persistent-straggler drill "
+                        "--quorum absorbs; see utils/chaos.py); defaults "
+                        "to the ATOMO_CHAOS env var")
+    t.add_argument("--quorum", type=str, default="off", metavar="Q",
+                   help="bounded-staleness quorum aggregation: each step "
+                        "consumes whatever payloads have ARRIVED (a "
+                        "straggler's payload rides a staleness ring, "
+                        "bounded at --staleness steps stale, then dropped "
+                        "+ counted) and waits only until Q of the N "
+                        "replicas are present — the surviving mean is "
+                        "rescaled by the exact unbiased n/kept argument "
+                        "the guard uses. The per-step arrival schedule "
+                        "is recorded to train-dir/arrival_schedule.jsonl "
+                        "so --replay-arrivals replays the trajectory "
+                        "bit-exact. Needs a compressing --code, "
+                        "--aggregate gather|ring and a multi-device "
+                        "mesh; conflicts with --overlap delayed, "
+                        "hierarchical plans, --sparse-rows, "
+                        "--stream-encode, --error-feedback, --elastic, "
+                        "--zero1/--partition sharded-update, "
+                        "--num-aggregate, --superstep > 1, "
+                        "--obs-quality. off (default) = blocking "
+                        "aggregation, byte-identical HLO to a build "
+                        "without the flag")
+    t.add_argument("--staleness", type=int, default=1, metavar="K",
+                   help="with --quorum: the staleness bound — a payload "
+                        "may be consumed at most K steps late; one that "
+                        "would exceed K is DROPPED (one "
+                        "staleness_exceeded incident each, never a "
+                        "silent stale apply)")
+    t.add_argument("--quorum-period-ms", type=float, default=100.0,
+                   metavar="MS",
+                   help="with --quorum: the modelled step period used to "
+                        "convert a chaos slow@S:R:SEC straggler's lag "
+                        "into whole steps (lag = ceil(SEC/period))")
+    t.add_argument("--replay-arrivals", type=str, default="",
+                   metavar="PATH",
+                   help="with --quorum: replay a recorded "
+                        "arrival_schedule.jsonl instead of deriving (and "
+                        "waiting out) a live schedule — the trajectory "
+                        "is bit-identical to the recorded run's; refuses "
+                        "a schedule recorded under different "
+                        "Q/K/N/period knobs")
     t.add_argument("--elastic", action="store_true", default=False,
                    help="elastic world size: track membership epochs in "
                         "train-dir/membership.json, carry a persistently "
@@ -790,6 +833,29 @@ def _partition(args: argparse.Namespace) -> str:
     return p
 
 
+def _quorum_q(args: argparse.Namespace):
+    """Parse ``--quorum``: None for 'off', else the validated Q floor.
+    One grammar for preflight and the run (a typo'd value must fail
+    before the supervisor re-exec, like every other argv-knowable
+    reject)."""
+    q = getattr(args, "quorum", "off")
+    if q in ("off", "", None):
+        return None
+    try:
+        v = int(q)
+    except (TypeError, ValueError):
+        raise SystemExit(
+            f"--quorum {q!r}: expected 'off' or a positive integer "
+            "(the number of replicas a step waits for)"
+        )
+    if v < 1:
+        raise SystemExit(
+            f"--quorum {v}: must be >= 1 (a step has to consume at "
+            "least one arrival)"
+        )
+    return v
+
+
 def _argv_preflight(args: argparse.Namespace) -> None:
     """Deterministic config conflicts knowable from argv alone, checked
     BEFORE the supervisor re-exec (and before the jax backend initializes
@@ -852,6 +918,10 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             pinned.append(f"--superstep {args.superstep}")
         if getattr(args, "plan", "auto") != "auto":
             pinned.append(f"--plan {args.plan}")
+        if getattr(args, "quorum", "off") != "off":
+            # quorum is a pinned knob like --overlap: the autopilot's
+            # +qK candidates explore it only when it is NOT pinned
+            pinned.append(f"--quorum {args.quorum}")
         if pinned:
             raise SystemExit(
                 "--auto tune picks the performance knobs itself and "
@@ -1240,6 +1310,131 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             )
     import os
 
+    q_val = _quorum_q(args)  # raises on a malformed --quorum value
+    if q_val is None:
+        if getattr(args, "replay_arrivals", ""):
+            raise SystemExit(
+                "--replay-arrivals replays a recorded quorum arrival "
+                "schedule and needs --quorum"
+            )
+    else:
+        # the quorum compatibility matrix, argv-knowable half (the loop
+        # and the step builder re-check with the resolved mesh/codec):
+        # quorum rides the payload gather/ring exchange and feeds a
+        # fresh host-derived arrival vector every step, so everything
+        # that re-shapes the exchange, carries cross-step payload state,
+        # or fuses steps is rejected with its reason
+        if args.staleness < 1:
+            raise SystemExit(
+                f"--staleness {args.staleness}: must be >= 1 (0 would "
+                "mean blocking aggregation — drop --quorum instead)"
+            )
+        if getattr(args, "quorum_period_ms", 100.0) <= 0:
+            raise SystemExit(
+                f"--quorum-period-ms {args.quorum_period_ms}: must be "
+                "> 0 (it converts a straggler's seconds of lag into "
+                "whole steps)"
+            )
+        if args.code.lower() in DENSE_CODES:
+            raise SystemExit(
+                "--quorum rides the encoded payload exchange (the "
+                "staleness ring carries payloads, not dense gradients); "
+                "pick a compressing --code"
+            )
+        if args.n_devices == 1:
+            raise SystemExit(
+                "--quorum needs a multi-device mesh: a single device "
+                "has no stragglers to absorb"
+            )
+        if args.aggregate in ("psum", "hierarchical"):
+            raise SystemExit(
+                f"--quorum does not compose with --aggregate "
+                f"{args.aggregate}: only the flat payload gather/ring "
+                "exchanges carry the staleness ring; psum ships dense "
+                "gradients and the hierarchical boundary re-encode is "
+                "not arrival-aware"
+            )
+        if getattr(args, "plan", "auto") != "auto":
+            raise SystemExit(
+                "--quorum does not compose with --plan: the two-level "
+                "topology schedules are not arrival-aware; drop one"
+            )
+        if args.overlap == "delayed":
+            raise SystemExit(
+                "--quorum does not compose with --overlap delayed: "
+                "both modes carry cross-step payload state, and "
+                "composing the delayed carry with the staleness ring "
+                "would double-count a step of lag — the quorum carry "
+                "IS the bounded generalization of the delayed one"
+            )
+        if getattr(args, "stream_encode", "off") == "on":
+            raise SystemExit(
+                "--quorum does not compose with --stream-encode: the "
+                "bucket-streamed encode is not staleness-ring-aware yet"
+            )
+        if getattr(args, "sparse_rows", "off") != "off":
+            raise SystemExit(
+                "--quorum does not compose with --sparse-rows: the "
+                "row payloads' shapes are assignment-specific and the "
+                "staleness ring is not row-aware yet"
+            )
+        if getattr(args, "error_feedback", False):
+            raise SystemExit(
+                "--quorum does not compose with --error-feedback: a "
+                "dropped stale payload's residual would be "
+                "mis-attributed — rejected honestly"
+            )
+        if getattr(args, "elastic", False):
+            raise SystemExit(
+                "--quorum does not compose with --elastic: membership "
+                "tracks replicas that LEFT, the staleness ring carries "
+                "replicas that are LATE — one absorption mechanism at "
+                "a time"
+            )
+        if _partition(args) != "replicated":
+            raise SystemExit(
+                "--quorum does not compose with --zero1 / --partition "
+                "sharded-update yet: the staleness-ring carry is "
+                "untested against the sharded state templates"
+            )
+        if args.num_aggregate is not None:
+            raise SystemExit(
+                "--quorum does not compose with --num-aggregate: the "
+                "arrival schedule already decides which replicas "
+                "contribute each step"
+            )
+        if args.superstep > 1:
+            raise SystemExit(
+                f"--superstep {args.superstep} does not compose with "
+                "--quorum: the host feeds a fresh arrival vector every "
+                "step, which a fused K-step scan cannot consume"
+            )
+        if args.phase_metrics:
+            raise SystemExit(
+                "--quorum needs the fused step (the staleness ring "
+                "rides its carry); --phase-metrics has no fused step"
+                + _TIMELINE_HINT
+            )
+        if getattr(args, "obs_quality", False):
+            raise SystemExit(
+                "--quorum does not compose with --obs-quality: a stale "
+                "payload's per-layer error column would describe an "
+                "earlier step's gradient — rejected honestly rather "
+                "than silently mis-attributed"
+            )
+        if args.on_diverge != "off":
+            raise SystemExit(
+                "--quorum does not compose with --on-diverge: the "
+                "rollback reload does not rebuild the staleness-ring "
+                "template yet"
+            )
+        if getattr(args, "replay_arrivals", "") and not os.path.exists(
+            args.replay_arrivals
+        ):
+            raise SystemExit(
+                f"--replay-arrivals {args.replay_arrivals!r}: no such "
+                "file"
+            )
     chaos_specs = [args.chaos] if args.chaos else []
     if not args.chaos and os.environ.get("ATOMO_CHAOS"):
         # the flagless path: supervised children inherit the env, so a
@@ -1294,6 +1489,29 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                         f"outside the {args.n_devices}-device mesh "
                         "(replicas are 0-based); the fault would never "
                         "fire and the drill would prove nothing"
+                    )
+        if _chaos_cfg.slow_replica_faults and _epoch0:
+            # slow@'s die@-style preflight: a typo'd replica index would
+            # silently straggle NOTHING and the drill would "pass"
+            # having proven nothing — argv-knowable for an explicit mesh
+            if args.n_devices == 1:
+                raise SystemExit(
+                    "chaos slow@S:R:SEC delays one replica of a "
+                    "multi-device mesh; single-device training has no "
+                    "exchange for a straggler to hold up"
+                )
+            if args.n_devices >= 2:
+                bad = [
+                    r for _, r, _ in _chaos_cfg.slow_replica_faults
+                    if r >= args.n_devices
+                ]
+                if bad:
+                    raise SystemExit(
+                        f"chaos slow@S:R:SEC targets replica(s) "
+                        f"{sorted(bad)} outside the "
+                        f"{args.n_devices}-device mesh (replicas are "
+                        "0-based); the fault would never fire and the "
+                        "drill would prove nothing"
                     )
     if getattr(args, "readmit_at", 0) and not getattr(args, "elastic", False):
         raise SystemExit(
@@ -1516,6 +1734,28 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             flush=True,
         )
         dcn_ways = 0
+    # the +qK quorum variants: explored only when a chaos slow@ fault
+    # actually straggles a replica of this mesh — priced by expected
+    # exposed wait from the fault's per-replica delays (the probe
+    # harness is straggler-free, so +qK is never probed; see tune())
+    slow_faults = ()
+    if args.chaos:
+        from atomo_tpu.utils.chaos import ChaosConfig
+
+        slow_faults = ChaosConfig.from_spec(args.chaos).slow_replica_faults
+    allow_quorum = bool(slow_faults) and codec is not None and n_dev > 1
+    quorum_q = 0
+    quorum_delays = None
+    if allow_quorum:
+        per_rep = [0.0] * n_dev
+        for _, r, sec in slow_faults:
+            if r < n_dev:
+                per_rep[r] = max(per_rep[r], float(sec))
+        quorum_delays = per_rep
+        slowed = len({r for _, r, _ in slow_faults if r < n_dev})
+        # quorum = everyone who is NOT persistently slowed (floor 1):
+        # the Q that absorbs exactly the injected stragglers
+        quorum_q = max(1, n_dev - slowed)
     doc = None
     if args.resume:
         # a resumed run (including a supervised restart's appended
@@ -1542,6 +1782,9 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         reusable, why = decision_reusable(
             prior, n_dev=n_dev,
             mesh_axes=MeshSpec.from_world(n_dev, dcn_ways).shape_dict(),
+            # the chaos-derived Q this run would explore (staleness=None:
+            # K was the recorded ladder's pick, any value is consistent)
+            quorum=quorum_q if allow_quorum else None,
         )
         if reusable:
             doc = prior
@@ -1610,6 +1853,10 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
                 budget_ctx["leaf_budgets"] if budget_ctx else None
             ),
             budget_codec=budget_ctx["codec"] if budget_ctx else None,
+            # the +qK bounded-staleness variants (priced, never probed)
+            allow_quorum=allow_quorum,
+            quorum_q=quorum_q,
+            quorum_delays=quorum_delays,
             stream_bucket_bytes=_stream_bucket_bytes(args),
             stream_buckets=_real_stream_buckets(
                 _init_params, _stream_bucket_bytes(args)
@@ -1678,6 +1925,11 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
     args._tuned_sparse = knobs.get("sparse_rows", "off")
     # a +ab winner pins the adaptive allocation on; cmd_train applies it
     args._tuned_budget = knobs.get("budget_alloc", "off")
+    if knobs.get("quorum"):
+        # a +qK winner arms the quorum exactly like an explicit flag;
+        # cmd_train builds the QuorumConfig from args after this returns
+        args.quorum = str(int(knobs["quorum"]))
+        args.staleness = int(knobs.get("staleness", 1))
     superstep = max(int(knobs.get("superstep", 1)), 1)
     print(f"--auto tune -> {win.get('name')} ({doc.get('why')})", flush=True)
 
@@ -1971,6 +2223,35 @@ def cmd_train(args: argparse.Namespace) -> int:
                 f"run resolved to a {n_dev}-device mesh (replicas are "
                 "0-based); the fault would never fire"
             )
+    if (
+        chaos is not None and chaos.config.slow_replica_faults
+        and not chaos.membership_epoch
+    ):
+        # the argv-ambiguous half of the slow@ preflight range check
+        # (--n-devices 0 = all visible needs the resolved count)
+        bad = [
+            r for _, r, _ in chaos.config.slow_replica_faults if r >= n_dev
+        ]
+        if bad or n_dev <= 1:
+            raise SystemExit(
+                f"chaos slow@S:R:SEC targets replica(s) "
+                f"{sorted(r for _, r, _ in chaos.config.slow_replica_faults)} "
+                f"but this run resolved to a {n_dev}-device mesh (replicas "
+                "are 0-based); the fault would never fire"
+            )
+    if _quorum_q(args) is not None:
+        # the argv-ambiguous half of the quorum preflight mesh checks
+        if n_dev <= 1:
+            raise SystemExit(
+                "--quorum waits for Q of N replica payloads: this run "
+                "resolved to 1 device, so there is no exchange to quorum on"
+            )
+        if _quorum_q(args) > n_dev:
+            raise SystemExit(
+                f"--quorum {_quorum_q(args)} exceeds the resolved "
+                f"{n_dev}-replica mesh: a quorum larger than the world "
+                "can never be met"
+            )
     if args.fabric == "measured":
         # the startup fabric probe (obs.fabric): measure per-tier
         # bandwidth/latency on the real mesh BEFORE anything prices a
@@ -2252,6 +2533,27 @@ def cmd_train(args: argparse.Namespace) -> int:
             patience=args.elastic_patience,
             readmit_at=args.readmit_at,
         )
+    quorum_cfg = None
+    if _quorum_q(args) is not None:
+        # built AFTER the autopilot block so a tuned +qK winner's knobs
+        # (applied onto args) arm the quorum exactly like an explicit flag
+        from atomo_tpu.quorum import QuorumConfig
+
+        if superstep > 1:
+            # argv superstep>1 was rejected by _argv_preflight; this is
+            # the backend default (8 on tpu) resolving over an armed
+            # quorum — arrivals change per step, so steps cannot fuse
+            print(
+                "Quorum: per-step arrival consumption cannot run under a "
+                "fused superstep scan; forcing --superstep 1",
+                flush=True,
+            )
+            superstep = 1
+        quorum_cfg = QuorumConfig(
+            _quorum_q(args),
+            staleness=args.staleness,
+            period_s=args.quorum_period_ms / 1e3,
+        )
     recorder = None
     if args.obs_record:
         from atomo_tpu.obs.recorder import (
@@ -2531,6 +2833,8 @@ def cmd_train(args: argparse.Namespace) -> int:
                 hybrid=hybrid_plan,
                 error_feedback=args.error_feedback,
                 budget_tuner=budget_tuner,
+                quorum=quorum_cfg,
+                quorum_replay=args.replay_arrivals or None,
             )
         except DivergenceError as exc:
             return _diverged_exit(exc)
@@ -3260,8 +3564,11 @@ def main(argv=None) -> int:
     from atomo_tpu.compat import enable_compile_cache
 
     # opt-in (ATOMO_COMPILE_CACHE=dir): ladder re-runs and elastic
-    # restarts skip recompiling identical XLA programs; no-op otherwise
-    enable_compile_cache()
+    # restarts skip recompiling identical XLA programs; no-op otherwise.
+    # Logged to stderr so verbs with a machine-readable stdout (report
+    # --json consumers, shell pipelines) stay clean — same contract as
+    # bench.py.
+    enable_compile_cache(log_fn=lambda m: print(m, file=sys.stderr, flush=True))
     argv = list(sys.argv[1:] if argv is None else argv)
     known = {"train", "evaluate", "tune", "lm", "report", "-h", "--help"}
     if argv and argv[0] not in known:

@@ -42,6 +42,9 @@ def install() -> None:
     jax.shard_map = shard_map
 
 
+_CACHE_ENABLED = None
+
+
 def enable_compile_cache(log_fn=print):
     """Opt-in persistent XLA compilation cache (``ATOMO_COMPILE_CACHE=dir``).
 
@@ -65,6 +68,12 @@ def enable_compile_cache(log_fn=print):
     path = os.environ.get("ATOMO_COMPILE_CACHE")
     if not path:
         return None
+    # Idempotent per process: in-process callers (cli.main under tests, the
+    # tuner's ladder) would otherwise stack one atexit report per call.
+    global _CACHE_ENABLED
+    if _CACHE_ENABLED == path:
+        return path
+    _CACHE_ENABLED = path
     os.makedirs(path, exist_ok=True)
 
     def _entries() -> int:

@@ -70,6 +70,8 @@ _SCALAR_KEYS = (
     "grad_norm",
     "ok_bits",
     "ef_res_norm",
+    "quorum_kept",
+    "stale_dropped",
 )
 # per-layer vector columns (the --obs-quality probes): recorded as lists
 _VECTOR_KEYS = ("q_err2", "q_rel")

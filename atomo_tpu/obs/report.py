@@ -37,6 +37,13 @@ artifacts audit each other instead of being trusted independently:
     metrics.jsonl (the ``budget_alloc_epochN`` meta lines and the
     per-step ``budget_epoch`` column) match the recorded allocation
     epochs in ``budget_alloc.json``, byte for byte and span for span.
+  * ``quorum_schedule_consistent`` — the recorded
+    ``arrival_schedule.jsonl`` agrees with what the run actually did:
+    each step's ``quorum_kept`` column matches the schedule's kept
+    count, no recorded staleness exceeds the K bound the meta header
+    pins, and every DROPPED entry has its matching
+    ``staleness_exceeded`` incident (a drop without an incident is a
+    silent stale apply — the thing the staleness contract forbids).
 
 A check whose source artifact is absent is SKIPPED (reported, not
 failed): a run without elastic has no membership to agree with.
@@ -489,6 +496,81 @@ def _check_budget_alloc(steps: list[dict], metas: list[dict],
     )
 
 
+def _check_quorum_schedule(steps: list[dict], incidents,
+                           sched_meta, sched_arrivals) -> dict:
+    """``quorum_schedule_consistent`` — arrival_schedule.jsonl must agree
+    with the run it anchors: per-step ``quorum_kept`` columns match the
+    schedule's kept counts, no recorded staleness exceeds the meta
+    header's K bound, and the schedule's total drop count equals the
+    number of ``staleness_exceeded`` incidents (every drop announced,
+    never a silent stale apply). Skipped when no schedule was recorded
+    (non-quorum runs)."""
+    name = "quorum_schedule_consistent"
+    if sched_meta is None and not sched_arrivals:
+        return _check(
+            name, True, "no arrival schedule recorded", skipped=True
+        )
+    bad = []
+    if sched_meta is None:
+        bad.append(
+            "arrival_schedule.jsonl has arrival records but no "
+            "quorum_config meta header — the knobs the vectors were "
+            "derived under are gone"
+        )
+    k_bound = int(sched_meta.get("staleness", 0)) if sched_meta else None
+    recs = [r for r in steps if r.get("quorum_kept") is not None]
+    for r in recs:
+        s = int(r["step"])
+        sched = sched_arrivals.get(s)
+        if sched is None:
+            bad.append(
+                f"step {s} records quorum_kept="
+                f"{int(r['quorum_kept'])} but the schedule has no "
+                "arrival record for it"
+            )
+            continue
+        if int(r["quorum_kept"]) != int(sched.get("kept", -1)):
+            bad.append(
+                f"step {s}: metrics say {int(r['quorum_kept'])} kept, "
+                f"schedule says {sched.get('kept')} — the recorded "
+                "trajectory and its replay anchor disagree"
+            )
+    if k_bound is not None:
+        over = [
+            (s, max(int(x) for x in rec.get("staleness", [0])))
+            for s, rec in sorted(sched_arrivals.items())
+            if any(int(x) > k_bound for x in rec.get("staleness", []))
+        ]
+        if over:
+            bad.append(
+                f"step {over[0][0]} records staleness {over[0][1]} past "
+                f"the K={k_bound} bound (+{len(over) - 1} more) — a "
+                "stale payload survived where it should have dropped"
+            )
+    total_drops = sum(
+        int(rec.get("dropped", 0)) for rec in sched_arrivals.values()
+    )
+    n_incidents = sum(
+        1 for r in incidents if r.get("cause") == "staleness_exceeded"
+    )
+    if total_drops != n_incidents:
+        bad.append(
+            f"schedule records {total_drops} drop(s) but incidents.jsonl "
+            f"holds {n_incidents} staleness_exceeded incident(s) — "
+            "every drop must be announced exactly once"
+        )
+    return _check(
+        name,
+        not bad,
+        "; ".join(bad[:5])
+        or (
+            f"{len(sched_arrivals)} arrival record(s), {len(recs)} "
+            f"quorum step record(s) and {n_incidents} drop incident(s) "
+            "agree"
+        ),
+    )
+
+
 def _check_drift_blame(incidents) -> dict:
     """``drift_blame_present`` — every ``perf_drift`` RETUNE incident
     (action ``retune->X`` / ``retune_keep``) must carry the blame record
@@ -567,6 +649,9 @@ def build_report(train_dir: str) -> dict:
     from atomo_tpu.budget.artifact import read_alloc
 
     budget_doc = read_alloc(train_dir)
+    from atomo_tpu.quorum.artifact import read_schedule, schedule_path
+
+    sched_meta, sched_arrivals = read_schedule(schedule_path(train_dir))
 
     events: list[dict] = []
     events.extend(_segments(steps))
@@ -626,6 +711,8 @@ def build_report(train_dir: str) -> dict:
         _check_fabric_probe(tune, fabric_probe, incidents),
         _check_drift_blame(incidents),
         _check_budget_alloc(steps, metas, budget_doc),
+        _check_quorum_schedule(steps, incidents, sched_meta,
+                               sched_arrivals),
     ]
     consistent = all(c["ok"] for c in checks)
     summary = {
@@ -648,6 +735,7 @@ def build_report(train_dir: str) -> dict:
             "tune_decision_json": tune is not None,
             "fabric_probe_json": fabric_probe is not None,
             "budget_alloc_json": budget_doc is not None,
+            "arrival_schedule_jsonl": len(sched_arrivals),
         },
         "summary": summary,
         "timeline": events,

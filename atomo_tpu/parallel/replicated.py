@@ -287,6 +287,115 @@ def init_ef_state(mesh: Mesh, state, *, axis: str = "dp") -> EfState:
     )
 
 
+@flax.struct.dataclass
+class QuorumCarry:
+    """The bounded-staleness payload history of ``--quorum`` (quorum/).
+
+    ``ring``: each chip's last K+1 ENCODED payloads, one per-leaf buffer
+    of global shape ``(n_dev, K+1, *payload_shape)`` sharded over the dp
+    axis — the :class:`OverlapCarry` layout generalized from one in-flight
+    slot to a staleness ring. Slot ``t mod (K+1)`` holds the payload
+    produced at step counter ``t``; because staleness is hard-bounded at
+    K, a ring of depth K+1 can never wrap onto a payload the schedule is
+    still allowed to select (the in-graph half of the staleness bound).
+
+    ``ring_ok``: (n_dev, K+1) float32 — the producing step's guard health
+    flag per slot (1.0 when the guard is off), PLUS the warm-up gate: a
+    never-written slot stays 0.0, so a staleness pointing before the
+    run's history selects a zero contribution even if the host schedule
+    mis-assigned it. Health travels WITH the payload, exactly like
+    :class:`OverlapCarry.ok` — a NaN source poisons the step that
+    CONSUMES it, however stale.
+
+    The carry holds ENCODED payloads for the same reason OverlapCarry
+    does: the consume chain reads only step-start values, and the ring
+    buffer costs K+1 payloads per chip, not K+1 dense gradients.
+    Checkpoints hold the ring, so kill->restart->resume replays the same
+    stale selections bit-exact.
+    """
+
+    ring: Any
+    ring_ok: jax.Array
+
+
+@flax.struct.dataclass
+class QuorumState:
+    """``TrainState`` + :class:`QuorumCarry` — what a ``--quorum`` step
+    consumes and returns (and what its checkpoints hold). Exposes
+    ``step``/``params``/``batch_stats`` like :class:`DelayedState`."""
+
+    train: TrainState
+    carry: QuorumCarry
+
+    @property
+    def step(self):
+        return self.train.step
+
+    @property
+    def params(self):
+        return self.train.params
+
+    @property
+    def batch_stats(self):
+        return self.train.batch_stats
+
+
+def _zero_quorum_carry_host(
+    codec, params, n_dev: int, staleness: int
+) -> QuorumCarry:
+    """Host-side all-zero staleness ring (the fresh-start value and the
+    resume template). Zero payloads decode to zero for every codec (the
+    _mask_gathered invariant) and zero ``ring_ok`` marks every slot
+    unwritten, so warm-up selections contribute nothing — absent, not
+    anomalous."""
+    shapes = jax.eval_shape(
+        lambda p: encode_tree(codec, jax.random.PRNGKey(0), p)[0], params
+    )
+    depth = staleness + 1
+    ring = jax.tree_util.tree_map(
+        lambda s: jnp.zeros((n_dev, depth) + tuple(s.shape), s.dtype),
+        shapes,
+    )
+    return QuorumCarry(
+        ring=ring, ring_ok=jnp.zeros((n_dev, depth), jnp.float32)
+    )
+
+
+def _place_quorum_carry(
+    mesh: Mesh, carry: QuorumCarry, *, axis: str = "dp"
+) -> QuorumCarry:
+    """Place a host-side :class:`QuorumCarry` onto the mesh, every leaf
+    sharded over ``axis`` (the _place_carry discipline: fresh init and
+    --resume must place identically or a restored trajectory drifts)."""
+    sh = NamedSharding(mesh, P(axis))
+    return QuorumCarry(
+        ring=jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.asarray(a), sh), carry.ring
+        ),
+        ring_ok=jax.device_put(jnp.asarray(carry.ring_ok), sh),
+    )
+
+
+def init_quorum_state(
+    mesh: Mesh, state, codec, staleness: int, *, axis: str = "dp"
+) -> QuorumState:
+    """Wrap a replicated state into the fresh :class:`QuorumState` a
+    ``--quorum`` step consumes (all-zero staleness ring, depth K+1)."""
+    return QuorumState(
+        train=state,
+        carry=_place_quorum_carry(
+            mesh,
+            _zero_quorum_carry_host(
+                codec,
+                jax.device_get(state.params),
+                mesh.shape[axis],
+                staleness,
+            ),
+            axis=axis,
+        ),
+    )
+
+
 def _zero1_chunk(flat_size: int, n_dev: int) -> int:
     """Per-chip slice length of the flat ZeRO-1 buffers. ONE definition
     (mesh.update.chunk_len — shared with the full sharded-update family):
@@ -766,6 +875,7 @@ def make_distributed_train_step(
     hybrid=None,
     sharded_update: Optional[ShardedUpdateSpecs] = None,
     error_feedback: bool = False,
+    quorum=None,
     _oracle_parts: bool = False,
 ):
     """Build the jitted SPMD train step over ``mesh``.
@@ -1291,6 +1401,87 @@ def make_distributed_train_step(
                 f"step's data axes are {expect_axes} — build the state "
                 "with sharded_update_state(mesh, ..., axis="
                 f"{expect_axes if len(expect_axes) > 1 else axis!r})"
+            )
+    if quorum is not None:
+        # the quorum conflict matrix (mirrored at CLI preflight and in
+        # distributed_train_loop): every reject below is a composition
+        # whose carry/masking semantics the staleness ring has not been
+        # proven against — rejected honestly, never silently degraded
+        if codec is None or aggregate not in ("gather", "ring"):
+            raise ValueError(
+                "quorum= needs a compressing codec with "
+                "aggregate='gather' or 'ring': the staleness ring carries "
+                "ENCODED payloads (dense psum has no payload to carry, "
+                "and the hierarchical boundary re-encode is not "
+                "staleness-aware)"
+            )
+        if not 1 <= quorum.quorum <= n_dev:
+            raise ValueError(
+                f"quorum Q={quorum.quorum} out of range for the "
+                f"{n_dev}-replica mesh (need 1 <= Q <= {n_dev})"
+            )
+        if overlap == "delayed":
+            raise ValueError(
+                "quorum= does not compose with overlap='delayed': the "
+                "staleness ring GENERALIZES the stale-by-one carry — "
+                "quorum with K>=1 already consumes stale payloads; "
+                "stacking both would apply staleness twice"
+            )
+        if hybrid is not None:
+            raise ValueError(
+                "quorum= does not compose with hybrid= (sparse rows): "
+                "the staleness ring's slots are codec-payload-shaped and "
+                "the row exchange is not ring-carry-aware yet"
+            )
+        if su is not None or zero1_specs is not None:
+            raise ValueError(
+                "quorum= does not compose with sharded-update/ZeRO-1 "
+                "yet: the staleness ring is untested against the sharded "
+                "state templates — run the replicated update"
+            )
+        if error_feedback:
+            raise ValueError(
+                "quorum= does not compose with error_feedback: a "
+                "dropped-or-stale payload would orphan its residual and "
+                "the telescoping bound no longer holds — run one or the "
+                "other"
+            )
+        if track_ok_bits or survivor_exact:
+            raise ValueError(
+                "quorum= does not compose with elastic membership "
+                "(track_ok_bits/survivor_exact): elastic SHRINKS the "
+                "roster while quorum rides out stragglers at fixed "
+                "membership — the two disagree about who is in the mean"
+            )
+        if k_agg:
+            raise ValueError(
+                "quorum= does not compose with num_aggregate: the "
+                "arrival schedule already decides which replicas "
+                "contribute each step — a second rotating subset would "
+                "double-select"
+            )
+        if superstep > 1:
+            raise ValueError(
+                "quorum= needs superstep=1: the host rig feeds each "
+                "step's arrival vector at dispatch time, and a fused "
+                "K-step scan has no per-step host boundary to feed it "
+                "through"
+            )
+        if stream_encode:
+            raise ValueError(
+                "quorum= does not compose with stream_encode yet: the "
+                "layer-bucket encode pipeline is not ring-carry-aware"
+            )
+        if track_quality:
+            raise ValueError(
+                "quorum= does not compose with track_quality: the "
+                "per-layer probe describes THIS step's encode while the "
+                "consumed payloads may be stale — mis-attribution, "
+                "rejected honestly"
+            )
+        if _oracle_parts:
+            raise ValueError(
+                "_oracle_parts drives the delayed-overlap oracle only"
             )
     batch_axes = (axis, inner_axis) if hierarchical else axis
     metric_axes = batch_axes
@@ -2137,6 +2328,214 @@ def make_distributed_train_step(
             check_vma=False,
             explicit_shardings=su is not None,
         )
+    if quorum is not None:
+        from atomo_tpu.elastic.shrink import survivor_decode_mean
+        from atomo_tpu.quorum.schedule import DROPPED
+
+        k_bound = quorum.staleness
+        depth = k_bound + 1
+
+        def spmd_quorum(q: QuorumState, key, images, labels, arrivals):
+            """The bounded-staleness quorum step. ``arrivals`` is the
+            host rig's (n_dev,) int32 staleness-assignment vector — a
+            TRACED input (one compiled program for every schedule; replay
+            feeds the recorded vectors back in and the trajectory is
+            bit-identical by construction). Encoding: sigma >= 0 consume
+            replica r's payload from sigma steps ago; negative = absent
+            (warm-up) or dropped (bound exceeded) — either way the
+            contribution is masked and the surviving mean is rescaled by
+            the exact unbiased n/kept operator the elastic family uses
+            (survivor_decode_mean: pinned roster-order fold, ONE
+            division), so a schedule where everything arrives on time
+            (sigma all zero) is bit-identical to the blocking step's
+            survivor-exact mean.
+
+            The staleness bound is asserted IN-GRAPH, not just host-side:
+            the ring is K+1 deep, a just-written slot's health flag only
+            becomes selectable for sigma in [0, K], and the present mask
+            below zeroes any sigma outside that window — a stale-beyond-K
+            payload CANNOT reach the mean even if a corrupted schedule
+            asks for it (it is dropped, and the host rig records the
+            matching staleness_exceeded incident)."""
+            state = q.train
+            my, k_codec, grads, loss, prec1, prec5, new_stats = (
+                compute_grads(state, key, images, labels)
+            )
+            gnorm = _local_grad_norm(grads) if track_grad_norm else None
+            ok_t = (
+                grad_ok(grads, guard.max_grad_norm)
+                if guard is not None
+                else None
+            )
+            dense_bytes = tree_nbytes(grads)
+            with named_phase("encode"):
+                payloads, stats = encode_tree(codec, k_codec, grads)
+            msg_bytes = stats.payload_bytes
+            # push this step's payload into slot step mod (K+1): the
+            # producing step's counter addresses the slot, so the
+            # consuming side can reconstruct slot = (step - sigma) mod
+            # (K+1) with no extra bookkeeping
+            slot = jnp.mod(state.step.astype(jnp.int32), depth)
+            ring = jax.tree_util.tree_map(
+                lambda r, p: jax.lax.dynamic_update_slice(
+                    r,
+                    p[None, None].astype(r.dtype),
+                    (0, slot) + (0,) * p.ndim,
+                ),
+                q.carry.ring,
+                payloads,
+            )
+            ok_val = (
+                ok_t.astype(jnp.float32)
+                if guard is not None
+                else jnp.float32(1.0)
+            )
+            ring_ok = jax.lax.dynamic_update_slice(
+                q.carry.ring_ok, ok_val.reshape(1, 1), (0, slot)
+            )
+            # select, per chip, the payload the schedule assigns it
+            sigma = arrivals[my]
+            sel_slot = jnp.mod(state.step.astype(jnp.int32) - sigma, depth)
+            sel_payload = jax.tree_util.tree_map(
+                lambda r: jax.lax.dynamic_slice(
+                    r,
+                    (0, sel_slot) + (0,) * (r.ndim - 2),
+                    (1, 1) + r.shape[2:],
+                ).reshape(r.shape[2:]),
+                ring,
+            )
+            sel_ok = jax.lax.dynamic_slice(
+                ring_ok, (0, sel_slot), (1, 1)
+            ).reshape(())
+            # the in-graph staleness bound + warm-up gate: sigma outside
+            # [0, K] masks out (and a never-written slot's ring_ok is 0)
+            present = (
+                jnp.logical_and(sigma >= 0, sigma <= k_bound).astype(
+                    jnp.float32
+                )
+                * sel_ok
+            )
+            # EQUAL WIRE to blocking: one payload per chip moves per
+            # step, whatever its staleness; masked contributions still
+            # ride (XLA collectives have no partial-completion mode —
+            # the SPMD-honesty note in the quorum package docstring)
+            if aggregate == "gather":
+                with named_phase("quorum_exchange"):
+                    gathered = jax.lax.all_gather(sel_payload, axis)
+                okg = jax.lax.all_gather(present, axis)
+                kept = jnp.sum(okg)
+                with named_phase("quorum_decode_mean"):
+                    # THE unbiased-rescale operator (elastic.shrink):
+                    # mask absent -> canonical per-replica decode ->
+                    # pinned roster-order fold -> ONE division by kept
+                    mean_grads = survivor_decode_mean(
+                        codec, gathered, okg, grads, kept=kept
+                    )
+            else:  # ring
+                with named_phase("quorum_ring_exchange_decode"):
+                    mean_grads, ok_stage = _ring_stream_mean(
+                        codec, sel_payload, grads,
+                        axis=axis, n_dev=n_dev, my=my,
+                        ok=present, sel=None, n_contrib=n_dev,
+                        bucket_size=ring_bucket_size,
+                        survivor_exact=True,
+                    )
+                kept = jnp.sum(ok_stage)
+            if remedy is not None:
+                from atomo_tpu.training.resilience import apply_remedy
+
+                mean_grads = apply_remedy(remedy, state.step, mean_grads)
+            updates, new_opt = optimizer.update(
+                mean_grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+            ok_step = kept > 0  # zero arrivals kept -> skip outright
+            new_params = select_state(ok_step, new_params, state.params)
+            new_opt = select_state(ok_step, new_opt, state.opt_state)
+            # BN stats and loss/precision describe THIS step's forward
+            # (the delayed-overlap discipline): the consumed payloads may
+            # be stale, the logged series stays aligned with the data
+            if guard is not None:
+                kept_chips = jax.lax.psum(
+                    ok_t.astype(jnp.float32), metric_axes
+                )
+                new_stats = jax.tree_util.tree_map(
+                    lambda s: _healthy_mean(
+                        s, ok_t, kept_chips, metric_axes
+                    ),
+                    new_stats,
+                )
+                stats_ok = jnp.logical_and(ok_step, kept_chips > 0)
+                metrics = {
+                    "loss": _healthy_mean(
+                        loss, ok_t, kept_chips, metric_axes
+                    ),
+                    "prec1": _healthy_mean(
+                        prec1, ok_t, kept_chips, metric_axes
+                    ),
+                    "prec5": _healthy_mean(
+                        prec5, ok_t, kept_chips, metric_axes
+                    ),
+                }
+            else:
+                new_stats = jax.lax.pmean(new_stats, metric_axes)
+                stats_ok = ok_step
+                metrics = {
+                    "loss": jax.lax.pmean(loss, metric_axes),
+                    "prec1": jax.lax.pmean(prec1, metric_axes),
+                    "prec5": jax.lax.pmean(prec5, metric_axes),
+                }
+            new_stats = select_state(
+                stats_ok, new_stats, state.batch_stats
+            )
+            metrics.update(
+                msg_bytes=jnp.asarray(msg_bytes, jnp.float32),
+                dense_bytes=jnp.asarray(dense_bytes, jnp.float32),
+                skipped=1.0 - ok_step.astype(jnp.float32),
+                # contributions absent from THIS mean, whatever the cause
+                # (staleness drop, warm-up, guard mask)
+                dropped=n_dev - kept,
+                quorum_kept=kept,
+                # the schedule's staleness-bound drops specifically — the
+                # column report's quorum_schedule_consistent reconciles
+                # against the staleness_exceeded incident stream
+                stale_dropped=jnp.sum(
+                    (arrivals == DROPPED).astype(jnp.float32)
+                ),
+            )
+            if gnorm is not None:
+                metrics["grad_norm"] = (
+                    _healthy_mean(gnorm, ok_t, kept_chips, metric_axes)
+                    if guard is not None
+                    else jax.lax.pmean(gnorm, metric_axes)
+                )
+            new_train = TrainState(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=new_stats,
+                opt_state=new_opt,
+            )
+            return (
+                QuorumState(
+                    train=new_train,
+                    carry=QuorumCarry(ring=ring, ring_ok=ring_ok),
+                ),
+                metrics,
+            )
+
+        q_spec = QuorumState(
+            train=state_spec,
+            carry=QuorumCarry(ring=P(axis), ring_ok=P(axis)),
+        )
+        # ONE compile path (parallel.compile); the arrival vector is a
+        # replicated traced input, so every schedule runs one program
+        return compile_step(
+            spmd_quorum, mesh,
+            in_specs=(q_spec, P(), P(batch_axes), P(batch_axes), P()),
+            out_specs=(q_spec, P()),
+            donate_argnums=(0,),
+            check_vma=False,
+        )
     if superstep > 1:
         # fused block variant: scan the per-step SPMD body INSIDE the
         # shard_map, so the K steps (collectives included) compile into
@@ -2395,6 +2794,8 @@ def distributed_train_loop(
     hybrid=None,
     error_feedback: bool = False,
     budget_tuner=None,
+    quorum=None,
+    quorum_replay: Optional[str] = None,
 ):
     """The distributed analogue of training.train_loop: one SPMD step per
     batch over ``mesh``, replicated state, reference-parity log lines, and
@@ -2530,7 +2931,25 @@ def distributed_train_loop(
     make_distributed_train_step for the fused-SVD/guarded-gather
     fusion-drift caveat). Rejects --phase-metrics, --elastic,
     --on-diverge and --sparse-rows honestly (see the in-loop messages);
-    supersedes ``zero1``."""
+    supersedes ``zero1``.
+
+    ``quorum`` (quorum.QuorumConfig; ``--quorum Q --staleness K``) runs
+    bounded-staleness quorum aggregation: the loop threads a
+    :class:`QuorumState` whose checkpoints include the per-chip payload
+    history ring, builds a :class:`~atomo_tpu.quorum.rig.QuorumRig`
+    (the host-side schedule/wait/record/replay authority — it stands
+    the chaos blocking sleep ``maybe_sleep_replica`` down and owns the
+    exposed wait itself), feeds the rig's per-step arrival vector to
+    the compiled step, and records every step's staleness assignment to
+    ``train_dir/arrival_schedule.jsonl``. ``quorum_replay``
+    (``--replay-arrivals PATH``) re-feeds a recorded schedule instead —
+    same schedule in, bit-identical trajectory out, drilled across
+    kill->restart->resume. The conflict matrix (mirrored at CLI
+    preflight and in the builder) rejects delayed overlap,
+    hierarchical, hybrid, sharded-update/zero1, elastic, EF,
+    num_aggregate, superstep>1, stream-encode, obs-quality,
+    phase-metrics, the doctor and the budget retuner — each with its
+    reason in the raise."""
     from atomo_tpu.training.checkpoint import latest_step, load_checkpoint
     from atomo_tpu.training.resilience import (
         SUPERVISED_ENV,
@@ -2754,6 +3173,107 @@ def distributed_train_loop(
                 "--sparse-rows yet (the row exchange is untested against "
                 "the flat master layout)"
             )
+    if quorum is not None:
+        # the quorum conflict matrix, loop half (the builder re-checks
+        # its subset; these carry the CLI-flag phrasing and the knobs
+        # only the loop knows — elastic/diverge/tuners/phase-metrics)
+        if codec is None or aggregate not in ("gather", "ring"):
+            raise ValueError(
+                "--quorum needs a compressing codec with --aggregate "
+                "gather or ring: the staleness ring carries ENCODED "
+                "payloads — dense psum has no payload to carry, and the "
+                "hierarchical boundary re-encode is not staleness-aware"
+            )
+        if mesh.shape["dp"] < 2:
+            raise ValueError(
+                "--quorum needs a multi-replica mesh: with one replica "
+                "there is nobody to be late (use --n-devices >= 2 or a "
+                "forced multi-device CPU mesh)"
+            )
+        if overlap == "delayed":
+            raise ValueError(
+                "--quorum does not compose with --overlap delayed: the "
+                "staleness ring GENERALIZES the stale-by-one carry "
+                "(quorum with K>=1 already consumes stale payloads); "
+                "stacking both would apply staleness twice"
+            )
+        if hybrid is not None:
+            raise ValueError(
+                "--quorum does not compose with --sparse-rows: the "
+                "staleness ring's slots are codec-payload-shaped and "
+                "the row exchange is not ring-carry-aware yet"
+            )
+        if sharded_update or zero1:
+            raise ValueError(
+                "--quorum does not compose with --partition "
+                "sharded-update / --zero1 yet: the staleness ring is "
+                "untested against the sharded state templates — run "
+                "the replicated update"
+            )
+        if elastic is not None:
+            raise ValueError(
+                "--quorum does not compose with --elastic: elastic "
+                "SHRINKS the roster while quorum rides out stragglers "
+                "at fixed membership — the two disagree about who is "
+                "in the mean; pick one straggler policy"
+            )
+        if error_feedback:
+            raise ValueError(
+                "--quorum does not compose with --error-feedback: a "
+                "dropped-or-stale payload would orphan its residual "
+                "and the telescoping bound no longer holds"
+            )
+        if phase_metrics:
+            raise ValueError(
+                "--quorum needs the fused step (the staleness ring "
+                "rides its carry); --phase-metrics has no fused step"
+                + PHASE_METRICS_HINT
+            )
+        if superstep > 1:
+            raise ValueError(
+                "--quorum needs --superstep 1: the host rig feeds each "
+                "step's arrival vector at dispatch time, and a fused "
+                "K-step scan has no per-step host boundary"
+            )
+        if diverge is not None:
+            raise ValueError(
+                "--quorum does not compose with --on-diverge: the "
+                "rollback replay does not rewind the arrival schedule "
+                "or the staleness ring template yet — drop one"
+            )
+        if num_aggregate:
+            raise ValueError(
+                "--quorum does not compose with --num-aggregate: the "
+                "arrival schedule already decides which replicas "
+                "contribute each step — a second rotating subset "
+                "would double-select"
+            )
+        if stream_encode:
+            raise ValueError(
+                "--quorum does not compose with --stream-encode yet: "
+                "the layer-bucket encode pipeline is not "
+                "ring-carry-aware"
+            )
+        if track_quality:
+            raise ValueError(
+                "--quorum does not compose with --obs-quality: the "
+                "per-layer probe describes THIS step's encode while "
+                "the consumed payloads may be stale — mis-attribution, "
+                "rejected honestly"
+            )
+        if budget_tuner is not None:
+            raise ValueError(
+                "--quorum does not compose with the online budget "
+                "re-allocation: a mid-run codec swap would change the "
+                "ring's payload shapes under carried stale slots — "
+                "freeze the allocation or drop --quorum"
+            )
+    elif quorum_replay:
+        raise ValueError(
+            "--replay-arrivals replays a recorded quorum schedule and "
+            "needs --quorum (with the recorded Q/K — the rig refuses a "
+            "mismatch)"
+        )
     chaos = resolve_chaos(chaos)
     if chaos is not None:
         chaos.maybe_die_crashloop()  # crashloop@M: attempt-keyed death
@@ -2766,6 +3286,7 @@ def distributed_train_loop(
     su_specs = None
     delayed_carry_host = None  # restored in-flight payload (delayed resume)
     ef_residual_host = None  # restored EF residual (--error-feedback resume)
+    quorum_carry_host = None  # restored staleness ring (--quorum resume)
     want_resume = resume and train_dir and latest_step(train_dir) is not None
     if sharded_update:
         from atomo_tpu.mesh.update import (
@@ -2995,6 +3516,47 @@ def distributed_train_loop(
                 ))
                 start_step = int(state.step)
                 log_fn(f"Resumed from {train_dir} at step {start_step}")
+        elif want_resume and quorum is not None:
+            # quorum checkpoints hold TrainState + the staleness ring:
+            # restore BOTH so the resumed steps re-select the SAME stale
+            # payloads the uninterrupted run would have (the ring plus
+            # the replayed arrival schedule is the whole resume contract)
+            template = QuorumState(
+                train=jax.device_get(state),
+                carry=_zero_quorum_carry_host(
+                    codec, jax.device_get(state.params),
+                    mesh.shape["dp"], quorum.staleness,
+                ),
+            )
+            try:
+                restored = load_checkpoint(train_dir, template)
+                state = restored.train
+                quorum_carry_host = restored.carry
+                start_step = int(state.step)
+                log_fn(f"Resumed from {train_dir} at step {start_step}")
+            except FileNotFoundError as exc:
+                log_fn(f"Resume requested but {exc}; starting fresh")
+            except (KeyError, ValueError) as exc:
+                # a ring-less (plain) checkpoint, or one written at a
+                # different K (the ring template is (n_dev, K+1)-shaped):
+                # restore the train state alone and re-zero the ring —
+                # the first resumed steps then consume warm-up absences
+                # instead of the carried stale payloads, an honest
+                # divergence from the uninterrupted run, said out loud
+                import warnings
+
+                warnings.warn(
+                    "--quorum resume: checkpoint has no matching "
+                    f"staleness ring ({exc}); restoring the train state "
+                    "only — the resumed steps warm the ring up from "
+                    "empty (recorded K must match to resume the ring)"
+                )
+                state = load_checkpoint(train_dir, create_state(
+                    model, optimizer, jax.random.PRNGKey(seed),
+                    jnp.asarray(sample_images),
+                ))
+                start_step = int(state.step)
+                log_fn(f"Resumed from {train_dir} at step {start_step}")
         elif want_resume and overlap == "delayed":
             # delayed checkpoints hold TrainState + the in-flight payload:
             # restore BOTH so the resumed trajectory is the uninterrupted
@@ -3074,6 +3636,16 @@ def distributed_train_loop(
             )
         else:
             state = init_ef_state(mesh, state)
+    if quorum is not None:
+        if quorum_carry_host is not None:
+            state = QuorumState(
+                train=state,
+                carry=_place_quorum_carry(mesh, quorum_carry_host),
+            )
+        else:
+            state = init_quorum_state(
+                mesh, state, codec, quorum.staleness
+            )
     if overlap == "delayed":
         if delayed_carry_host is not None:
             state = DelayedState(
@@ -3184,6 +3756,7 @@ def distributed_train_loop(
                 # path: the hybrid plan stands down with the codec
                 hybrid=None if densify else hybrid,
                 error_feedback=error_feedback,
+                quorum=quorum,
             )
 
         step_fn = build_step()
@@ -3215,9 +3788,30 @@ def distributed_train_loop(
     incidents = None
     if train_dir and (
         diverge is not None or tuner is not None or elastic is not None
+        or quorum is not None
         or os.environ.get(SUPERVISED_ENV) == "1"
     ):
         incidents = IncidentLog.for_train_dir(train_dir)
+    quorum_rig = None
+    if quorum is not None:
+        from atomo_tpu.quorum.rig import QuorumRig
+
+        # the host-side schedule/wait/record/replay authority; it owns
+        # the straggler wait from here on (the chaos blocking sleep
+        # maybe_sleep_replica stands down in the step loop below)
+        quorum_rig = QuorumRig(
+            quorum,
+            n_dev=mesh.shape["dp"],
+            train_dir=train_dir,
+            chaos=chaos,
+            incidents=incidents,
+            replay_path=quorum_replay,
+            log_fn=log_fn,
+        )
+        # a resumed run replays from the checkpoint: cut the killed
+        # attempt's recorded schedule tail, the recorder.prune_past
+        # discipline applied to arrival_schedule.jsonl
+        quorum_rig.prune_past(start_step)
     elastic_rig = None
     if elastic is not None:
         from atomo_tpu.elastic.coordinator import ElasticCoordinator
@@ -3369,6 +3963,7 @@ def distributed_train_loop(
                 guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
                 rig=rig, incidents=incidents, tuner=tuner, retune=retune,
                 elastic_rig=elastic_rig, recorder=recorder,
+                quorum_rig=quorum_rig,
             )
     return state
 
@@ -3431,6 +4026,7 @@ def _distributed_steps(
     profile_dir=None, profile_steps=3, batch_axes="dp",
     guard=None, chaos=None, keep_ckpts=0, rig=None, incidents=None,
     tuner=None, retune=None, elastic_rig=None, recorder=None,
+    quorum_rig=None,
 ):
     import time as _time
 
@@ -3451,6 +4047,12 @@ def _distributed_steps(
         if chaos is not None:
             chaos.maybe_die(step)
             chaos.maybe_sleep(step)
+            if quorum_rig is None:
+                # blocking baseline: the lockstep step is gated on the
+                # slowest replica, so a slow@S:R:SEC straggler stalls
+                # the whole step — the honest cost --quorum absorbs
+                # (when a rig is armed IT owns the wait instead)
+                chaos.maybe_sleep_replica(step, mesh.shape["dp"])
         if prof_first is not None and step == prof_first:
             prof_ctx = profile(profile_dir)
             prof_ctx.__enter__()
@@ -3467,7 +4069,15 @@ def _distributed_steps(
                 })
         images, labels = next(stream)
         si, sl = shard_batch(mesh, images, labels, axis=batch_axes)
-        out = step_fn(state, key, si, sl)
+        if quorum_rig is not None:
+            # the rig decides (or replays) this step's staleness
+            # assignment, sleeps the exposed wait, records the schedule
+            # line and any staleness_exceeded incidents — then the
+            # vector rides into the compiled step as a traced input
+            arrivals = quorum_rig.begin_step(step)
+            out = step_fn(state, key, si, sl, arrivals)
+        else:
+            out = step_fn(state, key, si, sl)
         if prof_ctx is not None and step >= prof_first + profile_steps - 1:
             jax.block_until_ready(out[0].params)
             prof_ctx.__exit__(None, None, None)
@@ -3717,6 +4327,10 @@ def _distributed_superstep_steps(
             for t in range(b0 + 1, s + 1):
                 chaos.maybe_die(t)
                 chaos.maybe_sleep(t)
+                # superstep is always the blocking baseline (--quorum
+                # rejects --superstep > 1): a slow@S:R:SEC straggler
+                # gates every step in the block
+                chaos.maybe_sleep_replica(t, mesh.shape["dp"])
         if profile_dir and block_idx == 2 and prof_ctx is None:
             # block 1 is dominated by compilation; trace the second block
             prof_ctx = profile(profile_dir)

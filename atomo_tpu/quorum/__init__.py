@@ -1,0 +1,105 @@
+"""Bounded-staleness quorum aggregation (``--quorum Q --staleness K``).
+
+PR 4's ``--overlap delayed`` absorbs exactly one step of lag for every
+replica at once; production fleets have *fat-tail* stragglers — one slow
+host, persistently late — that a stale-by-one carry cannot absorb and a
+blocking step pays for every step (the lockstep program is gated on its
+slowest member). This package generalizes the carry into a staleness-K /
+quorum-Q family:
+
+  * each step consumes, per replica, the freshest payload that has
+    ARRIVED — on-time replicas contribute this step's encode, a
+    straggler's payload rides forward on a per-chip history ring bounded
+    at K steps stale;
+  * a payload older than K is DROPPED and counted (a
+    ``staleness_exceeded`` incident per drop — never a silent stale
+    apply; the bound is also asserted in-graph, where a staleness outside
+    [0, K] simply cannot select a live ring slot);
+  * the surviving mean is rescaled by the exact unbiased n/kept argument
+    the gradient guard and the elastic layer already use — the SAME
+    operator (:func:`atomo_tpu.elastic.shrink.survivor_decode_mean`:
+    pinned roster-order fold, ONE division), so quorum trajectories are
+    bit-comparable to the elastic family's;
+  * a step keeps at least Q arrivals: when drops/warm-up leave fewer
+    than Q payloads present, the rig waits for the straggler's fresh
+    payload instead — the exposed wait is the Q-th order statistic of
+    the per-replica lags, which is exactly what
+    :func:`atomo_tpu.utils.comm_model.quorum_exposed_wait_s` prices for
+    the autopilot's ``+qK`` candidates.
+
+SPMD honesty: XLA collectives have no partial-completion mode (the
+hierarchical-aggregation caveat in parallel/replicated.py), so arrival is
+modelled, not raced: the HOST decides each step's per-replica staleness
+assignment — a pure function of (chaos ``slow@S:R:SEC`` table, step) —
+sleeps the exposed wait it implies, records the assignment to
+``train_dir/arrival_schedule.jsonl``, and feeds the vector to the
+compiled step as a traced input. Same schedule in => bit-identical
+trajectory out (``--replay-arrivals`` feeds a recorded schedule back in,
+drilled across kill->restart->resume), and the wire is EQUAL to
+blocking's: one payload per chip moves per step, whatever its staleness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class QuorumConfig:
+    """The quorum family's knobs, validated once.
+
+    ``quorum`` (Q): the minimum number of payloads a step consumes; when
+    drops or warm-up leave fewer present, the rig waits for fresh
+    payloads (Q = n_dev degenerates to blocking's wait-for-all).
+    ``staleness`` (K): the HARD bound on how many steps a payload may
+    ride the carry; older payloads are dropped and counted.
+    ``period_s``: the modelled seconds-per-step that converts a chaos
+    straggler's lag (seconds) into a staleness (steps); recorded in the
+    arrival-schedule header so a replay cannot silently re-derive a
+    different schedule from the same chaos spec."""
+
+    quorum: int
+    staleness: int = 1
+    period_s: float = 0.1
+
+    def __post_init__(self):
+        if self.quorum < 1:
+            raise ValueError(
+                f"--quorum must be >= 1 (got {self.quorum}); a step that "
+                "waits for zero arrivals has nothing to average"
+            )
+        if self.staleness < 0:
+            raise ValueError(
+                f"--staleness must be >= 0, got {self.staleness}"
+            )
+        if self.period_s <= 0:
+            raise ValueError(
+                f"quorum period must be > 0 s, got {self.period_s}"
+            )
+
+
+from atomo_tpu.quorum.artifact import (  # noqa: E402
+    ARRIVAL_SCHEDULE_NAME,
+    prune_schedule_after,
+    read_schedule,
+    schedule_path,
+)
+from atomo_tpu.quorum.rig import QuorumRig  # noqa: E402
+from atomo_tpu.quorum.schedule import (  # noqa: E402
+    ABSENT,
+    DROPPED,
+    staleness_vector,
+)
+
+__all__ = [
+    "ABSENT",
+    "ARRIVAL_SCHEDULE_NAME",
+    "DROPPED",
+    "QuorumConfig",
+    "QuorumRig",
+    "prune_schedule_after",
+    "read_schedule",
+    "schedule_path",
+    "staleness_vector",
+]

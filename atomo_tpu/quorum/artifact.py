@@ -1,0 +1,85 @@
+"""``train_dir/arrival_schedule.jsonl`` — the quorum run's replay anchor.
+
+Schema (one JSON object per line, append-only — the IncidentLog /
+FlightRecorder write discipline, so the artifact lint covers this module
+by construction):
+
+  {"kind": "meta", "what": "quorum_config", "quorum": Q, "staleness": K,
+   "n_replicas": N, "period_s": P}
+  {"kind": "arrival", "step": s, "staleness": [sigma_0..sigma_{N-1}],
+   "kept": k, "dropped": d, "exposed_wait_ms": w}
+
+The meta header pins the knobs the per-step vectors were derived under;
+adopting an existing artifact with DIFFERENT knobs is refused out loud
+(a schedule recorded at K=2 replayed under K=1 would silently change
+which payloads drop). Staleness encoding in the vectors: >= 0 present at
+that staleness, -1 dropped (bound exceeded), -2 absent (warm-up) — see
+quorum.schedule.
+
+A resumed run cuts the tail past its restart checkpoint with
+:func:`prune_schedule_after` (the flight recorder's atomic
+keep-records-<=-step rewrite, applied to this file) and then re-records
+the identical lines — the kill->restart->resume drill's contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Optional
+
+ARRIVAL_SCHEDULE_NAME = "arrival_schedule.jsonl"
+
+
+def schedule_path(train_dir: str) -> str:
+    return os.path.join(train_dir, ARRIVAL_SCHEDULE_NAME)
+
+
+def append_record(path: str, rec: dict) -> None:
+    """One newline-terminated line per record, one write() per line —
+    the append-only artifact discipline. Best-effort: an unwritable
+    artifact degrades observability/replayability, never training."""
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as exc:  # pragma: no cover - disk-full etc.
+        print(
+            f"WARNING: could not append to {path}: {exc}",
+            file=sys.stderr,
+        )
+
+
+def read_schedule(path: str):
+    """Parse an arrival schedule: (meta_or_None, {step: arrival_record}).
+    Tolerant of a torn final line (the run may have been SIGKILLed mid
+    append) — exactly the read_jsonl discipline."""
+    meta: Optional[dict] = None
+    arrivals: dict[int, dict] = {}
+    if not os.path.exists(path):
+        return meta, arrivals
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a killed writer
+            if rec.get("kind") == "meta":
+                meta = rec
+            elif rec.get("kind") == "arrival" and "step" in rec:
+                arrivals[int(rec["step"])] = rec
+    return meta, arrivals
+
+
+def prune_schedule_after(train_dir: str, step: int) -> None:
+    """Cut every arrival record past ``step`` (atomic rewrite; the meta
+    header has no step field and is always kept) — called by a resuming
+    run so the killed attempt's unsaved tail cannot shadow the lines the
+    replayed steps re-record."""
+    from atomo_tpu.obs.recorder import _prune_file_after
+
+    _prune_file_after(schedule_path(train_dir), step)

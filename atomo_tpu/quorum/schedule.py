@@ -1,0 +1,112 @@
+"""The arrival schedule: a PURE function of (fault table, step).
+
+Everything replayable about quorum aggregation rests on this file having
+no hidden state: the per-step staleness assignment derives from the chaos
+``slow@S:R:SEC`` table and the step number alone, so a resumed run, a
+doctor replay, or a ``--replay-arrivals`` run re-derives (or re-reads)
+the identical vectors and the trajectory is bit-identical.
+
+The arrival model, stated once
+------------------------------
+A replica slowed by SEC seconds from step S onward finishes its step-p
+work SEC late for every p >= S. With a modelled step period of
+``period_s`` that lag is ``L = ceil(SEC / period_s)`` steps (at least 1),
+and at consuming step s the freshest payload that has ARRIVED from that
+replica is:
+
+  * its CURRENT payload (staleness 0) while s < S (not yet slow);
+  * its last on-time payload during the warm-up window — staleness
+    ``s - S + 1`` for s in [S, S+L) — rising one step per step until
+  * the pipeline fills: staleness exactly L for s >= S + L (the payload
+    produced L steps ago arrives just as step s begins).
+
+  In one expression: ``sigma_avail = min(s - S + 1, L)`` for s >= S.
+
+A payload whose available staleness exceeds the K bound is DROPPED
+(encoded -1; one ``staleness_exceeded`` incident each step it would have
+been consumed). A staleness larger than the run's own history (steps
+before the producing step exists) is ABSENT (encoded -2; warm-up, not a
+drop — there is nothing stale to drop). The quorum floor then promotes
+waiting replicas: while fewer than Q payloads are present, the replica
+with the smallest remaining lag is waited for instead (staleness becomes
+0) and the step's exposed wait is the largest lag waited on — which is
+the Q-th order statistic of the per-replica lag vector, the quantity
+``utils.comm_model.quorum_exposed_wait_s`` prices.
+"""
+
+from __future__ import annotations
+
+import math
+
+DROPPED = -1  # staleness bound exceeded: dropped + counted
+ABSENT = -2  # warm-up: no payload exists yet (not a drop)
+
+
+def lateness_steps(sec: float, period_s: float) -> int:
+    """A straggler's lag in whole steps: ceil(SEC / period), at least 1
+    (a positive lag can never round down to 'on time')."""
+    return max(1, int(math.ceil(sec / period_s)))
+
+
+def staleness_vector(
+    step: int,
+    *,
+    n_dev: int,
+    quorum: int,
+    staleness: int,
+    faults,
+    period_s: float,
+):
+    """The arrival schedule for 1-based ``step``.
+
+    ``faults`` is the chaos ``slow_replica_faults`` table — an iterable
+    of (start_step, replica, seconds). Returns ``(sigma, exposed_wait_s,
+    drops)``: ``sigma`` is the per-replica staleness assignment
+    (length ``n_dev``; >= 0 present at that staleness, :data:`DROPPED`
+    or :data:`ABSENT` otherwise), ``exposed_wait_s`` the seconds the
+    host must wait to honor the quorum floor, and ``drops`` the
+    [(replica, available_staleness)] list behind each DROPPED entry
+    (the incident detail)."""
+    sigma = [0] * n_dev
+    wait = [0.0] * n_dev
+    avail = [0] * n_dev
+    for r in range(n_dev):
+        active = [
+            (sec, start)
+            for start, rep, sec in faults
+            if rep == r and step >= start
+        ]
+        if not active:
+            continue
+        # the dominant fault: largest lag wins, earliest start on ties
+        sec, start = max(active, key=lambda a: (a[0], -a[1]))
+        lag = lateness_steps(sec, period_s)
+        sig = min(step - start + 1, lag)
+        if sig > step - 1:
+            # the producing step does not exist yet: warm-up absence
+            sigma[r] = ABSENT
+            wait[r] = sec
+        elif sig <= staleness:
+            sigma[r] = sig  # present, stale — rides the carry
+        else:
+            sigma[r] = DROPPED
+            wait[r] = sec
+            avail[r] = sig
+    present = sum(1 for s in sigma if s >= 0)
+    exposed = 0.0
+    if present < quorum:
+        # quorum floor: wait for the nearest fresh payloads instead.
+        # Ascending-lag order makes the exposed wait exactly the Q-th
+        # order statistic of the per-replica lag vector.
+        waiting = sorted(
+            (r for r in range(n_dev) if sigma[r] < 0),
+            key=lambda r: (wait[r], r),
+        )
+        for r in waiting:
+            sigma[r] = 0
+            exposed = max(exposed, wait[r])
+            present += 1
+            if present >= quorum:
+                break
+    drops = [(r, avail[r]) for r in range(n_dev) if sigma[r] == DROPPED]
+    return sigma, exposed, drops

@@ -108,7 +108,7 @@ def winner_knobs(row: dict) -> dict:
         k: row[k]
         for k in ("aggregate", "overlap", "superstep", "ring_bucket_size",
                   "plan", "stream_encode", "stream_bucket_bytes",
-                  "sparse_rows", "budget_alloc")
+                  "sparse_rows", "budget_alloc", "quorum", "staleness")
         if k in row
     }
 
@@ -184,6 +184,10 @@ def tune(
     allow_budget: bool = False,
     budget_leaf_budgets=None,
     budget_codec=None,
+    allow_quorum: bool = False,
+    quorum_q: int = 0,
+    quorum_staleness_options=(1, 2),
+    quorum_delays=None,
     superstep_options=(1, 8),
     bucket_options=(65536,),
     dcn_ways: int = 0,
@@ -230,6 +234,17 @@ def tune(
     step builder with the WRAPPED codec swapped in — the measured ladder
     decides whether the adaptive split beats the uniform one on this
     deployment, and the winner's ``budget_alloc`` knob records it.
+
+    ``allow_quorum`` + ``quorum_q`` >= 1 add the ``+qK`` bounded-
+    staleness variants (one per bound in ``quorum_staleness_options``)
+    of every plain blocking gather/ring candidate, PRICED by the
+    expected exposed straggler wait (``quorum_delays`` — the chaos
+    ``slow@`` table's per-replica lag vector; blocking candidates pay
+    its max, quorum candidates its Q-th order statistic,
+    ``comm_model.quorum_exposed_wait_s``) but never PROBED: the probe
+    harness runs straggler-free, so a measured quorum probe would omit
+    exactly the wait the candidate exists to absorb — the rows carry
+    the prediction and say why (``probe_note``).
 
     ``fabric_probe`` (the ``fabric_probe.json`` document) is required
     when ``fabric == "measured"``: the ONE parsers resolve the token
@@ -309,6 +324,9 @@ def tune(
         ),
         allow_budget=bool(allow_budget and budget_codec is not None),
         budget_leaf_budgets=budget_leaf_budgets,
+        allow_quorum=bool(allow_quorum),
+        quorum_q=int(quorum_q),
+        quorum_staleness_options=quorum_staleness_options,
         superstep_options=superstep_options,
         bucket_options=bucket_options,
         dcn_ways=int(dcn_ways) if two_tier else 0,
@@ -331,6 +349,9 @@ def tune(
         # prices the +ab candidates from the allocation's per-leaf
         # pairs — held once here, like the sparse budgets above
         budget_leaf_budgets=budget_leaf_budgets,
+        # the straggler-exposure term: blocking candidates pay the max
+        # delay, +qK candidates the Q-th order statistic
+        quorum_delays=quorum_delays,
     )
     from atomo_tpu.mesh import MeshSpec
 
@@ -388,6 +409,20 @@ def tune(
     )
     n_probe = max(1, min(int(probe_top), len(ranked)))
     for i, cand in enumerate(ranked):
+        if cand.get("quorum"):
+            # priced, never probed (tune() docstring): the probe harness
+            # runs straggler-free, so a measured quorum probe would omit
+            # exactly the exposed wait the candidate exists to absorb
+            ladder.record({
+                **cand,
+                "probed": False,
+                "probe_note": (
+                    "quorum candidates are priced by expected exposed "
+                    "wait, not probed — the straggler-free probe harness "
+                    "cannot measure the wait they absorb"
+                ),
+            })
+            continue
         if i >= n_probe:
             ladder.record({**cand, "probed": False})
             continue
@@ -484,7 +519,8 @@ def decision_path(train_dir: str) -> str:
 
 
 def decision_reusable(
-    doc, *, n_dev: int, mesh_axes: Optional[dict] = None
+    doc, *, n_dev: int, mesh_axes: Optional[dict] = None,
+    quorum: Optional[int] = None, staleness: Optional[int] = None,
 ) -> tuple[bool, str]:
     """Can a ``--resume`` reuse this recorded tune decision?
 
@@ -507,6 +543,12 @@ def decision_reusable(
     predate the mesh record fall back to the n_devices check (said in
     the reason, never silently).
 
+    ``quorum``/``staleness`` (the resuming run's bounded-staleness
+    knobs; None/0 = quorum off) must match what the recorded winner
+    pinned: a decision priced under one (Q, K) means something else
+    under another — the same refusal family as the arrival artifact's
+    meta check (quorum.rig), applied to the tune decision.
+
     Returns ``(reusable, reason)``; the reason is logged either way and
     lands in incidents.jsonl on the re-tune path. A PURE function of the
     document (tested), like choose_winner."""
@@ -514,6 +556,22 @@ def decision_reusable(
         return False, "decision artifact is missing or incomplete"
     if not ((doc.get("winner") or {}).get("knobs")):
         return False, "decision artifact names no winner"
+    knobs = (doc.get("winner") or {}).get("knobs") or {}
+    rec_q = knobs.get("quorum") or None
+    rec_k = knobs.get("staleness") or None
+    run_q = int(quorum) if quorum else None
+    run_k = int(staleness) if staleness else None
+    # run_k None with a real run_q = "any K" (the resume site under
+    # --auto tune knows the chaos-derived Q but K was the ladder's pick)
+    if rec_q != run_q or (
+        rec_q is not None and run_k is not None and rec_k != run_k
+    ):
+        return False, (
+            f"decision pinned quorum={rec_q} staleness={rec_k} but this "
+            f"run sets quorum={run_q} staleness={run_k} — a winner "
+            "priced under one (Q, K) is invalid under another; "
+            "re-tuning"
+        )
     rec = (doc.get("meta") or {}).get("n_devices")
     if rec != n_dev:
         return False, (

@@ -30,6 +30,17 @@ Fault kinds
               fault it IGNORES doctor generations — a dead host stays
               dead across rollbacks                         (in-graph)
   slow@S:SEC  host sleeps SEC seconds before step S         (host)
+  slow@S:R:SEC replica R is a PERSISTENT straggler: SEC seconds late
+              every step from step S onward — the heterogeneous-fleet
+              fat-tail skew the quorum family absorbs. Under blocking
+              aggregation the lockstep step is gated on the slowest
+              replica, so the host sleeps SEC before EVERY step >= S
+              (the honest blocking baseline); under --quorum the rig
+              owns the wait instead (it sleeps only the Q-th-arrival
+              exposed wait and the stale payload rides the carry).
+              Like die@S:R it is keyed on the membership epoch and
+              IGNORES doctor generations — a slow host stays slow
+              across rollbacks                              (host)
   kill@S      process dies (os._exit) before step S runs    (host)
   crashloop@M the process dies at loop start on the first M runs and
               succeeds from run M+1 on (run index = the supervisor's
@@ -80,7 +91,8 @@ CKPT_FAULTS = ("truncate", "bitflip", "badmagic")
 CHAOS_EXIT_CODE = 43  # distinct from crashes (1) and the watchdog's 13
 
 _SPEC_RE = re.compile(
-    r"^(?P<kind>[a-z]+)@(?P<step>\d+)(?P<all>\*)?(?::(?P<arg>[0-9.e+-]+))?$"
+    r"^(?P<kind>[a-z]+)@(?P<step>\d+)(?P<all>\*)?"
+    r"(?::(?P<arg>[0-9.e+-]+))?(?::(?P<arg2>[0-9.e+-]+))?$"
 )
 
 
@@ -98,6 +110,9 @@ class ChaosConfig:
     ckpt_faults: tuple[tuple[int, str], ...] = ()
     spike_faults: tuple[tuple[int, int], ...] = ()  # (start_step, window)
     die_faults: tuple[tuple[int, int], ...] = ()  # (start_step, replica)
+    # slow@S:R:SEC — (start_step, replica, seconds): replica R lags SEC s
+    # on EVERY step >= S (persistent straggler, the quorum drill's skew)
+    slow_replica_faults: tuple[tuple[int, int, float], ...] = ()
     spike_scale: float = 8.0  # finite: passes grad_ok's finiteness screen
     crashloop: int = 0  # first M runs die at loop start; run M+1 succeeds
     explode_scale: float = 1e12
@@ -135,6 +150,7 @@ class ChaosConfig:
         if spike_scale is None:
             spike_scale = float(env.get("ATOMO_CHAOS_SPIKE_SCALE", "8.0"))
         grad, slow, kill, ckpt, spike, die = [], [], [], [], [], []
+        slow_rep = []
         crashloop = 0
         for raw in spec.split(","):
             tok = raw.strip().lower()
@@ -148,7 +164,12 @@ class ChaosConfig:
                     f"{sorted(GRAD_FAULTS) + ['spike', 'die', 'slow', 'kill', 'crashloop'] + list(CKPT_FAULTS)}"
                 )
             kind, step = m.group("kind"), int(m.group("step"))
-            arg = m.group("arg")
+            arg, arg2 = m.group("arg"), m.group("arg2")
+            if arg2 is not None and kind != "slow":
+                raise ValueError(
+                    f"chaos token {tok!r}: only slow@S:R:SEC takes two "
+                    "colon args"
+                )
             if kind in GRAD_FAULTS:
                 grad.append((step, kind, bool(m.group("all"))))
             elif kind == "spike":
@@ -165,7 +186,21 @@ class ChaosConfig:
                     )
                 die.append((step, rep))
             elif kind == "slow":
-                slow.append((step, float(arg) if arg else 0.25))
+                if arg2 is not None:
+                    # slow@S:R:SEC — replica-targeted persistent straggler
+                    rep = int(float(arg))
+                    sec = float(arg2)
+                    if rep < 0:
+                        raise ValueError(
+                            f"slow replica must be >= 0, got {rep}"
+                        )
+                    if sec <= 0:
+                        raise ValueError(
+                            f"slow replica delay must be > 0 s, got {sec}"
+                        )
+                    slow_rep.append((step, rep, sec))
+                else:
+                    slow.append((step, float(arg) if arg else 0.25))
             elif kind == "kill":
                 kill.append(step)
             elif kind == "crashloop":
@@ -182,6 +217,7 @@ class ChaosConfig:
             ckpt_faults=tuple(ckpt),
             spike_faults=tuple(spike),
             die_faults=tuple(die),
+            slow_replica_faults=tuple(slow_rep),
             spike_scale=spike_scale,
             crashloop=crashloop,
             seed=seed,
@@ -201,7 +237,7 @@ class ChaosConfig:
         return bool(
             self.grad_faults or self.slow_steps or self.kill_steps
             or self.ckpt_faults or self.spike_faults or self.die_faults
-            or self.crashloop
+            or self.slow_replica_faults or self.crashloop
         )
 
 
@@ -395,6 +431,35 @@ class ChaosInjector:
                 time.sleep(sec)
                 total += sec
         return total
+
+    def replica_delays(self, step: int, n_dev: int) -> list[float]:
+        """Per-replica straggler lag (seconds) at 1-based ``step`` from the
+        slow@S:R:SEC table: the max active fault's SEC per replica, 0.0 for
+        on-time replicas. A PURE function of (config, step) — the quorum
+        arrival schedule derives from it, so record/replay and the
+        doctor's rollback replay see the identical skew. Epoch-keyed like
+        die@ (fires only at membership epoch 0) and generation-IGNORING
+        (a slow host stays slow across rollbacks)."""
+        delays = [0.0] * n_dev
+        if self.membership_epoch:
+            return delays
+        for start, rep, sec in self.config.slow_replica_faults:
+            if step >= start and rep < n_dev:
+                delays[rep] = max(delays[rep], sec)
+        return delays
+
+    def maybe_sleep_replica(self, step: int, n_dev: int) -> float:
+        """BLOCKING-mode host cost of the slow@S:R:SEC stragglers: a
+        lockstep SPMD step is gated on its slowest replica, so the host
+        sleeps the max active lag before EVERY step the fault covers —
+        the honest baseline the quorum rig's exposed-wait sleep is
+        measured against. The quorum loop does NOT call this (the rig
+        owns the wait; see quorum.rig.QuorumRig.begin_step). Returns
+        seconds slept."""
+        lag = max(self.replica_delays(step, n_dev), default=0.0)
+        if lag > 0:
+            time.sleep(lag)
+        return lag
 
     def should_die(self, step: int) -> bool:
         return not self.generation and step in self.config.kill_steps

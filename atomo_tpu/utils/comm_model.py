@@ -197,6 +197,22 @@ def choose_aggregate(
     return "gather", reason
 
 
+def quorum_exposed_wait_s(delays, quorum: int) -> float:
+    """The quorum step's exposed straggler wait: the Q-th order statistic
+    of the per-replica delay vector (seconds). A blocking step pays
+    ``max(delays)`` — the slowest replica gates every step; a quorum-Q
+    step only waits until Q payloads are present, so its exposure is the
+    Q-th smallest delay (quorum.schedule's quorum floor promotes the
+    nearest stragglers first, making this exact, not a bound). This is
+    the quantity the autopilot's ``+qK`` candidates are priced by and
+    bench config 17 measures."""
+    d = sorted(float(x) for x in delays)
+    if not d:
+        return 0.0
+    q = min(max(int(quorum), 1), len(d))
+    return d[q - 1]
+
+
 def leaf_budget_totals(leaf_budgets) -> tuple[float, float]:
     """Sum per-leaf ``(dense_bytes, payload_bytes)`` pairs into the
     ``(dense, payload)`` totals every wire formula consumes — THE one
@@ -514,6 +530,9 @@ def candidate_name(cand: dict) -> str:
         bits.append("sp")  # per-layer sparse-row hybrid exchange
     if cand.get("budget_alloc") == "variance":
         bits.append("ab")  # adaptive variance-budget per-layer ranks
+    if cand.get("quorum"):
+        # bounded-staleness quorum aggregation: K is the staleness bound
+        bits.append(f"q{cand.get('staleness', 1)}")
     bits.append(f"k{cand.get('superstep', 1)}")
     if cand.get("aggregate") == "ring":
         bits.append(f"b{cand.get('ring_bucket_size', 65536)}")
@@ -534,6 +553,9 @@ def enumerate_candidates(
     sparse_leaf_budgets=None,
     allow_budget: bool = False,
     budget_leaf_budgets=None,
+    allow_quorum: bool = False,
+    quorum_q: int = 0,
+    quorum_staleness_options=(1, 2),
     superstep_options=(1, 8),
     bucket_options=(65536,),
     dcn_ways: int = 0,
@@ -580,7 +602,20 @@ def enumerate_candidates(
     wire-match gate); the sparse-candidate restrictions apply for the
     same reason until the delayed/streamed compositions are probed.
     ``+sp`` and ``+ab`` do not cross (the hybrid planner prices the
-    dense sub-list at the base codec's budget)."""
+    dense sub-list at the base codec's budget).
+
+    ``allow_quorum`` emits a bounded-staleness quorum variant (suffix
+    ``+qK``, one per staleness bound K in ``quorum_staleness_options``,
+    each carrying ``quorum=quorum_q`` — the caller's Q floor, typically
+    N-1) of every plain blocking gather/ring candidate: the same
+    restriction set as ``+sp``/``+ab`` because quorum composes with
+    neither delayed overlap, stream-encode, hierarchical nor supersteps
+    (the in-run conflict matrix —
+    parallel.replicated.make_distributed_train_step). The variants are
+    only worth probing under straggler load, so callers pass
+    ``allow_quorum`` exactly when a ``slow@`` chaos table (or a measured
+    skew) gives :func:`predict_step_s` a delay vector to price them
+    by."""
     ks = sorted({max(int(k), 1) for k in superstep_options})
     out: list[dict] = []
     if ways <= 1:
@@ -656,6 +691,28 @@ def enumerate_candidates(
                                 out.append(
                                     {**c, "budget_alloc": "variance"}
                                 )
+                            if (
+                                allow_quorum
+                                and int(quorum_q) >= 1
+                                and agg in ("gather", "ring")
+                                and ov == "off"
+                                and sb is None
+                                and k == 1
+                            ):
+                                # superstep > 1 is in quorum's conflict
+                                # matrix: the host feeds a fresh arrival
+                                # vector every step
+                                for st in sorted(
+                                    {max(int(s), 1)
+                                     for s in quorum_staleness_options}
+                                ):
+                                    out.append(
+                                        {
+                                            **c,
+                                            "quorum": int(quorum_q),
+                                            "staleness": st,
+                                        }
+                                    )
     if (
         has_codec
         and ways > 1
@@ -694,6 +751,7 @@ def predict_step_s(
     leaf_budgets=None,
     sparse_leaf_budgets=None,
     budget_leaf_budgets=None,
+    quorum_delays=None,
 ) -> float:
     """Model one candidate's synchronous step time (seconds).
 
@@ -728,7 +786,16 @@ def predict_step_s(
     ``topology.schedule.predict_plan_step_s`` and require ``fabric2`` (a
     :class:`~atomo_tpu.topology.fabric.TwoTierFabric`); on a two-tier
     mesh the flat candidates' ``fabric_bw`` should be the OUTER tier's
-    bandwidth — the slowest link on their gradient path."""
+    bandwidth — the slowest link on their gradient path.
+
+    ``quorum_delays`` (per-replica straggler delay vector, seconds —
+    from the chaos ``slow@`` table or a measured skew) adds the straggler
+    exposure every synchronous step pays: a blocking candidate waits for
+    the SLOWEST replica (``max(delays)``); a ``+qK`` quorum candidate
+    waits only the Q-th order statistic
+    (:func:`quorum_exposed_wait_s`) — the entire wall-clock case for
+    quorum aggregation, visible in the ranking exactly when a delay
+    vector exists."""
     lb = cand.get("leaf_budgets")
     if lb is None and cand.get("sparse_rows") == "on":
         lb = sparse_leaf_budgets
@@ -803,7 +870,17 @@ def predict_step_s(
     chain = wire / fabric_bw + decode_s
     if cand.get("overlap") == "delayed" and agg in ("gather", "ring"):
         chain = overlap_exposed_comm_s(chain, compute_s)
-    return compute_s + encode_s + chain + dispatch_s / k
+    straggler_s = 0.0
+    if quorum_delays:
+        # every synchronous step is gated by its stragglers: blocking
+        # waits for the slowest replica, quorum only for the Q-th arrival
+        if cand.get("quorum"):
+            straggler_s = quorum_exposed_wait_s(
+                quorum_delays, int(cand["quorum"])
+            )
+        else:
+            straggler_s = max(float(x) for x in quorum_delays)
+    return compute_s + encode_s + chain + straggler_s + dispatch_s / k
 
 
 def rank_candidates(
@@ -819,14 +896,17 @@ def rank_candidates(
     fabric2=None,
     sparse_leaf_budgets=None,
     budget_leaf_budgets=None,
+    quorum_delays=None,
 ) -> list[dict]:
     """Candidates + their predicted ms/step, best first (ties broken by
     name so the order — and therefore which candidates get probed — is
     deterministic for a given context). ``fabric2`` prices any
     hierarchical candidates per tier; ``sparse_leaf_budgets`` prices any
-    ``+sp`` candidates from the hybrid plan's per-leaf pairs and
+    ``+sp`` candidates from the hybrid plan's per-leaf pairs,
     ``budget_leaf_budgets`` any ``+ab`` candidates from the adaptive
-    allocation's (see :func:`predict_step_s`)."""
+    allocation's, and ``quorum_delays`` adds the per-candidate straggler
+    exposure (blocking max vs quorum Q-th order statistic — see
+    :func:`predict_step_s`)."""
     rows = []
     for c in cands:
         s = predict_step_s(
@@ -841,6 +921,7 @@ def rank_candidates(
             fabric2=fabric2,
             sparse_leaf_budgets=sparse_leaf_budgets,
             budget_leaf_budgets=budget_leaf_budgets,
+            quorum_delays=quorum_delays,
         )
         rows.append({**c, "predicted_ms_per_step": round(s * 1e3, 4)})
     rows.sort(key=lambda r: (r["predicted_ms_per_step"], r["name"]))

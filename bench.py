@@ -32,8 +32,8 @@ semantics compares: ring-vs-gather config 8, overlap-vs-blocking
 config 9, the autopilot scenario matrix config 10, the two-tier plan
 matrix config 11, the stream-encode exposure config 12, the sparse-wire
 config 13, the fabric-probe calibration config 14, the sharded-update
-memory config 15, and the adaptive-budget Pareto config 16): one JSON
-row per config
+memory config 15, the adaptive-budget Pareto config 16, and the quorum
+straggler-absorption config 17): one JSON row per config
 as it completes, then ONE final aggregate line — the headline config-2 row
 with a "configs" list embedding every row (VERDICT r2 next-round #4; the
 driver parses the last line). The parent enforces a global wall-clock
@@ -260,6 +260,24 @@ CONFIGS = {
     16: dict(metric="adaptive_budget_pareto", kind="adaptivebudget",
              batch=32, n_dev=4, ways=4, emb_rows=1024, emb_dim=16,
              zipf_slots=8, svd_rank=3, force_cpu_mesh=True),
+    # Config 17 (PR-16 quorum tentpole): quorum_straggler_absorption —
+    # bounded-staleness quorum aggregation vs blocking under ONE chaos-
+    # slowed replica (slow@S:R:SEC) on the forced 4-device CPU mesh.
+    # Measured fenced ms/step for the blocking step (which pays the
+    # straggler's host sleep every exchange, the maybe_sleep_replica
+    # discipline the shipped loop uses) vs the quorum step driven by a
+    # LIVE QuorumRig (Q=3 of 4, K=1: the slow replica's payload rides
+    # the carry one step stale, exposed wait 0) — at EQUAL wire, gated
+    # in-row (msg_bytes identical; the quorum knob changes when payloads
+    # are consumed, never how many bytes move). Then the REPLAY gate:
+    # a second run rebuilt from the recorded arrival_schedule.jsonl via
+    # --replay-arrivals semantics must land bit-identical params (the
+    # honest-convergence contract: the absorbed straggler trajectory is
+    # replayable, not a race). Semantics + schedule micro-compare like
+    # configs 8-16, not a chip-speed claim. Baseline "none".
+    17: dict(metric="quorum_straggler_absorption", kind="quorum",
+             network="lenet", batch=32, n_dev=4, ways=4, slow_ms=60,
+             force_cpu_mesh=True),
 }
 
 # Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
@@ -2207,6 +2225,188 @@ def measure_sharded_update_memory(cfg: dict) -> dict:
     return out
 
 
+def measure_quorum_absorption(cfg: dict) -> dict:
+    """Config-17: bounded-staleness quorum vs blocking under one chaos-
+    slowed replica (see CONFIGS[17] for the full row contract).
+
+    ``value`` is the quorum step's fenced ms/step with the live rig
+    consuming arrivals; ``blocking_ms_per_step`` pays the straggler's
+    host sleep every exchange. The two in-row gates:
+    ``equal_wire`` (identical msg_bytes — the quorum knob never changes
+    how many bytes move) and ``replay_bit_parity`` (a second run driven
+    by the recorded arrival schedule lands bit-identical params)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import QsgdCodec
+    from atomo_tpu.models import get_model
+    from atomo_tpu.parallel import (
+        make_distributed_train_step,
+        make_mesh,
+        replicate_state,
+        shard_batch,
+    )
+    from atomo_tpu.parallel.replicated import init_quorum_state
+    from atomo_tpu.quorum import QuorumConfig
+    from atomo_tpu.quorum.artifact import read_schedule, schedule_path
+    from atomo_tpu.quorum.rig import QuorumRig
+    from atomo_tpu.training import create_state, make_optimizer
+    from atomo_tpu.utils.chaos import ChaosConfig, ChaosInjector
+
+    fast = os.environ.get("ATOMO_BENCH_FAST") == "1"
+    dev = jax.devices()[0]
+    n_dev = min(int(cfg.get("n_dev", 4)), len(jax.devices()))
+    batch = int(cfg.get("batch", 32))
+    slow_s = float(cfg.get("slow_ms", 60)) / 1e3
+    base = dict(
+        metric=cfg["metric"], unit="ms/step", value=None,
+        byte_reduction=None, mfu=None, flops_per_step=None,
+        peak_tflops=None, platform=dev.platform, device=dev.device_kind,
+        ways=n_dev, chips_measured=n_dev,
+        timing="dispatch-loop-scalar-fenced",
+        config=dict(kind="quorum", network=cfg.get("network", "lenet"),
+                    batch=batch, n_dev=n_dev,
+                    slow_ms=float(cfg.get("slow_ms", 60)),
+                    quorum=n_dev - 1, staleness=1),
+        note=(f"bounded-staleness quorum (Q={n_dev - 1} of {n_dev}, K=1) "
+              f"vs blocking under one slow@ replica on a {n_dev}-device "
+              f"{dev.platform} mesh; equal-wire + replay-parity gates "
+              "in-row; not a chip-speed claim"),
+    )
+    if n_dev < 2:
+        base.update(measurement_valid=False,
+                    invalid_reason="single device: no exchange to quorum on")
+        return base
+
+    mesh = make_mesh(n_dev)
+    model = get_model(cfg.get("network", "lenet"), 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    r = np.random.default_rng(0)
+    images = jnp.asarray(
+        r.standard_normal((batch, 28, 28, 1)).astype(np.float32)
+    )
+    labels = jnp.asarray(r.integers(0, 10, batch).astype(np.int32))
+    codec = QsgdCodec(bits=8, bucket_size=512)
+    host0 = jax.device_get(
+        create_state(model, opt, jax.random.PRNGKey(0), images)
+    )
+    si, sl = shard_batch(mesh, images, labels)
+    key = jax.random.PRNGKey(1)
+    steps = _env_int("ATOMO_BENCH_STEPS", 3 if fast else 10)
+    # period == the straggler's lag, so its payload rides the carry ONE
+    # step stale (never dropped) and the exposed quorum wait is zero
+    qcfg = QuorumConfig(n_dev - 1, staleness=1, period_s=slow_s)
+    chaos_spec = f"slow@1:1:{slow_s}"
+
+    def fresh():
+        return replicate_state(
+            mesh, jax.tree_util.tree_map(jnp.asarray, host0)
+        )
+
+    out = dict(base, measurement_valid=True, invalid_reason=None)
+    work = tempfile.mkdtemp(prefix="bench_quorum_")
+    try:
+        # --- blocking: the exchange waits for the slowed replica -------
+        blocking = make_distributed_train_step(
+            model, opt, mesh, codec, aggregate="gather"
+        )
+        st = fresh()
+        st, m = blocking(st, key, si, sl)  # compile + warm (no sleep)
+        if not math.isfinite(float(m["loss"])):
+            raise RuntimeError("blocking warmup loss not finite")
+        block_bytes = int(m["msg_bytes"])
+        chaos = ChaosInjector(ChaosConfig.from_spec(chaos_spec))
+        t0 = time.perf_counter()
+        for s in range(1, steps + 1):
+            chaos.maybe_sleep_replica(s, n_dev)
+            st, m = blocking(st, key, si, sl)
+        float(m["loss"])  # the fence
+        t_block = (time.perf_counter() - t0) / steps
+
+        # --- quorum, live rig: the straggler rides the carry -----------
+        q_step = make_distributed_train_step(
+            model, opt, mesh, codec, aggregate="gather", quorum=qcfg
+        )
+
+        def run_quorum(train_dir, replay=None):
+            rig = QuorumRig(
+                qcfg, n_dev=n_dev, train_dir=train_dir,
+                chaos=None if replay else ChaosInjector(
+                    ChaosConfig.from_spec(chaos_spec)
+                ),
+                replay_path=replay, log_fn=lambda *_: None,
+            )
+            qst = init_quorum_state(mesh, fresh(), codec, qcfg.staleness)
+            m = None
+            t0 = time.perf_counter()
+            for s in range(1, steps + 1):
+                arr = jnp.asarray(rig.begin_step(s))
+                qst, m = q_step(qst, key, si, sl, arr)
+            float(m["loss"])  # the fence
+            dt = (time.perf_counter() - t0) / steps
+            return dt, jax.device_get(qst), m
+
+        # compile + warm the quorum program OFF the clock (throwaway
+        # state; the measured runs below start fresh)
+        _warm = init_quorum_state(mesh, fresh(), codec, qcfg.staleness)
+        _warm, wm = q_step(_warm, key, si, sl,
+                           jnp.zeros((n_dev,), jnp.int32))
+        if not math.isfinite(float(wm["loss"])):
+            raise RuntimeError("quorum warmup loss not finite")
+
+        d_live = os.path.join(work, "live")
+        t_quorum, live, qm = run_quorum(d_live)
+        out["value"] = round(t_quorum * 1e3, 3)
+        out["blocking_ms_per_step"] = round(t_block * 1e3, 3)
+        out["straggler_absorption_speedup"] = round(t_block / t_quorum, 3)
+        out["quorum_kept"] = int(qm["quorum_kept"])
+        out["stale_dropped"] = int(qm["stale_dropped"])
+        # equal wire: the quorum step ships the same payload bytes
+        out["msg_bytes"] = int(qm["msg_bytes"])
+        out["equal_wire"] = bool(int(qm["msg_bytes"]) == block_bytes)
+        if not out["equal_wire"]:
+            _mark_invalid(
+                out,
+                f"quorum step moved {int(qm['msg_bytes'])} B vs blocking "
+                f"{block_bytes} B — the equal-wire contract broke",
+            )
+        if t_quorum >= t_block:
+            _mark_invalid(
+                out,
+                "quorum step not below blocking despite the straggler "
+                "sleep (contended host)",
+            )
+
+        # --- replay gate: rebuild the run from the recorded schedule ---
+        _, arr_live = read_schedule(schedule_path(d_live))
+        out["schedule_steps_recorded"] = len(arr_live)
+        d_rep = os.path.join(work, "replay")
+        _, replayed, _ = run_quorum(d_rep, replay=schedule_path(d_live))
+        out["replay_bit_parity"] = bool(all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(live.train.params),
+                jax.tree_util.tree_leaves(replayed.train.params),
+            )
+        ))
+        if not out["replay_bit_parity"]:
+            _mark_invalid(
+                out,
+                "replayed arrival schedule did NOT reproduce the live "
+                "params bit-for-bit (the PR-16 replay contract)",
+            )
+    except Exception as exc:  # noqa: BLE001 — a failed drill is a failed row
+        _mark_invalid(out, f"quorum drill failed: {str(exc)[:200]}")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return out
+
+
 def measure_scenarios(cfg: dict) -> dict:
     """Config-10: the scenario matrix (autopilot regression gate).
 
@@ -2739,6 +2939,8 @@ def measure_ours(cfg: dict) -> dict:
         return measure_adaptive_budget(cfg)
     if cfg.get("kind") == "shardedupd":
         return measure_sharded_update_memory(cfg)
+    if cfg.get("kind") == "quorum":
+        return measure_quorum_absorption(cfg)
 
     model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Bench smoke (~8 min): prove the bench entrypoint still emits parseable
-# evidence without burning the full-ladder window. Fourteen checks:
+# evidence without burning the full-ladder window. Fifteen checks:
 #
 #   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
 #      the driver's last-line JSON contract, PLUS the partial-artifact
@@ -93,6 +93,15 @@
 #      Pareto gate, and the bit-exact resume-from-allocation drill —
 #      the PR-15 adaptive variance-budget codecs.
 #
+#  15. the quorum contract (<60 s, forced 4-device CPU mesh): bench
+#      config 17 runs bounded-staleness quorum aggregation (Q=3 of 4,
+#      K=1) vs blocking under one chaos-slowed replica and must exit 0
+#      with the equal-wire gate TRUE (identical msg_bytes — the knob
+#      changes when payloads are consumed, never how many bytes move),
+#      the recorded arrival schedule replayed to bit-identical params,
+#      zero staleness drops, and a measured absorption speedup > 1 —
+#      the PR-16 quorum aggregation.
+#
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
 cd "$(dirname "$0")/.." || exit 2
@@ -128,7 +137,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/14]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/15]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -157,7 +166,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/14]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/15]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -194,7 +203,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/14]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/15]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -225,7 +234,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/14]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/15]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -252,7 +261,7 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/14]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/15]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
 EOF
 [ $? -ne 0 ] && exit 1
@@ -285,7 +294,7 @@ for r in probed:
     assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
     assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
 assert doc.get("why"), doc
-print(f"bench_smoke OK[6/14]: --auto tune picked {win['name']} "
+print(f"bench_smoke OK[6/15]: --auto tune picked {win['name']} "
       f"({win.get('measured_ms_per_step')} ms/step measured, "
       f"{len(probed)}/{len(doc['rows'])} candidates probed); "
       "decision artifact parses")
@@ -329,7 +338,7 @@ for p in plans:
     assert isinstance(p.get("predicted_ms_per_step"), (int, float)), p
 td = row.get("tune_decision") or {}
 assert td.get("hierarchical_probed"), row
-print(f"bench_smoke OK[7/14]: two-tier plans "
+print(f"bench_smoke OK[7/15]: two-tier plans "
       f"{[p['plan'] for p in plans]} measured with per-tier "
       "predicted-vs-measured bytes matching, per-plan bit_parity=True; "
       f"mini-tune probed {td['hierarchical_probed']} "
@@ -377,7 +386,7 @@ sys.path.insert(0, ".")
 from atomo_tpu.training.checkpoint import latest_valid_step
 
 assert latest_valid_step(d) == 8, latest_valid_step(d)
-print("bench_smoke OK[8/14]: die@3:1 shrank 4 -> 3 at a checkpoint "
+print("bench_smoke OK[8/15]: die@3:1 shrank 4 -> 3 at a checkpoint "
       "boundary (planned reshape, restart budget untouched), finished at "
       f"step {latest_valid_step(d)} with membership epochs "
       f"{[w[0] for w in worlds]} recorded")
@@ -413,7 +422,7 @@ for k in ("compute_ms", "encode_monolithic_ms", "encode_streamed_ms",
           "encode_hidden_stream_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 assert int(ph.get("n_buckets", 0)) > 1, row
-print(f"bench_smoke OK[9/14]: stream {row['value']} vs off "
+print(f"bench_smoke OK[9/15]: stream {row['value']} vs off "
       f"{row['off_ms_per_step']} ms/step; exposed encode "
       f"{ph['encode_exposed_stream_ms']} (stream, {ph['n_buckets']} "
       f"buckets) vs {ph['encode_exposed_off_ms']} (off) ms; "
@@ -462,7 +471,7 @@ assert doc["consistent"] is True, doc["checks"]
 ran = [c["name"] for c in doc["checks"] if not c["skipped"]]
 segs = [e for e in doc["timeline"] if e["kind"] == "metrics"]
 assert segs and segs[0]["first_step"] == 1 and segs[-1]["last_step"] == 6
-print("bench_smoke OK[10/14]: recorder+quality run left "
+print("bench_smoke OK[10/15]: recorder+quality run left "
       f"{len(steps)} step records ({len(steps[0]['q_rel'])}-layer "
       "quality columns), report verb joined a consistent timeline "
       f"(checks ran: {ran})")
@@ -502,7 +511,7 @@ for l in layers:
     assert 0.0 <= l["density"] <= 1.0, l
     if l["assignment"] == "sparse":
         assert l["payload_bytes"] < l["dense_bytes"], l
-print(f"bench_smoke OK[11/14]: hybrid {row['hybrid_wire_bytes']} B vs "
+print(f"bench_smoke OK[11/15]: hybrid {row['hybrid_wire_bytes']} B vs "
       f"all-dense {row['alldense_wire_bytes']} B on the wire "
       f"({row['wire_reduction']}x reduction, "
       f"{len(plan['sparse_leaves'])}/{plan['n_leaves']} leaves sparse); "
@@ -546,7 +555,7 @@ assert set(ratios) == {"ici", "dcn"} and all(
 # even on a contended host
 assert row["fabric_parity"] is True, row
 assert row["run_artifact_complete"] is True, row
-print(f"bench_smoke OK[12/14]: probed ici {tiers['ici']['bandwidth_gbps']} "
+print(f"bench_smoke OK[12/15]: probed ici {tiers['ici']['bandwidth_gbps']} "
       f"/ dcn {tiers['dcn']['bandwidth_gbps']} GB/s/chip "
       f"({tiers['ici']['latency_us']} / {tiers['dcn']['latency_us']} "
       "us/hop); measured-vs-preset ratios recorded; measured-priced vs "
@@ -587,7 +596,7 @@ assert shd < z1 < rep, (rep, z1, shd)
 assert row["state_bytes_reduction"] > 1.5, row
 for part in ("replicated", "zero1", "sharded_update"):
     assert row[f"{part}_ms_per_step"] > 0, row
-print(f"bench_smoke OK[13/14]: per-chip state {rep} -> {z1} (zero1) -> "
+print(f"bench_smoke OK[13/15]: per-chip state {rep} -> {z1} (zero1) -> "
       f"{shd} B (sharded-update, {row['state_bytes_reduction']}x); "
       f"ms/step {row['replicated_ms_per_step']} / "
       f"{row['zero1_ms_per_step']} / {row['sharded_update_ms_per_step']}; "
@@ -627,7 +636,7 @@ assert row["measured_variance_reduction"] > 0, row
 assert row["pareto_loss_ok"] is True, row
 # gate 4: bit-exact resume from the recorded allocation artifact
 assert row["resume_bit_exact"] is True, row
-print(f"bench_smoke OK[14/14]: variance alloc {alloc['variance_ks']} vs "
+print(f"bench_smoke OK[14/15]: variance alloc {alloc['variance_ks']} vs "
       f"uniform {alloc['uniform_ks']} at "
       f"{row['variance_row']['wire_bytes']} <= "
       f"{row['uniform_row']['wire_bytes']} B wire; measured q_err2 "
@@ -638,4 +647,45 @@ print(f"bench_smoke OK[14/14]: variance alloc {alloc['variance_ks']} vs "
 EOF14
 [ $? -ne 0 ] && exit 1
 
-echo "bench_smoke: all 14 checks passed"
+# --- 15: config 17, quorum straggler-absorption contract -----------------
+out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=5 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+      ATOMO_COMPILE_CACHE="$art/xla" \
+      ATOMO_BENCH_ARTIFACT="$art/c17.json" \
+      python bench.py --config 17 --no-baseline 2>/dev/null)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: config 17 exited rc=$rc (timeout or crash)"
+  exit 1
+fi
+printf '%s\n' "$out" > "$art/c17.out"
+python - "$art/c17.out" <<'EOF15'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+assert lines, "bench_smoke FAIL: config 17 emitted no JSON"
+row = json.loads(lines[-1])
+assert row["metric"] == "quorum_straggler_absorption", row
+assert row["measurement_valid"], row.get("invalid_reason")
+# the equal-wire gate: the quorum knob changes WHEN payloads are
+# consumed, never how many bytes move
+assert row["equal_wire"] is True, row
+# the replay gate is semantics, not timing: a run rebuilt from the
+# recorded arrival schedule must land bit-identical params even on a
+# contended host
+assert row["replay_bit_parity"] is True, row
+assert row["schedule_steps_recorded"] > 0, row
+# the absorption itself: blocking pays the slow replica's sleep every
+# exchange, the quorum step does not (measurement_valid above already
+# gates quorum < blocking)
+assert row["straggler_absorption_speedup"] > 1, row
+assert row["stale_dropped"] == 0, row
+print(f"bench_smoke OK[15/15]: quorum {row['value']} vs blocking "
+      f"{row['blocking_ms_per_step']} ms/step under one slow@ replica "
+      f"({row['straggler_absorption_speedup']}x absorbed) at equal wire "
+      f"({row['msg_bytes']} B); {row['schedule_steps_recorded']}-step "
+      "arrival schedule replayed bit-exact")
+EOF15
+[ $? -ne 0 ] && exit 1
+
+echo "bench_smoke: all 15 checks passed"

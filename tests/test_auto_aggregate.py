@@ -196,6 +196,8 @@ def test_psum_mode_reports_dense_wire_bytes():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow  # compiles a full LM step to observe a warning (~12 s on
+# 1 core) — full-suite only
 def test_lm_flooring_rank_warns(capsys):
     """VERDICT r4 weak #8: the measured flooring configuration (rank 3 at
     width 64, artifacts/LM_CONVERGENCE.md) can no longer run silently."""
@@ -214,6 +216,8 @@ def test_lm_flooring_rank_warns(capsys):
     assert "floor" in text and "--svd-rank 3" in text
 
 
+@pytest.mark.slow  # two LM-width compiles (~8 s on 1 core) — full-suite
+# only
 def test_lm_rank_auto_scales_with_width(capsys):
     """--svd-rank 0 (the default) resolves to the width-scaled rank and
     prints the policy line: width 64 -> the verified rank 6."""

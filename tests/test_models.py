@@ -31,10 +31,10 @@ def test_mnist_models(name):
 @pytest.mark.parametrize(
     "name",
     [
-        "resnet18",
+        pytest.param("resnet18", marks=pytest.mark.slow),
         "vgg11",
         # the deep ones compile for 10-70s each on 1 CPU core — full-suite
-        # only; resnet18/vgg11 keep CIFAR-net coverage in the smoke set
+        # only; vgg11 keeps CIFAR-net coverage in the smoke set
         pytest.param("resnet50", marks=pytest.mark.slow),
         pytest.param("resnet110", marks=pytest.mark.slow),
         pytest.param("densenet100", marks=pytest.mark.slow),

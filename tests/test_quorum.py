@@ -1,0 +1,778 @@
+"""Bounded-staleness quorum aggregation (PR-16, ``--quorum``).
+
+Contracts being pinned (quorum/{schedule,artifact,rig}, the
+``quorum=`` step in parallel/replicated, chaos ``slow@S:R:SEC``,
+comm_model's ``+qK`` pricing, report's quorum_schedule_consistent):
+
+  * ``quorum=None`` is byte-identical lowered HLO — the knob-off
+    contract every optional subsystem carries.
+  * A schedule where everything arrives on time (sigma all zero) is
+    bit-identical to the BLOCKING step's survivor-exact guarded mean,
+    per codec family (qsgd and svd), gather AND ring: the quorum mean
+    is the same pinned roster-order fold with ONE division.
+  * The surviving mean is rescaled by THE unbiased n/kept operator the
+    elastic family uses: a quorum step with one replica masked out is
+    bit-identical to the guarded blocking step whose guard masks the
+    same replica (survivor_decode_mean parity at trajectory level).
+  * Staleness is hard-bounded IN-GRAPH: a corrupted schedule asking for
+    sigma > K contributes exactly nothing (bit-identical to an honest
+    DROPPED entry), and the host rig records one staleness_exceeded
+    incident per drop — never a silent stale apply.
+  * The arrival schedule records to train_dir/arrival_schedule.jsonl
+    and ``--replay-arrivals`` replays it bit-exact, wait-free — and
+    kill->restart->resume re-records the identical schedule and lands
+    on the uninterrupted trajectory.
+  * chaos ``slow@S:R:SEC`` parses, derives a pure per-step delay
+    vector, sleeps the blocking baseline, and is epoch-keyed like die@.
+  * The conflict matrix rejects quorum x {delayed overlap, hybrid rows,
+    sharded-update/zero1, error feedback, elastic, num_aggregate,
+    superstep>1, stream_encode, track_quality} with reasons — builder,
+    loop, AND argv preflight; decision_reusable refuses a resume whose
+    (Q, K) mismatches the recorded winner's.
+  * The autopilot's +qK candidates exist only for plain blocking
+    gather/ring, are priced by the Q-th-order-statistic exposed wait,
+    and are never probed (the probe harness is straggler-free).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from atomo_tpu.codecs import QsgdCodec, SvdCodec
+from atomo_tpu.data import BatchIterator, SPECS, synthetic_dataset
+from atomo_tpu.models import get_model
+from atomo_tpu.parallel import (
+    distributed_train_loop,
+    make_distributed_train_step,
+    make_mesh,
+    replicate_state,
+    shard_batch,
+)
+from atomo_tpu.parallel.replicated import init_quorum_state
+from atomo_tpu.quorum import QuorumConfig
+from atomo_tpu.quorum.artifact import (
+    append_record,
+    read_schedule,
+    schedule_path,
+)
+from atomo_tpu.quorum.rig import QuorumRig
+from atomo_tpu.quorum.schedule import (
+    ABSENT,
+    DROPPED,
+    lateness_steps,
+    staleness_vector,
+)
+from atomo_tpu.training import (
+    GuardConfig,
+    create_state,
+    make_optimizer,
+    snapshot_state,
+)
+from atomo_tpu.utils.chaos import ChaosConfig, ChaosInjector
+from atomo_tpu.utils.tracing import IncidentLog
+
+N_DEV = 4
+BATCH = 16
+
+QSGD = QsgdCodec(bits=4, bucket_size=128)
+
+
+def _eq(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b))
+    )
+
+
+def _setup(momentum=0.9):
+    mesh = make_mesh(N_DEV)
+    model = get_model("lenet", 10)
+    opt = make_optimizer("sgd", lr=0.01, momentum=momentum)
+    r = np.random.default_rng(0)
+    batches = [
+        (r.standard_normal((BATCH, 28, 28, 1)).astype(np.float32),
+         r.integers(0, 10, BATCH).astype(np.int32))
+        for _ in range(4)
+    ]
+    host0 = snapshot_state(
+        create_state(model, opt, jax.random.PRNGKey(0),
+                     jnp.asarray(batches[0][0]))
+    )
+    return mesh, model, opt, host0, batches
+
+
+def _fresh(mesh, host0):
+    return replicate_state(mesh, jax.tree_util.tree_map(jnp.asarray, host0))
+
+
+def _drive_quorum(step, mesh, host0, batches, codec, staleness, arrivals):
+    """Run the quorum step over ``batches``; ``arrivals`` is one vector
+    reused every step or a per-step list of vectors."""
+    qst = init_quorum_state(mesh, _fresh(mesh, host0), codec, staleness)
+    key = jax.random.PRNGKey(1)
+    per_step = (
+        arrivals if isinstance(arrivals, list) else [arrivals] * len(batches)
+    )
+    m = None
+    for (im, lb), arr in zip(batches, per_step):
+        si, sl = shard_batch(mesh, im, lb)
+        qst, m = step(qst, key, si, sl,
+                      jnp.asarray(np.asarray(arr, np.int32)))
+    return jax.device_get(qst), jax.device_get(m)
+
+
+def _drive_blocking(step, mesh, host0, batches):
+    st = _fresh(mesh, host0)
+    key = jax.random.PRNGKey(1)
+    m = None
+    for im, lb in batches:
+        si, sl = shard_batch(mesh, im, lb)
+        st, m = step(st, key, si, sl)
+    return jax.device_get(st), jax.device_get(m)
+
+
+def _make_iter():
+    return BatchIterator(
+        synthetic_dataset(SPECS["mnist"], True, size=64), BATCH, seed=0
+    )
+
+
+# --------------------------------------------------- 1. knob-off identity
+
+
+def test_quorum_off_is_byte_identical_hlo():
+    mesh, model, opt, host0, batches = _setup()
+    key = jax.random.PRNGKey(1)
+    si, sl = shard_batch(mesh, *batches[0])
+    st = _fresh(mesh, host0)
+    s_def = make_distributed_train_step(model, opt, mesh, QSGD,
+                                        aggregate="gather")
+    s_off = make_distributed_train_step(model, opt, mesh, QSGD,
+                                        aggregate="gather", quorum=None)
+    a = s_def.lower(st, key, si, sl).as_text()
+    b = s_off.lower(st, key, si, sl).as_text()
+    assert a == b  # the knob-off contract, byte for byte
+
+
+# ------------------------------------- 2. all-arrived degeneracy parity
+
+
+@pytest.mark.parametrize("agg", ["gather", "ring"])
+@pytest.mark.parametrize(
+    "codec",
+    [
+        QsgdCodec(bits=4, bucket_size=128),
+        # ~29 s of SVD compiles on 1 core — full-suite only; qsgd keeps the
+        # degeneracy parity in the smoke set for both aggregates
+        pytest.param(SvdCodec(rank=2), marks=pytest.mark.slow),
+    ],
+    ids=["qsgd", "svd"],
+)
+def test_all_arrived_bit_identical_to_blocking(agg, codec):
+    """sigma all zero = every payload fresh: the quorum mean degenerates
+    to the guarded blocking step's survivor-exact mean (the same
+    survivor_decode_mean fold, kept = n), bit for bit — gather and
+    ring, sign-family and factor-family codecs."""
+    mesh, model, opt, host0, batches = _setup()
+    blocking = make_distributed_train_step(
+        model, opt, mesh, codec, aggregate=agg,
+        guard=GuardConfig(), survivor_exact=True,
+    )
+    q_step = make_distributed_train_step(
+        model, opt, mesh, codec, aggregate=agg, guard=GuardConfig(),
+        quorum=QuorumConfig(N_DEV, staleness=1),
+    )
+    a, ma = _drive_blocking(blocking, mesh, host0, batches)
+    b, mb = _drive_quorum(q_step, mesh, host0, batches, codec, 1,
+                          np.zeros(N_DEV, np.int32))
+    assert _eq(a.params, b.train.params)
+    assert _eq(a.opt_state, b.train.opt_state)
+    assert float(mb["quorum_kept"]) == N_DEV
+    assert float(mb["stale_dropped"]) == 0.0
+    # equal wire: the quorum step ships the same payload bytes
+    assert float(mb["msg_bytes"]) == float(ma["msg_bytes"])
+
+
+# ------------------------------------ 3. unbiased-rescale operator parity
+
+
+def test_masked_quorum_matches_guarded_survivor_rescale():
+    """One replica masked out of the quorum mean (DROPPED) must follow
+    the exact unbiased n/kept path the guard's skip-and-rescale uses:
+    bit-identical params/opt trajectory to the guarded blocking step
+    whose die@ chaos poisons the SAME replica every step. (BN stats and
+    loss describe different masks — the guard excludes the poisoned
+    forward's stats, quorum keeps the healthy forward — so only the
+    update path is compared.)"""
+    mesh, model, opt, host0, batches = _setup()
+    chaos = ChaosInjector(ChaosConfig.from_spec("die@1:3"))
+    blocking = make_distributed_train_step(
+        model, opt, mesh, QSGD, aggregate="gather",
+        guard=GuardConfig(), survivor_exact=True, chaos=chaos,
+    )
+    q_step = make_distributed_train_step(
+        model, opt, mesh, QSGD, aggregate="gather",
+        quorum=QuorumConfig(3, staleness=1),
+    )
+    a, ma = _drive_blocking(blocking, mesh, host0, batches)
+    b, mb = _drive_quorum(q_step, mesh, host0, batches, QSGD, 1,
+                          np.asarray([0, 0, 0, DROPPED], np.int32))
+    assert _eq(a.params, b.train.params)
+    assert _eq(a.opt_state, b.train.opt_state)
+    assert float(ma["dropped"]) == 1.0 == float(mb["dropped"])
+    assert float(mb["quorum_kept"]) == 3.0
+    assert float(mb["stale_dropped"]) == 1.0
+
+
+# ------------------------------------------- 4. in-graph staleness bound
+
+
+def test_staleness_bound_is_enforced_in_graph():
+    """A corrupted schedule asking for sigma > K selects NOTHING: the
+    trajectory is bit-identical to the honest DROPPED encoding — the
+    bound does not rest on the host rig being well-behaved."""
+    mesh, model, opt, host0, batches = _setup()
+    q_step = make_distributed_train_step(
+        model, opt, mesh, QSGD, aggregate="gather",
+        quorum=QuorumConfig(3, staleness=1),
+    )
+    honest, mh = _drive_quorum(q_step, mesh, host0, batches, QSGD, 1,
+                               np.asarray([0, 0, 0, DROPPED], np.int32))
+    corrupt, mc = _drive_quorum(q_step, mesh, host0, batches, QSGD, 1,
+                                np.asarray([0, 0, 0, 7], np.int32))
+    assert _eq(honest.train.params, corrupt.train.params)
+    assert _eq(honest.train.opt_state, corrupt.train.opt_state)
+    assert float(mc["quorum_kept"]) == 3.0
+    # the metrics column counts SCHEDULE drops (the incident stream's
+    # reconciliation anchor); the in-graph mask still dropped sigma=7
+    assert float(mh["stale_dropped"]) == 1.0
+    assert float(mc["stale_dropped"]) == 0.0
+
+
+def test_rig_drops_past_bound_and_writes_incidents(tmp_path):
+    """Loop-level staleness-exceeded drill: a straggler whose lag
+    exceeds K is dropped every consuming step, each drop lands ONE
+    staleness_exceeded incident, and the report's
+    quorum_schedule_consistent check reconciles the two streams."""
+    mesh, model, opt, _, _ = _setup()
+    d = str(tmp_path / "run")
+    chaos = ChaosInjector(ChaosConfig.from_spec("slow@1:1:0.25"))
+    distributed_train_loop(
+        model, opt, mesh, _make_iter(), codec=QSGD, aggregate="gather",
+        max_steps=5, log_every=0, eval_freq=0, seed=0, train_dir=d,
+        save_freq=0, chaos=chaos,
+        quorum=QuorumConfig(3, staleness=1, period_s=0.1),
+    )
+    meta, arrivals = read_schedule(schedule_path(d))
+    assert meta["quorum"] == 3 and meta["staleness"] == 1
+    assert meta["n_replicas"] == N_DEV
+    # lag = ceil(0.25/0.1) = 3 steps: warm-up ABSENT through step 3,
+    # then the pipeline fills at staleness 3 > K=1 -> DROPPED onward
+    assert [arrivals[s]["staleness"][1] for s in range(1, 6)] == [
+        ABSENT, ABSENT, ABSENT, DROPPED, DROPPED,
+    ]
+    incs = [
+        r for r in IncidentLog.read(os.path.join(d, "incidents.jsonl"))
+        if r.get("cause") == "staleness_exceeded"
+    ]
+    assert [(r["step"], r["target"]) for r in incs] == [(4, 1), (5, 1)]
+    assert all(
+        r["action"] == "drop" and r["bound"] == 1
+        and r["available_staleness"] == 3
+        for r in incs
+    )
+    from atomo_tpu.obs.report import build_report
+
+    doc = build_report(d)
+    checks = {c["name"]: c for c in doc["checks"]}
+    assert checks["quorum_schedule_consistent"]["ok"] is True
+    assert not checks["quorum_schedule_consistent"]["skipped"]
+    assert doc["sources"]["arrival_schedule_jsonl"] == 5
+    # silence one drop's incident -> the check catches it, --strict rc=3
+    inc_path = os.path.join(d, "incidents.jsonl")
+    recs = [
+        r for r in IncidentLog.read(inc_path)
+        if not (r.get("cause") == "staleness_exceeded" and r["step"] == 5)
+    ]
+    with open(inc_path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    doc2 = build_report(d)
+    checks2 = {c["name"]: c for c in doc2["checks"]}
+    assert checks2["quorum_schedule_consistent"]["ok"] is False
+    assert "announced" in checks2["quorum_schedule_consistent"]["detail"]
+    from atomo_tpu.cli import main
+
+    assert main(["report", "--train-dir", d]) == 0
+    assert main(["report", "--train-dir", d, "--strict"]) == 3
+
+
+def test_report_skips_without_schedule(tmp_path):
+    from atomo_tpu.obs.report import build_report
+
+    d = tmp_path / "empty"
+    d.mkdir()
+    (d / "metrics.jsonl").write_text("")
+    doc = build_report(str(d))
+    checks = {c["name"]: c for c in doc["checks"]}
+    assert checks["quorum_schedule_consistent"]["skipped"] is True
+
+
+# --------------------------------------- 5. record / replay bit-exactness
+
+
+def test_schedule_record_replay_and_resume_bit_exact(tmp_path):
+    """The replay anchor: a live run under slow@ chaos records its
+    arrival schedule; (a) --replay-arrivals re-runs it bit-exact with
+    NO chaos armed (wait-free — the vectors are the trajectory), and
+    (b) kill->restart->resume re-records the identical schedule and
+    lands on the uninterrupted run's params."""
+    mesh, model, opt, _, _ = _setup()
+    qcfg = QuorumConfig(3, staleness=1, period_s=0.1)
+    chaos = ChaosConfig.from_spec("slow@2:1:0.02")
+
+    def run(d, *, max_steps, chaos_on=True, resume=False, replay=None,
+            save_freq=0):
+        return distributed_train_loop(
+            model, opt, mesh, _make_iter(), codec=QSGD,
+            aggregate="gather", max_steps=max_steps, log_every=0,
+            eval_freq=0, seed=0, train_dir=d, save_freq=save_freq,
+            resume=resume,
+            chaos=ChaosInjector(chaos) if chaos_on else None,
+            quorum=qcfg, quorum_replay=replay,
+        )
+
+    d_live = str(tmp_path / "live")
+    live = run(d_live, max_steps=4)
+    meta, arr_live = read_schedule(schedule_path(d_live))
+    assert meta["what"] == "quorum_config" and sorted(arr_live) == [1, 2, 3, 4]
+    # the slow replica's payload rides the carry at staleness 1
+    assert arr_live[3]["staleness"] == [0, 1, 0, 0]
+    assert arr_live[3]["kept"] == 4 and arr_live[3]["dropped"] == 0
+
+    # (a) replay into a fresh dir: bit-exact, chaos-free, re-recorded
+    d_rep = str(tmp_path / "replay")
+    rep = run(d_rep, max_steps=4, chaos_on=False,
+              replay=schedule_path(d_live))
+    assert _eq(jax.device_get(live.params), jax.device_get(rep.params))
+    _, arr_rep = read_schedule(schedule_path(d_rep))
+    assert arr_rep == arr_live  # the replayed dir is as complete
+
+    # (b) kill at step 2 (checkpointed), restart with --resume
+    d_kr = str(tmp_path / "killres")
+    run(d_kr, max_steps=2, save_freq=2)
+    resumed = run(d_kr, max_steps=4, resume=True, save_freq=2)
+    assert _eq(jax.device_get(live.params), jax.device_get(resumed.params))
+    _, arr_kr = read_schedule(schedule_path(d_kr))
+    assert arr_kr == arr_live
+
+
+def test_rig_refuses_mismatched_schedule_meta(tmp_path):
+    d = str(tmp_path)
+    p = schedule_path(d)
+    append_record(p, {
+        "kind": "meta", "what": "quorum_config", "quorum": 3,
+        "staleness": 2, "n_replicas": 4, "period_s": 0.1,
+    })
+    append_record(p, {
+        "kind": "arrival", "step": 1, "staleness": [0, 0, 0, 0],
+        "kept": 4, "dropped": 0, "exposed_wait_ms": 0.0,
+    })
+    with pytest.raises(ValueError, match="refusing to mix schedules"):
+        QuorumRig(QuorumConfig(3, staleness=1, period_s=0.1),
+                  n_dev=4, train_dir=d)
+    with pytest.raises(ValueError, match="refusing to mix schedules"):
+        QuorumRig(QuorumConfig(2, staleness=2, period_s=0.1),
+                  n_dev=4, replay_path=p)
+    # matching knobs replay fine, and a missing step is refused loudly
+    rig = QuorumRig(QuorumConfig(3, staleness=2, period_s=0.1),
+                    n_dev=4, replay_path=p)
+    assert rig.begin_step(1).tolist() == [0, 0, 0, 0]
+    with pytest.raises(ValueError, match="no step 2"):
+        rig.begin_step(2)
+
+
+def test_schedule_is_pure_and_prices_the_qth_order_wait():
+    assert lateness_steps(0.25, 0.1) == 3
+    assert lateness_steps(0.01, 0.1) == 1  # never rounds down to on-time
+    faults = ((1, 1, 0.3), (1, 2, 0.5))
+    # K large enough: both stragglers' payloads ride the carry
+    sigma, exposed, drops = staleness_vector(
+        20, n_dev=4, quorum=2, staleness=5, faults=faults, period_s=0.1
+    )
+    assert sigma == [0, 3, 5, 0] and exposed == 0.0 and drops == []
+    # K=1: both drop; the quorum floor then promotes the NEAREST
+    # straggler and the exposed wait is the Q-th order statistic
+    sigma, exposed, drops = staleness_vector(
+        20, n_dev=4, quorum=3, staleness=1, faults=faults, period_s=0.1
+    )
+    assert sigma == [0, 0, DROPPED, 0]
+    assert exposed == 0.3 and drops == [(2, 5)]
+    # same call twice -> identical (pure function of (faults, step))
+    again = staleness_vector(
+        20, n_dev=4, quorum=3, staleness=1, faults=faults, period_s=0.1
+    )
+    assert again == ([0, 0, DROPPED, 0], 0.3, [(2, 5)])
+
+
+# ----------------------------------------------- 6. chaos slow@S:R:SEC
+
+
+def test_chaos_slow_replica_grammar_and_delays():
+    cfg = ChaosConfig.from_spec("slow@3:1:0.5,slow@5:0.2")
+    assert cfg.slow_replica_faults == ((3, 1, 0.5),)
+    assert cfg.slow_steps == ((5, 0.2),)  # two-arg slow@ is untouched
+    inj = ChaosInjector(cfg, membership_epoch=0)
+    assert inj.replica_delays(2, 4) == [0.0, 0.0, 0.0, 0.0]
+    assert inj.replica_delays(3, 4) == [0.0, 0.5, 0.0, 0.0]
+    assert inj.replica_delays(9, 4) == [0.0, 0.5, 0.0, 0.0]  # persistent
+    # epoch-keyed like die@: a reshaped world's member comes back healthy
+    assert ChaosInjector(cfg, membership_epoch=1).replica_delays(9, 4) == [
+        0.0, 0.0, 0.0, 0.0,
+    ]
+    # generation-IGNORING: a slow host stays slow across doctor rollbacks
+    assert inj.with_generation(2).replica_delays(9, 4)[1] == 0.5
+    with pytest.raises(ValueError, match=">= 0"):
+        ChaosConfig.from_spec("slow@3:-1:0.5")
+    with pytest.raises(ValueError, match="> 0 s"):
+        ChaosConfig.from_spec("slow@3:1:0")
+    with pytest.raises(ValueError, match="two"):
+        ChaosConfig.from_spec("die@3:1:0.5")
+
+
+def test_chaos_slow_blocking_sleep_is_the_max_lag(monkeypatch):
+    import atomo_tpu.utils.chaos as chaos_mod
+
+    slept = []
+    monkeypatch.setattr(chaos_mod.time, "sleep", slept.append)
+    inj = ChaosInjector(
+        ChaosConfig.from_spec("slow@2:1:0.3,slow@2:3:0.1"),
+        membership_epoch=0,
+    )
+    assert inj.maybe_sleep_replica(1, 4) == 0.0
+    assert inj.maybe_sleep_replica(2, 4) == 0.3  # max, not sum: lockstep
+    assert slept == [0.3]
+
+
+def test_cli_preflight_validates_slow_replica_spec():
+    from atomo_tpu.cli import _argv_preflight, build_parser
+
+    parser = build_parser()
+    sub = next(
+        a for a in parser._actions if hasattr(a, "choices") and a.choices
+    )
+    train = sub.choices["train"]
+
+    def preflight(*argv):
+        _argv_preflight(train.parse_args(
+            ["--synthetic", "--train-dir", "/tmp/unused", *argv]
+        ))
+
+    # die@-style range validation: a typo'd replica index would straggle
+    # NOTHING and the drill would prove nothing
+    with pytest.raises(SystemExit) as ei:
+        preflight("--chaos", "slow@2:7:0.5", "--n-devices", "4")
+    assert "slow@S:R:SEC" in str(ei.value) and "[7]" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--chaos", "slow@2:0:0.5", "--n-devices", "1")
+    assert "multi-device" in str(ei.value)
+    # in-range on an explicit mesh, and --n-devices 0 defers to the
+    # in-run resolved-count check
+    preflight("--chaos", "slow@2:3:0.5", "--n-devices", "4")
+    preflight("--chaos", "slow@2:7:0.5", "--n-devices", "0")
+
+
+# ------------------------------------------------- 7. conflict matrices
+
+
+def test_builder_conflict_matrix():
+    mesh, model, opt, _, _ = _setup()
+    q = QuorumConfig(3, staleness=1)
+    mk = lambda **kw: make_distributed_train_step(
+        model, opt, mesh, kw.pop("codec", QSGD),
+        aggregate=kw.pop("aggregate", "gather"), quorum=q, **kw
+    )
+    with pytest.raises(ValueError, match="compressing codec"):
+        mk(codec=None)
+    with pytest.raises(ValueError, match="compressing codec"):
+        mk(aggregate="psum")
+    with pytest.raises(ValueError, match="out of range"):
+        make_distributed_train_step(model, opt, mesh, QSGD,
+                                    aggregate="gather",
+                                    quorum=QuorumConfig(5))
+    with pytest.raises(ValueError, match="delayed"):
+        mk(overlap="delayed")
+    with pytest.raises(ValueError, match="error_feedback"):
+        mk(error_feedback=True)
+    with pytest.raises(ValueError, match="elastic membership"):
+        mk(survivor_exact=True)
+    with pytest.raises(ValueError, match="num_aggregate"):
+        mk(num_aggregate=2)
+    with pytest.raises(ValueError, match="superstep=1"):
+        mk(superstep=2)
+    with pytest.raises(ValueError, match="stream_encode"):
+        mk(stream_encode=True)
+    with pytest.raises(ValueError, match="track_quality"):
+        mk(track_quality=True)
+
+
+def test_loop_conflict_matrix():
+    mesh, model, opt, _, _ = _setup()
+    q = QuorumConfig(3, staleness=1)
+    run = lambda **kw: distributed_train_loop(
+        model, opt, mesh, _make_iter(), codec=kw.pop("codec", QSGD),
+        aggregate=kw.pop("aggregate", "gather"), max_steps=1,
+        log_every=0, eval_freq=0, quorum=kw.pop("quorum", q), **kw
+    )
+    with pytest.raises(ValueError, match="compressing codec"):
+        run(codec=None, aggregate="psum")
+    with pytest.raises(ValueError, match="delayed"):
+        run(overlap="delayed")
+    with pytest.raises(ValueError, match="sparse"):
+        run(hybrid=object())
+    with pytest.raises(ValueError, match="elastic"):
+        from atomo_tpu.elastic import ElasticConfig
+
+        run(elastic=ElasticConfig())
+    with pytest.raises(ValueError, match="error-feedback"):
+        run(error_feedback=True)
+    with pytest.raises(ValueError, match="superstep"):
+        run(superstep=2)
+    with pytest.raises(ValueError, match="needs --quorum"):
+        run(quorum=None, quorum_replay="/tmp/nope.jsonl")
+
+
+def test_cli_preflight_quorum_matrix():
+    from atomo_tpu.cli import _argv_preflight, build_parser
+
+    parser = build_parser()
+    sub = next(
+        a for a in parser._actions if hasattr(a, "choices") and a.choices
+    )
+    train = sub.choices["train"]
+
+    def preflight(*argv):
+        _argv_preflight(train.parse_args(
+            ["--synthetic", "--train-dir", "/tmp/unused", "--code",
+             "qsgd", "--n-devices", "4", *argv]
+        ))
+
+    with pytest.raises(SystemExit, match="malformed|integer"):
+        preflight("--quorum", "three")
+    with pytest.raises(SystemExit) as ei:
+        preflight("--quorum", "3", "--staleness", "0")
+    assert "blocking" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--quorum", "3", "--code", "sgd")
+    assert "compressing" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--quorum", "3", "--overlap", "delayed")
+    assert "delayed" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--quorum", "3", "--aggregate", "hierarchical")
+    assert "hierarchical" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--quorum", "3", "--elastic")
+    assert "elastic" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--quorum", "3", "--zero1")
+    assert "zero1" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--quorum", "3", "--superstep", "4")
+    assert "superstep" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--quorum", "3", "--error-feedback")
+    assert "error-feedback" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--replay-arrivals", "/tmp/whatever.jsonl")
+    assert "needs --quorum" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--quorum", "3", "--replay-arrivals",
+                  "/tmp/definitely-not-a-file.jsonl")
+    assert "no such" in str(ei.value)
+    # quorum is a pinned knob under --auto tune (the +qK candidates
+    # explore it only when it is NOT pinned)
+    with pytest.raises(SystemExit) as ei:
+        preflight("--quorum", "3", "--auto", "tune")
+    assert "--quorum" in str(ei.value)
+    # the clean config passes
+    preflight("--quorum", "3", "--staleness", "2")
+
+
+def test_decision_reusable_refuses_mismatched_qk():
+    from atomo_tpu.tuning.autopilot import decision_reusable
+
+    doc = {
+        "complete": True,
+        "meta": {"n_devices": 4},
+        "winner": {"knobs": {
+            "aggregate": "gather", "overlap": "off", "superstep": 1,
+            "quorum": 3, "staleness": 2,
+        }},
+    }
+    ok, _ = decision_reusable(doc, n_dev=4, quorum=3, staleness=2)
+    assert ok
+    # run_k None = "any K" (the resume site knows Q, K was the pick)
+    ok, _ = decision_reusable(doc, n_dev=4, quorum=3)
+    assert ok
+    ok, why = decision_reusable(doc, n_dev=4, quorum=3, staleness=1)
+    assert not ok and "staleness" in why
+    ok, why = decision_reusable(doc, n_dev=4, quorum=2, staleness=2)
+    assert not ok and "quorum" in why
+    ok, why = decision_reusable(doc, n_dev=4)
+    assert not ok and "quorum=3" in why
+    # and the reverse: a quorum-free decision refused under a quorum run
+    plain = {
+        "complete": True, "meta": {"n_devices": 4},
+        "winner": {"knobs": {"aggregate": "gather", "superstep": 1}},
+    }
+    ok, why = decision_reusable(plain, n_dev=4, quorum=3, staleness=1)
+    assert not ok and "priced under one" in why
+    assert decision_reusable(plain, n_dev=4)[0]
+
+
+# --------------------------------------- 8. autopilot +qK candidate space
+
+
+def test_enumerate_and_price_quorum_candidates():
+    from atomo_tpu.utils.comm_model import (
+        candidate_name,
+        enumerate_candidates,
+        predict_step_s,
+        quorum_exposed_wait_s,
+    )
+
+    cands = enumerate_candidates(
+        has_codec=True, ways=4, allow_quorum=True, quorum_q=3,
+        quorum_staleness_options=(1, 2),
+    )
+    qc = [c for c in cands if c.get("quorum")]
+    # +qK exists ONLY on the plain blocking gather/ring points: no
+    # overlap, no stream buckets, superstep 1
+    assert {c["aggregate"] for c in qc} == {"gather", "ring"}
+    assert all(
+        c["overlap"] == "off" and c["superstep"] == 1
+        and c.get("stream_encode", "off") == "off"
+        for c in qc
+    )
+    assert sorted({c["staleness"] for c in qc}) == [1, 2]
+    assert all(c["quorum"] == 3 for c in qc)
+    names = {candidate_name(c) for c in qc}
+    assert any("+q1+" in n for n in names)
+    assert any("+q2+" in n for n in names)
+    # off by default: the baseline space is untouched
+    base = enumerate_candidates(has_codec=True, ways=4)
+    assert not [c for c in base if c.get("quorum")]
+
+    # pricing: quorum pays the Q-th order statistic, blocking the max
+    delays = [0.0, 0.0, 0.0, 0.6]
+    assert quorum_exposed_wait_s(delays, 3) == 0.0
+    assert quorum_exposed_wait_s(delays, 4) == 0.6
+    assert quorum_exposed_wait_s([], 3) == 0.0
+    kw = dict(dense_bytes=1e6, payload_bytes=2e5, ways=4,
+              fabric_bw=1e9, compute_s=0.01, tax_s=0.001,
+              quorum_delays=delays)
+    blocking = {"aggregate": "gather", "overlap": "off", "superstep": 1}
+    quorum = {**blocking, "quorum": 3, "staleness": 1}
+    t_b = predict_step_s(blocking, **kw)
+    t_q = predict_step_s(quorum, **kw)
+    assert t_b - t_q == pytest.approx(0.6)
+    # no straggler table -> identical predictions (equal wire)
+    kw.pop("quorum_delays")
+    assert predict_step_s(blocking, **kw) == predict_step_s(quorum, **kw)
+
+
+def test_tune_prices_but_never_probes_quorum(monkeypatch, tmp_path):
+    """The +qK rows ride the ladder priced-only: the probe harness is
+    straggler-free, so a probe would measure a wait that is not there.
+    The winner under a fat straggler is the quorum candidate."""
+    import atomo_tpu.tuning.autopilot as ap
+
+    probed = []
+
+    def fake_probe(cand, **kw):
+        probed.append(cand["name"])
+        return {
+            **cand, "probed": True, "sync_ok": True,
+            "measured_ms_per_step": 50.0, "probe_wall_s": 0.1,
+        }
+
+    monkeypatch.setattr(
+        "atomo_tpu.tuning.probe.probe_candidate", fake_probe
+    )
+    from atomo_tpu.tuning.probe import model_init_fn
+
+    model = get_model("lenet", 10)
+    doc = ap.tune(
+        model=model,
+        optimizer=make_optimizer("sgd", lr=0.01, momentum=0.9),
+        codec=QsgdCodec(bits=8, bucket_size=512),
+        model_init_fn=model_init_fn(
+            model, jnp.zeros((1, 28, 28, 1), jnp.float32)
+        ),
+        n_dev=4, sample_shape=(28, 28, 1), num_classes=10, batch=8,
+        artifact_path=str(tmp_path / "td.json"),
+        allow_quorum=True, quorum_q=3,
+        quorum_delays=[0.0, 0.0, 0.0, 2.0],
+        probe_top=20, probe_steps=1, probe_reps=1,
+        log_fn=lambda *_: None,
+    )
+    qrows = [r for r in doc["rows"] if r.get("quorum")]
+    assert qrows, "the +qK candidates must be in the ladder"
+    assert all(r["probed"] is False for r in qrows)
+    assert all("straggler-free" in r["probe_note"] for r in qrows)
+    assert not any("+q" in n for n in probed)
+    # the pricing is in the artifact: the +q1 gather row dodges the 2 s
+    # blocking exposure its gather+off+k1 sibling pays
+    rows = {r["name"]: r for r in doc["rows"]}
+    gap = (rows["gather+off+k1"]["predicted_ms_per_step"]
+           - rows["gather+off+q1+k1"]["predicted_ms_per_step"])
+    assert gap == pytest.approx(2000.0)
+    # choose_winner's measured-beats-priced contract holds: the winner
+    # is a validly-probed row, and a quorum row's knob vector carries
+    # (quorum, staleness) for the day the prediction fallback picks one
+    assert rows[doc["winner"]["name"]]["probed"] is True
+    qk = ap.winner_knobs(qrows[0])
+    assert qk["quorum"] == 3 and qk["staleness"] in (1, 2)
+
+
+# ------------------------------------------------- artifact discipline
+
+
+def test_lint_covers_quorum_subsystem_by_construction(tmp_path):
+    """The mesh/budget precedent applied to the NEW quorum/ package: the
+    artifact-discipline walk covers it with no allowlist to forget — a
+    json.dump smuggled into atomo_tpu/quorum/ is flagged, and the real
+    package (append-only one-write-per-line jsonl) is clean."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_artifact_discipline",
+        os.path.join(repo, "scripts", "check_artifact_discipline.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    pkg = tmp_path / "atomo_tpu" / "quorum"
+    pkg.mkdir(parents=True)
+    bad = pkg / "rogue.py"
+    bad.write_text(
+        "import json\n"
+        "def w(train_dir, obj):\n"
+        "    with open(train_dir + '/arrival_schedule.jsonl', 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+    )
+    out = mod.scan_file(
+        str(bad), os.path.join("atomo_tpu", "quorum", "rogue.py")
+    )
+    assert len(out) == 1 and "write_json_atomic" in out[0]
+    real = os.path.join(repo, "atomo_tpu", "quorum")
+    assert os.path.isdir(real)
+    assert not [
+        v for v in mod.collect_violations(repo) if "atomo_tpu/quorum" in v
+    ]

@@ -109,7 +109,13 @@ def _run_blocks(step_fn, state, key, batches, sizes):
 
 @pytest.mark.parametrize(
     "codec",
-    [None, QsgdCodec(bits=4, bucket_size=128), SvdCodec(rank=2)],
+    [
+        None,
+        QsgdCodec(bits=4, bucket_size=128),
+        # ~25 s of SVD compiles on 1 core — full-suite only; qsgd keeps the
+        # partition invariant in the smoke set
+        pytest.param(SvdCodec(rank=2), marks=pytest.mark.slow),
+    ],
     ids=["dense", "qsgd", "svd"],
 )
 def test_superstep_bitwise_partition_invariant(codec):
@@ -281,6 +287,9 @@ def _run_ft(train_dir, chaos="", resume=False, superstep=1, timeout=240):
     return proc, final
 
 
+@pytest.mark.slow  # 3 subprocess trainings (~22 s on 1 core) — full-suite
+# only; test_train_loop_resume_at_non_multiple_of_k keeps the non-boundary
+# resume contract in the smoke set
 def test_superstep_kill_restart_resume_non_boundary(tmp_path):
     """The superstep fault-tolerance drill (PR-1 contract with K>1):
 
@@ -373,7 +382,19 @@ def _dist_run_blocks(step_fn, state, key, batches, sizes, mesh, axes):
     return state, flat
 
 
-@pytest.mark.parametrize("mode", ["gather", "ring", "psum", "hierarchical", "zero1"])
+@pytest.mark.parametrize(
+    "mode",
+    [
+        "gather",
+        # ring/hierarchical/zero1 re-prove the same scan-partition contract
+        # over pricier exchanges (~30 s combined on 1 core) — full-suite
+        # only; gather+psum keep it in the smoke set
+        pytest.param("ring", marks=pytest.mark.slow),
+        "psum",
+        pytest.param("hierarchical", marks=pytest.mark.slow),
+        pytest.param("zero1", marks=pytest.mark.slow),
+    ],
+)
 def test_distributed_superstep_partition_invariant(mode):
     """(a) distributed: K fused SPMD steps == K sequential dispatches of
     the same fused program, bitwise, for every aggregate mode (compressed

@@ -344,6 +344,8 @@ def _run_hier(mesh, model, opt, state0, si, sl, nsteps=2, **kw):
     return st, jax.device_get(m)
 
 
+@pytest.mark.slow  # ~8 s of hierarchical compiles on 1 core — full-suite
+# only; the legacy pin is a frozen contract, not an active code path
 def test_legacy_plan_bit_identical_to_pre_topology_program():
     """plan=LEGACY_PLAN routes through the frozen inline path: the
     trajectory is bit-for-bit the plan=None (pre-topology) one."""

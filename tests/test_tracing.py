@@ -48,6 +48,9 @@ def test_profile_stops_trace_on_error(tmp_path):
     assert _files_under(tmp_path)
 
 
+@pytest.mark.slow  # full end-to-end CLI training under the profiler (~14 s
+# on 1 core) — full-suite only; test_fabric_obs's timeline test keeps
+# trace-production coverage in the smoke set
 def test_cli_profile_dir_flag_produces_trace(tmp_path, capsys):
     """The --profile-dir trace flag end to end: a short distributed run
     announces the profiled window and leaves trace files."""

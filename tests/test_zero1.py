@@ -89,7 +89,15 @@ def test_zero1_opt_state_is_actually_sharded():
         assert shard.data.shape == (chunk,)  # 1/n per chip
 
 
-@pytest.mark.parametrize("opt_name", ["sgd", "adam"])
+@pytest.mark.parametrize(
+    "opt_name",
+    [
+        "sgd",
+        # ~17 s of adam compiles on 1 core — full-suite only; sgd keeps the
+        # zero1 x hierarchical composition in the smoke set
+        pytest.param("adam", marks=pytest.mark.slow),
+    ],
+)
 def test_zero1_composes_with_hierarchical(opt_name):
     """VERDICT r4 weak #7: zero1 + hierarchical aggregation. The optimizer
     slices shard over BOTH data axes (every chip holds 1/8), and two steps
