@@ -31,10 +31,34 @@ Byte pricing is the codec's OWN static accounting
 so a predicted allocation total and the executed program's
 ``msg_bytes`` agree to the byte: the bench config 16 wire-match gate.
 
+THE QSGD BIT LAW (the second water-filling target, same machinery,
+different pricing/variance pair): stochastic rounding of |x|/s onto
+L(b) = 2^b - 1 levels has per-value error (s/L)^2 f(1-f) with f the
+fractional level position. Under the uniform-residual model
+(E f(1-f) = 1/6 — exact in the fine-grid limit L >> |x| sqrt(n)/s,
+the regime where QSGD's own variance bound is tight), a bucketed leaf
+obeys
+
+    E ||ghat - g||_F^2  =  B_l / (2^b - 1)^2,
+    B_l = (1/6) sum_buckets n_b * s_b^2
+
+(n_b = real values in the bucket, s_b = its L2 scale; B_l is a
+property of the gradient's bucket norms alone, and the 1/6 constant
+cancels in every allocation ratio, so the greedy ordering does not
+depend on the residual model). The knob is the leaf's bit width b,
+priced by the codec's own packed-word accounting
+(``QsgdCodec.leaf_payload_bytes``); unlike SVD there is NO dense
+fallback in the wire format, so the solver never claims an exact-wire
+zero-variance point — it simply refuses to buy bits whose payload
+would meet or exceed the dense bytes. The uniform degenerate point is
+every leaf at the codec's configured ``bits`` — byte-for-byte the
+plain codec. TernGrad's max-norm scale + sigma clip has a DIFFERENT
+error law (not stated here) and stays rejected.
+
 Scope (honest): the solver allocates SVD ranks for the ``fixed_k``
-sampler — the family whose variance law is stated above. Per-layer
-QSGD bit allocation is the same machinery with a different pricing/
-variance pair and is rejected at the CLI until its law is stated too.
+sampler and QSGD bit widths for the L2-scale ``qsgd`` scheme — the
+two families whose variance laws are stated above. Every other
+codec/sampler pair is rejected at the CLI until its law is stated too.
 """
 
 from __future__ import annotations
@@ -49,11 +73,15 @@ from typing import Optional, Sequence
 class LayerSpectrum:
     """One leaf's allocation inputs, canonical flatten order.
 
-    ``a`` is the variance numerator A = (sum s)^2 - sum s^2 of the
-    leaf's matricized spectrum; ``r_full`` caps the useful rank;
-    ``adaptive`` is False for leaves the codec ships dense at ANY rank
-    (payload >= dense already at rank 1 — BN scales, biases): they cost
-    their fixed payload and contribute zero variance, no knob."""
+    ``a`` is the variance numerator — A = (sum s)^2 - sum s^2 of the
+    leaf's matricized spectrum for SVD ranks, or B = (1/6) sum n_b s_b^2
+    of its bucket norms for QSGD bits; ``r_full`` caps the useful knob
+    (full rank, or the last bit width whose payload still beats dense);
+    ``adaptive`` is False for leaves with no knob — SVD leaves shipped
+    dense at ANY rank (zero variance, fixed payload) and QSGD leaves
+    whose 1-bit payload already meets dense (they still ship quantized
+    at the base bits and contribute variance there, but the solver
+    never moves them)."""
 
     index: int
     name: str
@@ -70,10 +98,10 @@ class Allocation:
     """A solved per-layer budget split (the artifact's epoch body)."""
 
     mode: str  # "uniform" | "variance"
-    ks: tuple  # per-leaf rank, canonical flatten order
+    ks: tuple  # per-leaf knob (SVD rank or QSGD bits), flatten order
     payload_bytes: int  # predicted total wire bytes (clamped actual)
     budget_bytes: int  # the budget the solver was given
-    predicted_variance: float  # sum_l A_l / k_l over adaptive leaves
+    predicted_variance: float  # sum of the stated per-leaf law
     epoch: int = 0
 
     def describe(self) -> str:
@@ -85,14 +113,31 @@ class Allocation:
         )
 
 
-def _leaf_bytes(codec, spectrum: LayerSpectrum, k: int) -> int:
-    """Wire bytes of this leaf at rank ``k`` — the codec's own clamped
-    static pricing (dense fallback included)."""
+def knob_name(codec) -> str:
+    """Which field the allocator waters: ``rank`` (SVD fixed_k) or
+    ``bits`` (QSGD). The dispatch key for pricing AND variance law."""
+    return "rank" if hasattr(codec, "rank") else "bits"
+
+
+def _with_knob(codec, k: int):
     import dataclasses as _dc
 
-    return int(
-        _dc.replace(codec, rank=int(k)).leaf_payload_bytes(spectrum.shape)
-    )
+    return _dc.replace(codec, **{knob_name(codec): int(k)})
+
+
+def variance_at(codec, a: float, k: int) -> float:
+    """The stated per-leaf law at knob value ``k``: A/k for SVD ranks,
+    B/(2^b - 1)^2 for QSGD bits (module docstring)."""
+    if knob_name(codec) == "bits":
+        lv = float((1 << int(k)) - 1)
+        return a / (lv * lv)
+    return a / k
+
+
+def _leaf_bytes(codec, spectrum: LayerSpectrum, k: int) -> int:
+    """Wire bytes of this leaf at knob ``k`` — the codec's own clamped
+    static pricing (dense fallback included, where the format has one)."""
+    return int(_with_knob(codec, k).leaf_payload_bytes(spectrum.shape))
 
 
 def measure_spectra(codec, grads) -> list:
@@ -105,7 +150,13 @@ def measure_spectra(codec, grads) -> list:
     the CODEC's own resize policy and its full singular-value spectrum
     taken host-side (numpy — probe-time only, never traced; the
     matrices are capped at ``max_min_dim`` on the small side, so this
-    is cheap). Pure given the gradient: same probe, same spectra."""
+    is cheap). Pure given the gradient: same probe, same spectra.
+
+    A ``bits`` codec (QSGD) dispatches to the bucket-norm measurement —
+    same LayerSpectrum container, the B_l numerator of the module
+    docstring's bit law instead of the SVD A_l."""
+    if knob_name(codec) == "bits":
+        return _measure_bit_spectra(codec, grads)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -142,11 +193,75 @@ def measure_spectra(codec, grads) -> list:
     return out
 
 
-def _always_dense(codec, shape) -> bool:
-    """Is this leaf dense-fallback at rank 1 (i.e. at every rank)?"""
-    import dataclasses as _dc
+#: Bit widths past this point buy nothing: float32 inputs carry 24
+#: significand bits, and the packed (1+b)-bit layout needs b+1 <= 32.
+MAX_BITS = 16
 
-    return bool(_dc.replace(codec, rank=1)._dense_fallback(tuple(shape)))
+
+def _measure_bit_spectra(codec, grads) -> list:
+    """Per-leaf :class:`LayerSpectrum` for QSGD bit allocation.
+
+    The numerator is the bit law's B_l = (1/6) sum_b n_b s_b^2 over the
+    leaf's REAL (unpadded) bucket contents — n_b values and L2 scale
+    s_b per bucket, exactly the bucketing :meth:`QsgdCodec.encode`
+    performs, measured host-side from the probe gradient (no extra
+    device work). ``r_full`` is the last bit width (<= MAX_BITS) whose
+    payload still beats the leaf's dense bytes; ``base_k`` is the
+    codec's configured ``bits`` UNCLAMPED — the uniform point must be
+    byte-for-byte the plain codec, which never falls back to dense.
+    TernGrad is refused: its max-norm scale + sigma clip follows a
+    different error law that the module docstring does not state."""
+    import jax
+    import numpy as np
+
+    if getattr(codec, "scheme", "qsgd") != "qsgd":
+        raise ValueError(
+            f"bit allocation needs the L2-scale qsgd scheme, got "
+            f"{codec.scheme!r}: the terngrad max-norm law is not stated"
+        )
+    flat, _ = jax.tree_util.tree_flatten_with_path(grads)
+    out = []
+    for i, (path, leaf) in enumerate(flat):
+        name = jax.tree_util.keystr(path)
+        shape = tuple(int(d) for d in leaf.shape)
+        arr = np.asarray(jax.device_get(leaf), dtype=np.float32).reshape(-1)
+        dense_b = int(arr.size) * 4
+        bs = int(codec.bucket_size)
+        b_num = 0.0
+        for start in range(0, arr.size, bs):
+            chunk = arr[start:start + bs]
+            s_b = float(np.linalg.norm(chunk))
+            b_num += chunk.size * s_b * s_b
+        b_num /= 6.0
+        adaptive = not _always_dense(codec, shape)
+        r_full = 1
+        for b in range(1, MAX_BITS + 1):
+            if _with_knob(codec, b).leaf_payload_bytes(shape) < dense_b:
+                r_full = b
+        base_k = int(codec.bits)
+        if not adaptive:
+            r_full = base_k
+        out.append(
+            LayerSpectrum(
+                index=i, name=name, shape=shape, dense_bytes=dense_b,
+                r_full=r_full, a=max(b_num, 0.0), base_k=base_k,
+                adaptive=adaptive,
+            )
+        )
+    return out
+
+
+def _always_dense(codec, shape) -> bool:
+    """Is this leaf knob-less? SVD: dense-fallback already at rank 1
+    (i.e. at every rank). QSGD: the 1-bit payload already meets the
+    dense bytes, so no bit width can beat dense wire."""
+    shape = tuple(shape)
+    if knob_name(codec) == "bits":
+        dense = 4
+        for d in shape:
+            dense *= int(d)
+        return _with_knob(codec, 1).leaf_payload_bytes(shape) >= dense
+    return bool(_with_knob(codec, 1)._dense_fallback(shape))
 
 
 def spectra_from_qerr2(
@@ -157,9 +272,11 @@ def spectra_from_qerr2(
 ) -> list:
     """Fold an observed per-layer q_err2 series into fresh spectra.
 
-    Under the stated law E q_err2_l = A_l / k_l, the mean of the
-    recorded ``--obs-quality`` series at the CURRENT allocation is an
-    unbiased online estimate A_l ~= mean(q_err2_l) * k_l — no extra
+    Under the stated law E q_err2_l = A_l / k_l (SVD ranks; for QSGD
+    bits the same inversion reads B_l ~= mean(q_err2_l) * (2^b - 1)^2
+    when ``codec`` is a bits codec), the mean of the recorded
+    ``--obs-quality`` series at the CURRENT allocation is an unbiased
+    online estimate of the numerator — no extra
     SVDs, the streamed-encode leaf visits already paid for the signal.
     Non-adaptive leaves keep their measured A (they have no knob and a
     lossless/dense leaf reads q_err2 = 0 anyway); an unusable sample
@@ -191,7 +308,11 @@ def spectra_from_qerr2(
                 and math.isfinite(float(q))
                 and float(q) >= 0
             ):
-                a = float(q) * k
+                if codec is not None and knob_name(codec) == "bits":
+                    # invert the bit law: B = q_err2 * (2^b - 1)^2
+                    a = float(q) / variance_at(codec, 1.0, k)
+                else:
+                    a = float(q) * k
         out.append(dataclasses.replace(l, a=a))
     return out
 
@@ -205,14 +326,22 @@ def uniform_ks(spectra: Sequence[LayerSpectrum]) -> tuple:
 def predicted_variance(
     spectra: Sequence[LayerSpectrum], ks: Sequence[int], codec=None
 ) -> float:
-    """Total predicted estimator variance sum_l A_l / k_l (adaptive
-    leaves; a leaf whose payload at k_l reaches the dense fallback is
-    exact — variance 0 — when ``codec`` is given to price it)."""
+    """Total predicted estimator variance under the stated per-leaf
+    law. SVD ranks: sum_l A_l / k_l over adaptive leaves (a leaf whose
+    payload at k_l reaches the dense fallback is exact — variance 0 —
+    when ``codec`` is given to price it; non-adaptive leaves ship dense,
+    zero variance). QSGD bits: sum_l B_l / (2^b - 1)^2 over EVERY leaf —
+    the wire format has no exact point, and a knob-less leaf still
+    quantizes at its base bits."""
+    bits = codec is not None and knob_name(codec) == "bits"
     total = 0.0
     for l in spectra:
+        k = max(int(ks[l.index]), 1)
+        if bits:
+            total += variance_at(codec, l.a, k)
+            continue
         if not l.adaptive:
             continue
-        k = max(int(ks[l.index]), 1)
         if codec is not None and _leaf_bytes(codec, l, k) >= l.dense_bytes:
             continue  # dense fallback ships exact: zero variance
         total += l.a / k
@@ -284,10 +413,15 @@ def solve_allocation(
         if not l.adaptive:
             ks[l.index] = l.base_k  # fixed leaves: priced, never re-ranked
         spent += _leaf_bytes(codec, l, ks[l.index])
-    # The greedy: each move raises one adaptive leaf's rank by one; its
-    # gain is A (1/k - 1/(k+1)) — or the FULL remaining A/k when the
-    # next rank crosses into the dense fallback (exact: variance drops
-    # to zero) — per delta-byte. heapq is a min-heap: push -gain/byte.
+    # The greedy: each move raises one adaptive leaf's knob by one; its
+    # gain is the stated law's marginal drop — SVD ranks:
+    # A (1/k - 1/(k+1)), or the FULL remaining A/k when the next rank
+    # crosses into the dense fallback (exact: variance drops to zero);
+    # QSGD bits: B (1/L(b)^2 - 1/L(b+1)^2) with NO dense-crossing move
+    # (the format has no exact point — a bit width whose payload meets
+    # dense is simply never bought) — per delta-byte. heapq is a
+    # min-heap: push -gain/byte.
+    bits_knob = knob_name(codec) == "bits"
     heap: list = []
 
     def push_move(l: LayerSpectrum, k: int):
@@ -298,7 +432,13 @@ def solve_allocation(
             return  # already at the exact dense fallback: nothing to buy
         nxt = _leaf_bytes(codec, l, k + 1)
         d_bytes = nxt - here
-        if nxt >= l.dense_bytes:
+        if bits_knob:
+            if nxt >= l.dense_bytes:
+                return  # never pay dense wire for a lossy payload
+            gain = variance_at(codec, l.a, k) - variance_at(
+                codec, l.a, k + 1
+            )
+        elif nxt >= l.dense_bytes:
             gain = l.a / k  # crossing into the exact dense fallback
         else:
             gain = l.a * (1.0 / k - 1.0 / (k + 1))

@@ -1,4 +1,5 @@
-"""PerLeafCodec — an allocation's per-layer ranks as a codec wrapper.
+"""PerLeafCodec — an allocation's per-layer knobs (SVD ranks or QSGD
+bit widths) as a codec wrapper.
 
 The codecs.base tree walkers (``encode_tree`` / ``encode_leaf_subset`` /
 ``encode_tree_streamed`` / ``decode_tree`` / ``decode_mean_tree``)
@@ -45,7 +46,9 @@ class PerLeafCodec:
 
     @property
     def ks(self) -> tuple:
-        return tuple(int(c.rank) for c in self.codecs)
+        return tuple(
+            int(getattr(c, "rank", None) or c.bits) for c in self.codecs
+        )
 
     def codec_for(self, i: int):
         """The codec for GLOBAL leaf index ``i`` (codecs.base.leaf_codec
@@ -69,13 +72,18 @@ class PerLeafCodec:
 
 
 def budgeted_codec(base, ks) -> PerLeafCodec:
-    """Wrap ``base`` with an allocation's per-leaf ranks (canonical
-    flatten order). Rank values must be static Python ints — they size
-    the wire payloads at trace time."""
+    """Wrap ``base`` with an allocation's per-leaf knob values (canonical
+    flatten order) — SVD ranks or QSGD bit widths, dispatched on which
+    field the base codec carries (``budget.allocator.knob_name``). Knob
+    values must be static Python ints — they size the wire payloads at
+    trace time."""
+    from atomo_tpu.budget.allocator import knob_name
+
+    knob = knob_name(base)
     return PerLeafCodec(
         base=base,
         codecs=tuple(
-            dataclasses.replace(base, rank=int(k)) for k in ks
+            dataclasses.replace(base, **{knob: int(k)}) for k in ks
         ),
         name=f"{getattr(base, 'name', 'codec')}+ab",
     )

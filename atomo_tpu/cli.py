@@ -81,8 +81,21 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
     t.add_argument("--n-devices", type=int, default=0,
                    help="devices in the dp mesh; 0 = all visible, 1 = single-host")
     t.add_argument("--auto", type=str, default="off",
-                   choices=["off", "tune"],
-                   help="tune = performance autopilot: predict a ranked "
+                   choices=["off", "tune", "controller"],
+                   help="controller = the GLOBAL controller: one priced "
+                        "decision space over every knob (aggregate / "
+                        "overlap / superstep / ring bucket / stream "
+                        "buckets / topology plan / per-leaf rank-or-bit "
+                        "allocation / sparse-row hybrid / quorum), the "
+                        "pure legacy solvers composed as subroutines of "
+                        "one predict-ranked enumeration, only the "
+                        "shortlist probed, one decision artifact "
+                        "(train_dir/controller_decision.json) "
+                        "superseding tune_decision.json + "
+                        "budget_alloc.json as the resume source of "
+                        "truth, and one online re-solve loop "
+                        "(controller_redecide incidents). "
+                        "tune = performance autopilot: predict a ranked "
                         "candidate list of knob vectors (aggregate / "
                         "overlap / stream-encode / superstep / ring "
                         "bucket) from the comm "
@@ -898,11 +911,13 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             f"--superstep {args.superstep}: must be >= 1 (or 0 for the "
             "per-backend auto default)"
         )
-    if getattr(args, "auto", "off") == "tune":
+    if getattr(args, "auto", "off") in ("tune", "controller"):
         # pin or tune, not both: a knob whose value differs from its
         # auto/default sentinel was pinned by the user, and silently
         # overriding an explicit choice is worse than refusing. (Values,
         # not argv, define "pinned": re-passing a default is a no-op.)
+        # The controller inherits the whole matrix — it picks a SUPERSET
+        # of the autopilot's knobs.
         pinned = []
         if args.aggregate != "auto":
             pinned.append(f"--aggregate {args.aggregate}")
@@ -924,23 +939,23 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             pinned.append(f"--quorum {args.quorum}")
         if pinned:
             raise SystemExit(
-                "--auto tune picks the performance knobs itself and "
-                f"conflicts with the pinned {', '.join(pinned)}; drop the "
-                "pinned flag(s) to let the autopilot choose, or drop "
-                "--auto tune to keep your explicit config"
+                f"--auto {args.auto} picks the performance knobs itself "
+                f"and conflicts with the pinned {', '.join(pinned)}; drop "
+                "the pinned flag(s) to let it choose, or drop "
+                f"--auto {args.auto} to keep your explicit config"
             )
         if args.phase_metrics:
             raise SystemExit(
-                "--auto tune cannot compose with --phase-metrics (the "
-                "phased observability mode forces superstep 1 + gather — "
-                "there is nothing left to tune); drop one"
+                f"--auto {args.auto} cannot compose with --phase-metrics "
+                "(the phased observability mode forces superstep 1 + "
+                "gather — there is nothing left to tune); drop one"
                 + _TIMELINE_HINT
             )
         if not args.train_dir:
             raise SystemExit(
-                "--auto tune needs a --train-dir: the decision artifact "
-                "(tune_decision.json) and the online re-tuner's incident "
-                "log live there"
+                f"--auto {args.auto} needs a --train-dir: the decision "
+                "artifact and the online re-tuner's incident log live "
+                "there"
             )
     if getattr(args, "fabric", "auto") == "measured":
         # argv-knowable half of the measured-fabric contract; the
@@ -1118,7 +1133,7 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "exchange"
             )
         if (
-            getattr(args, "auto", "off") == "tune"
+            getattr(args, "auto", "off") in ("tune", "controller")
             and args.code.lower() in DENSE_CODES
         ):
             raise SystemExit(
@@ -1178,19 +1193,22 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "per-layer budget; dense training has no budget to "
                 "allocate"
             )
-        if args.code.lower() != "svd":
+        if args.code.lower() not in ("svd", "qsgd"):
             raise SystemExit(
-                f"--budget-alloc variance needs --code svd: the solver "
-                "implements the fixed_k rank-allocation variance law "
-                f"(A/k); per-layer bit allocation for {args.code!r} is "
-                "the same machinery with a different pricing/variance "
-                "pair and is not stated yet — rejected honestly"
+                f"--budget-alloc variance needs --code svd (the fixed_k "
+                "rank law A/k) or --code qsgd (the bit law "
+                f"B/(2^b-1)^2); per-layer allocation for {args.code!r} "
+                "is the same machinery with a different pricing/"
+                "variance pair and is not stated yet — rejected "
+                "honestly (terngrad's max-norm scale + sigma clip "
+                "included)"
             )
-        if args.sample != "fixed_k":
+        if args.code.lower() == "svd" and args.sample != "fixed_k":
             raise SystemExit(
-                f"--budget-alloc variance needs --sample fixed_k (the "
-                f"stated variance law is the with-replacement sampler's "
-                f"A/k; --sample {args.sample} has a different law)"
+                f"--budget-alloc variance with --code svd needs "
+                f"--sample fixed_k (the stated variance law is the "
+                f"with-replacement sampler's A/k; --sample "
+                f"{args.sample} has a different law)"
             )
         if args.aggregate == "hierarchical" or plan_flag != "auto":
             raise SystemExit(
@@ -1198,11 +1216,17 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "aggregation: the hierarchical boundary re-encode is not "
                 "allocation-aware yet"
             )
-        if getattr(args, "sparse_rows", "off") != "off":
+        if getattr(args, "sparse_rows", "off") != "off" and (
+            getattr(args, "auto", "off") != "controller"
+        ):
             raise SystemExit(
-                "--budget-alloc variance does not compose with "
-                "--sparse-rows yet: the hybrid planner prices the dense "
-                "sub-list at the base codec's budget"
+                "--budget-alloc variance with --sparse-rows is a JOINT "
+                "decision: the hybrid planner must re-price its dense "
+                "sub-list under the allocated per-leaf codec, and the "
+                "two single deciders each assume the other's knob is at "
+                "its default. --auto controller prices and probes "
+                "exactly that cross term (the +sp+ab candidates) — use "
+                "it; the static pairing stays rejected"
             )
         if args.phase_metrics:
             raise SystemExit(
@@ -1289,13 +1313,13 @@ def _argv_preflight(args: argparse.Namespace) -> None:
                 "rides its carry); --phase-metrics has no fused step"
                 + _TIMELINE_HINT
             )
-        if getattr(args, "auto", "off") == "tune":
-            raise SystemExit(
-                "--error-feedback does not compose with --auto tune "
-                "yet: the probe ladder does not build the residual-carry "
-                "program, so its timings would describe a different "
-                "step — pick knobs explicitly"
-            )
+        # --auto tune/controller DOES compose with EF now (ISSUE-17
+        # satellite): the probe harness builds the residual-carry step,
+        # the candidate space narrows to the flat blocking programs EF
+        # supports (tune() applies the same matrix as the rejects
+        # above), and every probed row carries the bias contract in
+        # its record plus a probe_note naming the changed comparison
+        # basis.
         if not (args.code.lower() == "svd" and args.sample == "topk"):
             # svd+topk is the one contraction estimator in the registry;
             # every other compressing code (svd random samplers, qsgd,
@@ -1641,16 +1665,27 @@ def _real_stream_buckets(model_init_fn, bucket_bytes: int) -> int:
 
 
 def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
-                   save_freq, sparse_plan=None, budget_ctx=None):
-    """``--auto tune``: run the startup probe ladder, apply the winning
-    knob vector onto ``args`` (aggregate / overlap / ring bucket) and
-    return ``(superstep, tuner)`` — the chosen fused-block size plus the
-    armed :class:`~atomo_tpu.tuning.autopilot.OnlineRetuner` (or None
-    when there is no checkpoint cadence to snap a re-probe to). The
-    decision artifact lands in ``train_dir/tune_decision.json``; the
-    subsequent training trajectory is bit-identical to launching the
-    chosen config statically (probes never touch the data iterator or
-    the run's init seed)."""
+                   save_freq, sparse_plan=None, budget_ctx=None,
+                   hybrid_inputs=None):
+    """``--auto tune`` / ``--auto controller``: run the startup probe
+    ladder, apply the winning knob vector onto ``args`` (aggregate /
+    overlap / ring bucket) and return ``(superstep, tuner)`` — the
+    chosen fused-block size plus the armed online retuner (or None when
+    there is no checkpoint cadence to snap a re-probe to). The decision
+    artifact lands in ``train_dir/tune_decision.json``; the subsequent
+    training trajectory is bit-identical to launching the chosen config
+    statically (probes never touch the data iterator or the run's init
+    seed).
+
+    Under ``--auto controller`` the solve is the JOINT one
+    (:func:`atomo_tpu.controller.solve_controller` — the legacy deciders
+    composed inside one priced enumeration), the artifact is
+    ``controller_decision.json`` (legacy artifacts still resume, with a
+    stated fallback), and the returned tuner is a
+    :class:`~atomo_tpu.controller.ControllerRetuner` so every online
+    change lands as one ``controller_redecide`` incident.
+    ``hybrid_inputs`` (the ``plan_hybrid`` argument triple) enables the
+    controller's ``+sp+ab`` cross term."""
     import jax
     import jax.numpy as jnp
 
@@ -1665,13 +1700,15 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         probe_candidate,
     )
 
+    is_ctl = getattr(args, "auto", "off") == "controller"
+    tag = "Controller" if is_ctl else "Autopilot"
     if jax.process_count() > 1:
         raise SystemExit(
-            "--auto tune is single-host for now (probe meshes are built "
-            "over this host's devices; a multi-host probe would need "
-            "every process in the dispatch loop); pick knobs explicitly "
-            "on multi-host meshes — hierarchical plans ARE probed on "
-            "single-host --dcn-ways meshes"
+            f"--auto {args.auto} is single-host for now (probe meshes "
+            "are built over this host's devices; a multi-host probe "
+            "would need every process in the dispatch loop); pick knobs "
+            "explicitly on multi-host meshes — hierarchical plans ARE "
+            "probed on single-host --dcn-ways meshes"
         )
     dcn_ways = 0
     if getattr(args, "dcn_ways", 0) > 1 and n_dev > 1:
@@ -1705,7 +1742,7 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
     # space up front, out loud, exactly like allow_overlap below
     if dcn_ways and args.on_diverge == "densify":
         print(
-            "Autopilot: excluding hierarchical candidates (--on-diverge "
+            f"{tag}: excluding hierarchical candidates (--on-diverge "
             "densify cannot compose with a two-level schedule — the "
             "dense fallback aggregates with a flat psum)",
             flush=True,
@@ -1713,14 +1750,14 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         dcn_ways = 0
     if dcn_ways and k_agg:
         print(
-            "Autopilot: excluding hierarchical candidates "
+            f"{tag}: excluding hierarchical candidates "
             "(--num-aggregate subsets replicas only in flat gather/ring)",
             flush=True,
         )
         dcn_ways = 0
     if dcn_ways and getattr(args, "elastic", False):
         print(
-            "Autopilot: excluding hierarchical candidates (--elastic is "
+            f"{tag}: excluding hierarchical candidates (--elastic is "
             "flat-mesh only — membership tracks single replicas, not "
             "inner groups)",
             flush=True,
@@ -1728,7 +1765,7 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         dcn_ways = 0
     if dcn_ways and getattr(args, "obs_quality", False):
         print(
-            "Autopilot: excluding hierarchical candidates (--obs-quality "
+            f"{tag}: excluding hierarchical candidates (--obs-quality "
             "probes flat exchanges only — the boundary re-encode is not "
             "probe-aware)",
             flush=True,
@@ -1771,15 +1808,38 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
 
         from atomo_tpu.tuning.autopilot import decision_reusable
 
-        path = decision_path(args.train_dir)
-        try:
-            with open(path) as f:
-                prior = _json.load(f)
-        except (OSError, ValueError):
-            prior = None
+        if is_ctl:
+            # one resume source of truth: controller_decision.json,
+            # with the STATED legacy fallback (load_resume_decision logs
+            # it) so pre-controller train_dirs keep resuming
+            from atomo_tpu.controller import (
+                controller_path,
+                controller_reusable,
+                load_resume_decision,
+            )
+
+            prior, source = load_resume_decision(args.train_dir)
+            path = (
+                controller_path(args.train_dir)
+                if source == "controller"
+                else decision_path(args.train_dir)
+            )
+            check = (
+                controller_reusable
+                if source == "controller"
+                else decision_reusable
+            )
+        else:
+            path = decision_path(args.train_dir)
+            try:
+                with open(path) as f:
+                    prior = _json.load(f)
+            except (OSError, ValueError):
+                prior = None
+            check = decision_reusable
         from atomo_tpu.mesh import MeshSpec
 
-        reusable, why = decision_reusable(
+        reusable, why = check(
             prior, n_dev=n_dev,
             mesh_axes=MeshSpec.from_world(n_dev, dcn_ways).shape_dict(),
             # the chaos-derived Q this run would explore (staleness=None:
@@ -1789,17 +1849,17 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         if reusable:
             doc = prior
             print(
-                f"Autopilot: resuming with the recorded decision from "
+                f"{tag}: resuming with the recorded decision from "
                 f"{path} (no re-probe; delete the file to re-tune)",
                 flush=True,
             )
         elif prior is not None:
-            print(f"Autopilot: NOT reusing {path}: {why}", flush=True)
+            print(f"{tag}: NOT reusing {path}: {why}", flush=True)
             if args.train_dir:
                 from atomo_tpu.utils.tracing import IncidentLog
 
                 IncidentLog.for_train_dir(args.train_dir).append(
-                    "tune_decision",
+                    "controller_decision" if is_ctl else "tune_decision",
                     action="retune",
                     reason=why,
                     n_devices=n_dev,
@@ -1816,7 +1876,66 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         and not getattr(args, "obs_quality", False)
     )
     compute_dtype = jnp.bfloat16 if args.bf16 else None
+    _ef = bool(getattr(args, "error_feedback", False))
     try:
+        if doc is None and is_ctl:
+            # the JOINT solve: the legacy deciders composed as
+            # subroutines of one predict_step_s-ranked enumeration; the
+            # shared knobs below are the SAME values the tune() branch
+            # passes, so restricting the controller to one decider's
+            # axes reproduces that decider's winner (degeneracy tests)
+            from atomo_tpu.controller import (
+                controller_path,
+                solve_controller,
+            )
+
+            doc = solve_controller(
+                model=model, optimizer=optimizer, codec=codec,
+                model_init_fn=_init_params, n_dev=n_dev,
+                sample_shape=sample_shape, num_classes=num_classes,
+                batch=args.batch_size, fabric=args.fabric,
+                seed=args.seed,
+                artifact_path=controller_path(args.train_dir),
+                budget_ctx=budget_ctx if n_dev > 1 else None,
+                hybrid=(
+                    sparse_plan
+                    if getattr(args, "sparse_rows", "off") == "auto"
+                    else None
+                ),
+                hybrid_inputs=hybrid_inputs,
+                allow_psum=args.num_aggregate is None,
+                allow_overlap=allow_overlap,
+                allow_stream=codec is not None and n_dev > 1,
+                stream_bucket_bytes=_stream_bucket_bytes(args),
+                stream_buckets=_real_stream_buckets(
+                    _init_params, _stream_bucket_bytes(args)
+                ),
+                allow_quorum=allow_quorum,
+                quorum_q=quorum_q,
+                quorum_delays=quorum_delays,
+                superstep_options=(1, 8),
+                bucket_options=(
+                    (args.ring_bucket_size,)
+                    if args.ring_bucket_size != 65536 else (65536, 0)
+                ),
+                dcn_ways=dcn_ways,
+                probe_top=args.tune_top, probe_steps=args.tune_steps,
+                probe_reps=args.tune_reps,
+                num_aggregate=k_agg, zero1=zero1, partition=partition,
+                grad_accum=args.grad_accum,
+                compute_dtype=compute_dtype,
+                codec_tax_s=(
+                    None if args.codec_tax_ms is None
+                    else args.codec_tax_ms / 1e3
+                ),
+                ring_bucket_size=args.ring_bucket_size,
+                fabric_probe=getattr(args, "_fabric_probe", None),
+                error_feedback=_ef,
+                context={
+                    "network": args.network, "dataset": args.dataset,
+                    "code": args.code, "seed": args.seed,
+                },
+            )
         doc = doc if doc is not None else tune(
             model=model, optimizer=optimizer, codec=codec,
             model_init_fn=_init_params, n_dev=n_dev,
@@ -1890,6 +2009,10 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             # meta records the per-tier GB/s for the report's
             # cross-artifact check
             fabric_probe=getattr(args, "_fabric_probe", None),
+            # --error-feedback narrows the space inside tune() (EF
+            # conflict matrix) and marks every probed row's comparison
+            # basis — probed as a candidate, not rejected up front
+            error_feedback=_ef,
             context={
                 "network": args.network, "dataset": args.dataset,
                 "code": args.code, "seed": args.seed,
@@ -1900,9 +2023,15 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
     win = doc.get("winner") or {}
     knobs = win.get("knobs") or {}
     if not knobs:
+        if is_ctl:
+            from atomo_tpu.controller import controller_path as _cpath
+
+            art = _cpath(args.train_dir)
+        else:
+            art = decision_path(args.train_dir)
         raise SystemExit(
-            "--auto tune produced no viable candidate (see "
-            f"{decision_path(args.train_dir)})"
+            f"--auto {args.auto} produced no viable candidate (see "
+            f"{art})"
         )
     if n_dev > 1:
         args.aggregate = knobs.get("aggregate", "gather")
@@ -1931,7 +2060,29 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         args.quorum = str(int(knobs["quorum"]))
         args.staleness = int(knobs.get("staleness", 1))
     superstep = max(int(knobs.get("superstep", 1)), 1)
-    print(f"--auto tune -> {win.get('name')} ({doc.get('why')})", flush=True)
+    print(
+        f"--auto {args.auto} -> {win.get('name')} ({doc.get('why')})",
+        flush=True,
+    )
+    # a joint +sp+ab winner executes the hybrid plan RE-PLANNED under
+    # the budget-wrapped codec (the crossover moves when per-leaf wire
+    # bytes move) — the same deterministic plan_hybrid the controller
+    # priced; cmd_train applies it via _tuned_hybrid_ab
+    run_hybrid = sparse_plan
+    if (
+        is_ctl and budget_ctx is not None and hybrid_inputs
+        and knobs.get("sparse_rows") == "on"
+        and knobs.get("budget_alloc") == "variance"
+    ):
+        from atomo_tpu.sparse.hybrid import plan_hybrid
+
+        run_hybrid = plan_hybrid(
+            budget_ctx["codec"],
+            hybrid_inputs["grads_like"],
+            hybrid_inputs["densities"],
+            hybrid_inputs["row_bounds"],
+        )
+        args._tuned_hybrid_ab = run_hybrid
 
     # online re-tune (rung 0.5): needs a checkpoint cadence to snap the
     # re-probe to. The re-pickable knob is the gather<->ring pair (the
@@ -1969,8 +2120,10 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
                 grad_accum=args.grad_accum, compute_dtype=compute_dtype,
                 ring_bucket_size=args.ring_bucket_size,
                 # a +sp winner's gather<->ring re-probe must time the
-                # hybrid program the run actually dispatches
-                hybrid=sparse_plan,
+                # hybrid program the run actually dispatches (the
+                # +sp+ab re-planned one under the controller)
+                hybrid=run_hybrid,
+                error_feedback=_ef,
             )
             return row["measured_ms_per_step"]
 
@@ -1996,7 +2149,7 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         def on_fabric_moved(doc, _dir=args.train_dir):
             path = write_fabric_probe(_dir, doc)
             print(
-                f"Autopilot: fabric moved — {path} re-written from the "
+                f"{tag}: fabric moved — {path} re-written from the "
                 "re-probe (meta.reps says it was the quick ladder)",
                 flush=True,
             )
@@ -2006,7 +2159,18 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             fabric_baseline=measured_bandwidths(probe_doc),
             on_fabric_moved=on_fabric_moved,
         )
-    return superstep, OnlineRetuner(probe_fn=probe_fn, **fabric_kw)
+    inner = OnlineRetuner(probe_fn=probe_fn, **fabric_kw)
+    if is_ctl:
+        # one re-solve loop: the drift retuner (and, when cmd_train arms
+        # it, the budget retuner) composed behind one object — every
+        # applied change is one controller_redecide incident quoting the
+        # old/new knob vector (the ISSUE-17 online half)
+        from atomo_tpu.controller import ControllerRetuner
+
+        return superstep, ControllerRetuner(
+            tuner=inner, knobs=dict(knobs)
+        )
+    return superstep, inner
 
 
 def _recorder_tier_ms(args, n_dev, model, train_iter, codec):
@@ -2280,6 +2444,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         except ValueError as exc:
             raise SystemExit(str(exc)) from None
     sparse_plan = None
+    hybrid_inputs = None  # plan_hybrid's argument triple (controller +sp+ab)
     if args.sparse_rows != "off":
         if n_dev <= 1:
             # the argv-ambiguous half of the preflight mesh check
@@ -2308,16 +2473,35 @@ def cmd_train(args: argparse.Namespace) -> int:
             # training arrays (never epoch(): pulling a batch would
             # advance the shuffle RNG — the --aggregate auto precedent)
             from atomo_tpu.codecs import DenseCodec
-            from atomo_tpu.sparse import plan_for_model
+            from atomo_tpu.sparse.hybrid import (
+                infer_row_bounds,
+                measured_densities,
+                plan_hybrid,
+                probe_gradient,
+            )
 
             plan_codec = codec if codec is not None else DenseCodec()
             probe_n = min(max(args.batch_size, 8), len(train_iter.images))
-            plan = plan_for_model(
-                plan_codec, model,
+            # plan_for_model's composition, inlined so the measured
+            # triple survives: the controller re-plans the crossover
+            # under the budget-wrapped codec (+sp+ab) from the SAME
+            # probe inputs — deterministic, one probe gradient
+            _grads = probe_gradient(
+                model,
                 train_iter.images[:probe_n], train_iter.labels[:probe_n],
-                batch_per_chip=max(args.batch_size // n_dev, 1),
-                slots=int(train_iter.images.shape[1]),
             )
+            _densities = measured_densities(_grads)
+            _row_bounds = infer_row_bounds(
+                _grads, max(args.batch_size // n_dev, 1),
+                int(train_iter.images.shape[1]),
+            )
+            plan = plan_hybrid(plan_codec, _grads, _densities, _row_bounds)
+            if args.auto == "controller":
+                hybrid_inputs = {
+                    "grads_like": _grads,
+                    "densities": _densities,
+                    "row_bounds": _row_bounds,
+                }
             if plan.any_sparse:
                 sparse_plan = plan
                 print(plan.describe(), flush=True)
@@ -2421,17 +2605,18 @@ def cmd_train(args: argparse.Namespace) -> int:
                 codec, spectra, alloc.ks
             ),
         }
-        if args.auto != "tune":
+        if args.auto not in ("tune", "controller"):
             # pinned variance mode: the wrapped codec IS the run's codec
-            # (under --auto tune the +ab candidates compete and the
-            # measured winner decides below)
+            # (under --auto tune/controller the +ab candidates compete
+            # and the measured winner decides below)
             codec = wrapped
     tuner = None
-    if args.auto == "tune":
+    if args.auto in ("tune", "controller"):
         superstep, tuner = _run_autopilot(args, model, optimizer, codec,
                                           train_iter, n_dev, save_freq,
                                           sparse_plan=sparse_plan,
-                                          budget_ctx=budget_ctx)
+                                          budget_ctx=budget_ctx,
+                                          hybrid_inputs=hybrid_inputs)
         if budget_ctx is not None:
             if getattr(args, "_tuned_budget", "off") == "variance":
                 codec = budget_ctx["codec"]
@@ -2450,11 +2635,15 @@ def cmd_train(args: argparse.Namespace) -> int:
                 )
     hybrid_plan = None
     if sparse_plan is not None:
-        if args.auto == "tune":
+        if args.auto in ("tune", "controller"):
             # the +sp candidates competed in the probe ladder; the
-            # winner's knob decides (measured, not assumed)
+            # winner's knob decides (measured, not assumed). A joint
+            # +sp+ab winner executes the crossover re-planned under the
+            # budget-wrapped codec (_run_autopilot recorded it)
             if getattr(args, "_tuned_sparse", "off") == "on":
-                hybrid_plan = sparse_plan
+                hybrid_plan = (
+                    getattr(args, "_tuned_hybrid_ab", None) or sparse_plan
+                )
         else:
             hybrid_plan = sparse_plan
         if hybrid_plan is not None and codec is None:
@@ -2569,7 +2758,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         # fabricate a calibration series for a program it never priced
         pred_ms = (
             resolve_predicted_ms(args.train_dir)
-            if args.auto == "tune"
+            if args.auto in ("tune", "controller")
             else None
         )
         tier_ms = None
@@ -2628,6 +2817,20 @@ def cmd_train(args: argparse.Namespace) -> int:
                 "incidents.jsonl as budget_realloc)",
                 flush=True,
             )
+            if args.auto == "controller" and tuner is not None:
+                # ONE re-solve loop: fold the budget reactor into the
+                # ControllerRetuner so drift and allocation re-decisions
+                # share one knob vector and one controller_redecide
+                # incident stream (the loop sees a single object as
+                # both tuner= and budget_tuner=)
+                tuner.budget_tuner = budget_tuner
+                budget_tuner = tuner
+                print(
+                    "Controller: online re-solve loop armed (drift + "
+                    "allocation reactors composed; applied changes land "
+                    "as controller_redecide)",
+                    flush=True,
+                )
         else:
             print(
                 "Budget: allocation frozen for this run"

@@ -164,6 +164,25 @@ class QsgdCodec:
     def levels(self) -> int:
         return (1 << self.bits) - 1
 
+    def leaf_payload_bytes(self, grad_shape: tuple[int, ...]) -> int:
+        """Static wire bytes of one leaf's payload — the analytic twin of
+        ``jax.eval_shape`` over :meth:`encode` (pinned equal in
+        tests/test_comm_model.py, the SvdCodec precedent): per bucket,
+        ``padded_bucket/vals_per_word`` uint32 words plus one float32
+        scale. No dense fallback exists in this wire format — a leaf
+        whose quantized payload exceeds its dense bytes still ships
+        quantized (the budget allocator simply refuses to buy bits past
+        that point)."""
+        n = 1
+        for d in grad_shape:
+            n *= int(d)
+        b = self.bucket_size
+        n_buckets = -(-n // b)
+        words_per_bucket = padded_bucket(b, self.bits) // _vals_per_word(
+            self.bits
+        )
+        return n_buckets * words_per_bucket * 4 + n_buckets * 4
+
     def _pallas(self) -> bool:
         """use_pallas=None resolves to the jnp path EVERYWHERE (round-4
         default flip, VERDICT r3 weak #3/next-round #4): on the real v5e

@@ -1,0 +1,38 @@
+"""Global controller — one priced decision space, one artifact, one
+re-solve loop (ISSUE-17 tentpole).
+
+  * :mod:`~atomo_tpu.controller.space` — the decision-space grammar:
+    the joint cross-term candidates the single deciders never priced,
+    and the subspace restriction behind the degeneracy guarantees.
+  * :mod:`~atomo_tpu.controller.solve` — the startup joint solve:
+    the pure legacy solvers (water-filling allocation, hybrid
+    crossover, plan ranking, quorum pricing) composed as subroutines
+    inside one ``predict_step_s``-ranked enumeration, probed through
+    the existing harness.
+  * :mod:`~atomo_tpu.controller.artifact` —
+    ``controller_decision.json``: the one resume source of truth,
+    superseding ``tune_decision.json`` + ``budget_alloc.json`` under
+    refuse-on-mismatch (legacy artifacts read with a stated fallback).
+  * :mod:`~atomo_tpu.controller.online` — :class:`ControllerRetuner`:
+    the drift and budget reactors composed behind one object; every
+    applied change is one ``controller_redecide`` incident.
+"""
+
+from atomo_tpu.controller.artifact import (  # noqa: F401
+    CONTROLLER_DECISION_NAME,
+    controller_path,
+    controller_reusable,
+    load_resume_decision,
+    read_controller,
+)
+from atomo_tpu.controller.online import ControllerRetuner  # noqa: F401
+from atomo_tpu.controller.solve import (  # noqa: F401
+    pack_kernel_record,
+    solve_controller,
+)
+from atomo_tpu.controller.space import (  # noqa: F401
+    DECIDERS,
+    candidate_predicate,
+    joint_candidates,
+    normalize_deciders,
+)

@@ -1,0 +1,145 @@
+"""``controller_decision.json`` — the ONE decision artifact.
+
+The controller supersedes the two resume sources of truth the repo grew
+separately (``tune_decision.json`` for the autopilot's knob vector,
+``budget_alloc.json`` for the per-leaf allocation) with a single
+document: the full probe ladder (the ``tune_decision`` row shape,
+``kind: "controller_decision"``), one winner knob vector spanning every
+decider's axes, and — in ``meta`` so they land atomically with the
+FIRST row, not in a post-finish rewrite a kill could lose —
+``meta.controller`` (deciders searched, pack-kernel resolution),
+``meta.allocation`` (the solved per-leaf knob epoch the ``+ab`` knob
+resolves against on resume) and ``meta.hybrid`` (the per-leaf
+assignment the ``+sp`` knob resolves against).
+
+Resume discipline is the ``decision_reusable`` family, composed:
+:func:`controller_reusable` refuses on everything the tune check
+refuses on (no winner, world/mesh/quorum mismatch) PLUS a knob vector
+whose ``budget_alloc``/``sparse_rows`` entries reference meta sections
+the artifact does not carry. LEGACY FALLBACK (stated, never silent):
+:func:`load_resume_decision` prefers ``controller_decision.json``; when
+a train_dir predates the controller it falls back to reading
+``tune_decision.json`` (+ ``budget_alloc.json`` for the allocation)
+and says so — old runs keep resuming, new runs write one artifact.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from atomo_tpu.tuning.autopilot import (
+    TUNE_DECISION_NAME,
+    decision_reusable,
+)
+
+CONTROLLER_DECISION_NAME = "controller_decision.json"
+
+
+def controller_path(train_dir: str) -> str:
+    return os.path.join(train_dir, CONTROLLER_DECISION_NAME)
+
+
+def read_controller(train_dir: Optional[str]) -> Optional[dict]:
+    """Parse controller_decision.json; missing/unparseable -> None (the
+    caller re-solves from scratch and says so)."""
+    if not train_dir:
+        return None
+    try:
+        with open(controller_path(train_dir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def controller_reusable(
+    doc,
+    *,
+    n_dev: int,
+    mesh_axes: Optional[dict] = None,
+    quorum: Optional[int] = None,
+    staleness: Optional[int] = None,
+) -> tuple:
+    """Can a ``--resume`` reuse this recorded controller decision?
+
+    Composes :func:`~atomo_tpu.tuning.autopilot.decision_reusable`
+    (world size, mesh shape, quorum pinning — one validity law, not a
+    fork of it) with the controller's own closure condition: a winner
+    whose knob vector turns on ``budget_alloc`` or ``sparse_rows`` is
+    only executable if the artifact carries the meta section that knob
+    resolves against. Returns ``(reusable, reason)``; pure function of
+    the document (tested), like its parents."""
+    if doc and doc.get("kind") != "controller_decision":
+        return False, (
+            f"artifact kind is {doc.get('kind')!r}, not a controller "
+            "decision — re-solving"
+        )
+    ok, reason = decision_reusable(
+        doc, n_dev=n_dev, mesh_axes=mesh_axes,
+        quorum=quorum, staleness=staleness,
+    )
+    if not ok:
+        return ok, reason
+    knobs = ((doc.get("winner") or {}).get("knobs")) or {}
+    meta = doc.get("meta") or {}
+    if knobs.get("budget_alloc") == "variance" and not (
+        (meta.get("allocation") or {}).get("ks")
+    ):
+        return False, (
+            "winner pins budget_alloc=variance but the artifact carries "
+            "no meta.allocation.ks to rebuild the per-leaf codec from — "
+            "re-solving"
+        )
+    if knobs.get("sparse_rows") == "on" and not (
+        (meta.get("hybrid") or {}).get("assignments")
+    ):
+        return False, (
+            "winner pins sparse_rows=on but the artifact carries no "
+            "meta.hybrid assignment to rebuild the exchange plan from — "
+            "re-solving"
+        )
+    return True, reason
+
+
+def load_resume_decision(
+    train_dir: Optional[str], log_fn=print
+) -> tuple:
+    """The resume read path with the STATED legacy fallback: returns
+    ``(doc, source)`` where source is ``"controller"`` for
+    controller_decision.json, ``"legacy"`` for a tune_decision.json
+    (with any budget_alloc.json allocation grafted into
+    ``meta.allocation`` so the one resume code path downstream reads
+    one shape), or ``(None, "none")``. The fallback is logged — a run
+    resuming from pre-controller artifacts should say so, not pass as a
+    controller run."""
+    doc = read_controller(train_dir)
+    if doc is not None:
+        return doc, "controller"
+    if not train_dir:
+        return None, "none"
+    try:
+        with open(os.path.join(train_dir, TUNE_DECISION_NAME)) as f:
+            legacy = json.load(f)
+    except (OSError, ValueError):
+        return None, "none"
+    log_fn(
+        "Controller: no controller_decision.json in this train_dir; "
+        "falling back to the legacy tune_decision.json"
+        " (pre-controller run — its knob vector is honored as-is)"
+    )
+    from atomo_tpu.budget.artifact import latest_epoch, read_alloc
+
+    ep = latest_epoch(read_alloc(train_dir))
+    if ep and ep.get("ks"):
+        meta = legacy.setdefault("meta", {})
+        meta.setdefault(
+            "allocation",
+            {"epoch": ep.get("epoch"), "ks": ep.get("ks"),
+             "source": "budget_alloc.json (legacy fallback)"},
+        )
+        log_fn(
+            "Controller: grafted the legacy budget_alloc.json epoch "
+            f"{ep.get('epoch')} into the decision's allocation view"
+        )
+    return legacy, "legacy"

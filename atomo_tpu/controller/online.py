@@ -1,0 +1,174 @@
+"""One re-solve loop — the controller's online half.
+
+The repo had grown three independent online reactors: the drift
+retuner (``tuning.autopilot.OnlineRetuner`` — step-time drift →
+gather/ring re-probe), the budget retuner (``budget.retune`` —
+q_err2 drift → re-allocation) and the hybrid re-plan (deferred to
+restart by design: the assignment changes payload shapes AND the
+trajectory class). Each logged its own incident family and re-decided
+on its own trigger; nobody owned the joint knob vector.
+
+:class:`ControllerRetuner` subsumes them by COMPOSITION, not
+replacement: the inner reactors keep their signals, their hysteresis
+gates and their incident records (``perf_drift``, ``budget_realloc`` —
+the report's existing checks stay meaningful), and the controller
+wraps each APPLIED change in one ``controller_redecide`` incident
+quoting the old/new knob vector and the step-time/variance evidence
+both ways — the single audit stream the ISSUE-17 artifact story needs.
+Flight-recorder feeding is unchanged: the loop's existing retune hooks
+(``tuner.observe`` / ``tuner.maybe_retune`` /
+``budget_tuner.maybe_realloc``) all land on this one object, which
+satisfies BOTH protocols, so the loop wiring (replicated.py) did not
+fork.
+
+Re-decisions stay checkpoint-boundary-gated and hysteresis-gated
+because the inner reactors already are (drift patience, budget
+``min_gain``); the controller adds no second trigger — one change, one
+boundary, one incident. A hybrid re-plan remains restart-territory and
+the redecide record for any other change says so (``hybrid_note``)
+instead of pretending the axis is online-movable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+HYBRID_NOTE = (
+    "hybrid assignment is not online-movable (payload shapes and "
+    "trajectory class change); re-plan happens at restart from the "
+    "controller artifact"
+)
+
+
+class ControllerRetuner:
+    """Compose the drift retuner + budget retuner behind one object
+    satisfying both loop protocols (module docstring). Either inner
+    reactor may be None — the corresponding axis is then simply not
+    re-decided online, exactly as before the controller existed."""
+
+    def __init__(
+        self,
+        *,
+        tuner=None,
+        budget_tuner=None,
+        knobs: Optional[dict] = None,
+        incidents=None,
+        log_fn=print,
+    ):
+        self.tuner = tuner
+        self.budget_tuner = budget_tuner
+        # the decision's winner knob vector, kept current as re-decisions
+        # apply — the redecide incidents quote it whole, old and new
+        self.knobs = dict(knobs or {})
+        self.incidents = incidents
+        self.log_fn = log_fn
+        self.redecisions = 0
+        self._last_probe_ms: dict = {}
+        if tuner is not None and tuner.probe_fn is not None:
+            orig = tuner.probe_fn
+
+            def _recording_probe(mode):
+                v = float(orig(mode))
+                self._last_probe_ms[mode] = round(v, 4)
+                return v
+
+            tuner.probe_fn = _recording_probe
+
+    # -- shared protocol plumbing -------------------------------------
+    def bind(self, incidents=None, recorder=None, log_fn=None):
+        """Late-bind loop-owned sinks; forwards to both inner reactors
+        (the loop calls this once as ``tuner`` and once as
+        ``budget_tuner`` — idempotent by construction)."""
+        if incidents is not None:
+            self.incidents = incidents
+        if log_fn is not None:
+            self.log_fn = log_fn
+        if self.tuner is not None:
+            self.tuner.bind(incidents=incidents, log_fn=log_fn)
+        if self.budget_tuner is not None:
+            self.budget_tuner.bind(
+                incidents=incidents, recorder=recorder, log_fn=log_fn
+            )
+        return self
+
+    def _redecide(self, step, axis, old_knobs, new_knobs, evidence):
+        self.redecisions += 1
+        if self.incidents is not None:
+            self.incidents.append(
+                "controller_redecide",
+                step=int(step),
+                axis=axis,
+                knobs_old=old_knobs,
+                knobs_new=new_knobs,
+                evidence=evidence,
+                hybrid_note=HYBRID_NOTE,
+            )
+        self.log_fn(
+            f"Controller: re-decision at step {step} on the {axis} axis: "
+            f"{old_knobs} -> {new_knobs}"
+        )
+
+    # -- OnlineRetuner protocol (the loop's ``tuner=``) ---------------
+    @property
+    def pending(self):
+        return self.tuner.pending if self.tuner is not None else None
+
+    @property
+    def state(self):
+        return self.tuner.state if self.tuner is not None else None
+
+    def observe(self, dts):
+        if self.tuner is None:
+            return None
+        return self.tuner.observe(dts)
+
+    def maybe_retune(self, step: int, current_mode: str):
+        if self.tuner is None:
+            return None
+        self._last_probe_ms = {}
+        new_mode = self.tuner.maybe_retune(step, current_mode)
+        if new_mode is not None:
+            old = dict(self.knobs)
+            self.knobs = {**self.knobs, "aggregate": new_mode}
+            self._redecide(
+                step, "aggregate", old, dict(self.knobs),
+                evidence={
+                    "probed_ms_per_step": dict(self._last_probe_ms),
+                    "old_mode_ms": self._last_probe_ms.get(current_mode),
+                    "new_mode_ms": self._last_probe_ms.get(new_mode),
+                },
+            )
+        return new_mode
+
+    # -- BudgetRetuner protocol (the loop's ``budget_tuner=``) --------
+    def maybe_realloc(self, step: int):
+        if self.budget_tuner is None:
+            return None
+        old_ks = list(self.budget_tuner.alloc.ks)
+        old_var = float(self.budget_tuner.alloc.predicted_variance)
+        new_codec = self.budget_tuner.maybe_realloc(step)
+        if new_codec is not None:
+            new = self.budget_tuner.alloc
+            old = dict(self.knobs)
+            self.knobs = {
+                **self.knobs,
+                "budget_alloc": "variance",
+                "budget_epoch": int(new.epoch),
+            }
+            self._redecide(
+                step, "allocation", old, dict(self.knobs),
+                evidence={
+                    "ks_old": [int(k) for k in old_ks],
+                    "ks_new": [int(k) for k in new.ks],
+                    "predicted_variance_old": round(old_var, 8),
+                    "predicted_variance_new": round(
+                        float(new.predicted_variance), 8
+                    ),
+                    "basis": (
+                        "each variance under its own solve's spectra; "
+                        "the paired budget_realloc incident quotes the "
+                        "apples-to-apples pair under fresh spectra"
+                    ),
+                },
+            )
+        return new_codec

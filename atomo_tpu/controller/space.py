@@ -1,0 +1,183 @@
+"""The controller's decision-space grammar.
+
+One PRICED space over every performance knob the repo grew one decider
+at a time: aggregate + overlap + superstep + ring bucket + stream
+buckets (the autopilot's axes), the topology plan (two-tier meshes),
+the per-leaf rank/bit allocation (the variance budget), the per-layer
+sparse-row representation (the hybrid planner), and the quorum/
+staleness pair. The GRAMMAR is ``comm_model.candidate_name``'s suffix
+algebra — ``<agg>+<overlap>[+se][+sp][+ab][+qK]+k<K>[+b<N>]`` with
+``hier[<plan>]`` replacing the flat aggregate on two-tier candidates —
+and this module contributes two pure pieces:
+
+  * :func:`joint_candidates` — the CROSS TERMS the single deciders
+    never enumerate (``+sp+ab``, ``+ab+se``, ``+ab`` under delayed
+    overlap, ``+ab`` under each hierarchical plan, ``+ab+qK``), each
+    carrying its own per-leaf ``leaf_budgets`` pricing override where
+    the shared ranking inputs cannot express it. They ride the SAME
+    ``predict_step_s``-ranked ladder as the enumerated space — one
+    ordering decides who gets probed, not four independent winners.
+  * :func:`candidate_predicate` — subspace restriction: confining the
+    search to one legacy decider's knob axes must reproduce that
+    decider's winner bit-identically (the degeneracy tests), which is
+    what makes the controller a superset of the old paths rather than
+    a fifth opinion.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+DECIDERS = ("autopilot", "budget", "hybrid", "topology")
+
+
+def normalize_deciders(deciders: Optional[Iterable[str]]) -> frozenset:
+    """Validated decider set; ``None`` = the full joint space."""
+    if deciders is None:
+        return frozenset(DECIDERS)
+    out = frozenset(str(d) for d in deciders)
+    bad = out - frozenset(DECIDERS)
+    if bad:
+        raise ValueError(
+            f"unknown decider(s) {sorted(bad)}; the decision space is "
+            f"composed of {DECIDERS}"
+        )
+    if not out:
+        raise ValueError("the decider set must name at least one axis")
+    return out
+
+
+def candidate_predicate(
+    deciders: Iterable[str],
+) -> Optional[Callable[[dict], bool]]:
+    """The subspace restriction as a candidate predicate (``None`` for
+    the full space — no filtering, zero overhead on the default path).
+
+    Excluding a decider removes its knob axis: no ``topology`` drops
+    hierarchical candidates, no ``hybrid`` drops ``+sp``, no ``budget``
+    drops ``+ab``. Excluding ``autopilot`` freezes ITS axes at the
+    degenerate point (blocking, superstep 1, no stream, no quorum,
+    gather — or hierarchical-only when topology is the surviving
+    decider), which is exactly what the budget-only / hybrid-only /
+    topology-only degeneracy tests pin against the standalone solvers.
+    """
+    d = normalize_deciders(deciders)
+    if d == frozenset(DECIDERS):
+        return None
+
+    def pred(cand: dict) -> bool:
+        if "topology" not in d and cand.get("aggregate") == "hierarchical":
+            return False
+        if "hybrid" not in d and cand.get("sparse_rows") == "on":
+            return False
+        if "budget" not in d and cand.get("budget_alloc") == "variance":
+            return False
+        if "autopilot" not in d:
+            if cand.get("overlap", "off") != "off":
+                return False
+            if int(cand.get("superstep", 1)) != 1:
+                return False
+            if cand.get("stream_encode") == "on" or cand.get("quorum"):
+                return False
+            if d == frozenset({"topology"}):
+                return cand.get("aggregate") == "hierarchical"
+            if cand.get("aggregate") not in ("gather", "hierarchical"):
+                return False
+        return True
+
+    return pred
+
+
+def joint_candidates(
+    *,
+    deciders: Iterable[str],
+    allow_ring: bool = True,
+    ring_bucket_size: int = 65536,
+    have_budget: bool = False,
+    have_sparse: bool = False,
+    sparse_ab_leaf_budgets=None,
+    allow_overlap: bool = True,
+    allow_stream: bool = False,
+    stream_bucket_bytes: int = 4 << 20,
+    stream_buckets: int = 0,
+    two_tier: bool = False,
+    plan_names=None,
+    allow_quorum: bool = False,
+    quorum_q: int = 0,
+    quorum_staleness_options=(1, 2),
+) -> list[dict]:
+    """The joint cross-term candidates (module docstring), named through
+    the one grammar (``candidate_name``) so the decision artifact reads
+    like the enumerated rows. Pure and deterministic — same inputs,
+    same list, same order.
+
+    ``sparse_ab_leaf_budgets`` (the hybrid plan RE-PLANNED under the
+    budget-wrapped codec, ``HybridPlan.leaf_budgets()``) is required for
+    the ``+sp+ab`` cross term: its wire is neither the base hybrid's nor
+    the allocation's, so the candidate carries the override
+    ``predict_step_s`` prices first. The other ``+ab`` cross terms price
+    through the ranking call's ``budget_leaf_budgets`` — the same sums
+    the wrapped codec's executed program reports."""
+    from atomo_tpu.utils.comm_model import candidate_name
+
+    d = normalize_deciders(deciders)
+    have_budget = bool(have_budget and "budget" in d)
+    have_sparse = bool(have_sparse and "hybrid" in d)
+    out: list[dict] = []
+    aggs = ["gather"] + (["ring"] if allow_ring else [])
+    for agg in aggs:
+        base = {"aggregate": agg, "overlap": "off", "superstep": 1}
+        if agg == "ring":
+            base["ring_bucket_size"] = int(ring_bucket_size)
+        if have_budget and have_sparse and sparse_ab_leaf_budgets:
+            out.append({
+                **base,
+                "sparse_rows": "on",
+                "budget_alloc": "variance",
+                "leaf_budgets": [
+                    (int(a), int(b)) for a, b in sparse_ab_leaf_budgets
+                ],
+            })
+        if have_budget and allow_stream:
+            c = {
+                **base,
+                "stream_encode": "on",
+                "stream_bucket_bytes": int(stream_bucket_bytes),
+                "budget_alloc": "variance",
+            }
+            if stream_buckets > 0:
+                c["stream_buckets"] = int(stream_buckets)
+            out.append(c)
+        if have_budget and allow_overlap:
+            out.append(
+                {**base, "overlap": "delayed", "budget_alloc": "variance"}
+            )
+        if (
+            have_budget
+            and allow_quorum
+            and int(quorum_q) >= 1
+            and "autopilot" in d
+        ):
+            for st in sorted(
+                {max(int(s), 1) for s in quorum_staleness_options}
+            ):
+                out.append({
+                    **base,
+                    "quorum": int(quorum_q),
+                    "staleness": st,
+                    "budget_alloc": "variance",
+                })
+    if have_budget and two_tier and "topology" in d:
+        from atomo_tpu.topology.schedule import PLAN_NAMES
+
+        for pname in PLAN_NAMES if plan_names is None else tuple(plan_names):
+            out.append({
+                "aggregate": "hierarchical",
+                "plan": pname,
+                "overlap": "off",
+                "superstep": 1,
+                "budget_alloc": "variance",
+            })
+    for c in out:
+        c["name"] = candidate_name(c)
+    return out

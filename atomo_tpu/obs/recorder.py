@@ -82,19 +82,24 @@ def metrics_path(train_dir: str) -> str:
 
 
 def resolve_predicted_ms(train_dir: Optional[str]) -> Optional[float]:
-    """The calibration column's reference: the autopilot winner's
-    predicted ms/step from ``train_dir/tune_decision.json`` when a tune
-    ran, else None (no prediction -> no calibration column; the recorder
-    never invents a model the run did not use)."""
+    """The calibration column's reference: the decision winner's
+    predicted ms/step — from ``train_dir/controller_decision.json`` when
+    the global controller solved (the superseding artifact), else
+    ``tune_decision.json``, else None (no prediction -> no calibration
+    column; the recorder never invents a model the run did not use)."""
     if not train_dir:
         return None
+    from atomo_tpu.controller.artifact import controller_path
     from atomo_tpu.tuning.autopilot import decision_path
 
-    try:
-        with open(decision_path(train_dir)) as f:
-            doc = json.load(f)
-    except (OSError, ValueError):
-        return None
+    doc = None
+    for path in (controller_path(train_dir), decision_path(train_dir)):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            break
+        except (OSError, ValueError):
+            continue
     win = (doc or {}).get("winner") or {}
     pred = win.get("predicted_ms_per_step")
     return float(pred) if isinstance(pred, (int, float)) and pred > 0 else None

@@ -44,6 +44,13 @@ artifacts audit each other instead of being trusted independently:
     pins, and every DROPPED entry has its matching
     ``staleness_exceeded`` incident (a drop without an incident is a
     silent stale apply — the thing the staleness contract forbids).
+  * ``controller_decision_consistent`` — ``controller_decision.json``
+    is closed over its own meta sections (a knob pinning
+    ``budget_alloc``/``sparse_rows`` carries the allocation/assignment
+    it resolves against), is not contradicted by the superseded
+    ``tune_decision.json``/``budget_alloc.json`` on any shared knob
+    axis, and its ``controller_redecide`` incidents chain old->new
+    without gaps (``--strict`` exits 3 on a contradicted knob vector).
 
 A check whose source artifact is absent is SKIPPED (reported, not
 failed): a run without elastic has no membership to agree with.
@@ -619,6 +626,109 @@ def _check_drift_blame(incidents) -> dict:
     )
 
 
+def _check_controller_decision(ctl, tune, budget_doc, incidents) -> dict:
+    """``controller_decision_consistent`` — the controller's ONE
+    artifact must not be contradicted by the artifacts it supersedes or
+    by its own audit stream (``--report --strict`` exits 3 on a
+    contradicted knob vector, like every other check):
+
+      * closure: a winner knob vector pinning ``budget_alloc=variance``
+        / ``sparse_rows=on`` must carry the ``meta.allocation`` /
+        ``meta.hybrid`` section that knob resolves against on resume;
+      * supersession: a coexisting legacy ``tune_decision.json`` (or
+        ``budget_alloc.json`` epoch 0) that disagrees with the
+        controller's winner on a shared knob axis means two artifacts
+        claim to be the source of truth — exactly what the controller
+        exists to prevent;
+      * the re-solve audit: ``controller_redecide`` incidents chain —
+        each one's ``knobs_old`` is the previous one's ``knobs_new``,
+        and the first chains off the recorded winner.
+
+    Skipped when the run has no controller decision."""
+    name = "controller_decision_consistent"
+    if not ctl:
+        return _check(
+            name, True, "no controller decision recorded", skipped=True
+        )
+    bad = []
+    if not ctl.get("complete"):
+        bad.append("controller_decision.json is incomplete (solve died "
+                   "mid-ladder)")
+    knobs = ((ctl.get("winner") or {}).get("knobs")) or {}
+    meta = ctl.get("meta") or {}
+    if not knobs:
+        bad.append("controller decision records no winner knob vector")
+    if knobs.get("budget_alloc") == "variance" and not (
+        (meta.get("allocation") or {}).get("ks")
+    ):
+        bad.append(
+            "winner pins budget_alloc=variance but the artifact carries "
+            "no meta.allocation.ks"
+        )
+    if knobs.get("sparse_rows") == "on" and not (
+        (meta.get("hybrid") or {}).get("assignments")
+    ):
+        bad.append(
+            "winner pins sparse_rows=on but the artifact carries no "
+            "meta.hybrid assignment"
+        )
+    if tune is not None:
+        legacy = ((tune.get("winner") or {}).get("knobs")) or {}
+        for k in sorted(set(knobs) & set(legacy)):
+            if knobs[k] != legacy[k]:
+                bad.append(
+                    f"superseded tune_decision.json contradicts the "
+                    f"controller on {k!r}: {legacy[k]!r} vs {knobs[k]!r} "
+                    "— two artifacts claim the knob vector"
+                )
+    if budget_doc and (meta.get("allocation") or {}).get("ks"):
+        ep0 = next(
+            (e for e in budget_doc.get("epochs", [])
+             if int(e.get("epoch", -1)) == int(
+                 meta["allocation"].get("epoch", 0))),
+            None,
+        )
+        if ep0 is not None:
+            art_ks = [int(k) for k in ep0.get("ks") or []]
+            ctl_ks = [int(k) for k in meta["allocation"]["ks"]]
+            if art_ks and art_ks != ctl_ks:
+                bad.append(
+                    "legacy budget_alloc.json epoch "
+                    f"{meta['allocation'].get('epoch', 0)} records ks="
+                    f"{art_ks} but the controller decision says {ctl_ks}"
+                )
+    redecides = [
+        r for r in incidents if r.get("cause") == "controller_redecide"
+    ]
+    prev = {k: v for k, v in knobs.items()}
+    for r in redecides:
+        old = r.get("knobs_old") or {}
+        new = r.get("knobs_new") or {}
+        where = f"controller_redecide at step {r.get('step')}"
+        if not old or not new:
+            bad.append(f"{where} quotes no old/new knob vector")
+            continue
+        mismatched = {
+            k for k in set(prev) & set(old) if prev[k] != old[k]
+        }
+        if mismatched:
+            bad.append(
+                f"{where}: knobs_old disagrees with the preceding "
+                f"decision on {sorted(mismatched)} — the audit chain "
+                "is broken"
+            )
+        prev = new
+    return _check(
+        name,
+        not bad,
+        "; ".join(bad[:5])
+        or (
+            "one decision artifact, knob vector closed over its meta "
+            f"sections, {len(redecides)} re-decision(s) chained"
+        ),
+    )
+
+
 def build_report(train_dir: str) -> dict:
     """Join the run's artifacts into the report document (see module
     docstring). Pure read — writing run_report.json is the caller's move
@@ -652,6 +762,9 @@ def build_report(train_dir: str) -> dict:
     from atomo_tpu.quorum.artifact import read_schedule, schedule_path
 
     sched_meta, sched_arrivals = read_schedule(schedule_path(train_dir))
+    from atomo_tpu.controller.artifact import read_controller
+
+    ctl = read_controller(train_dir)
 
     events: list[dict] = []
     events.extend(_segments(steps))
@@ -713,6 +826,7 @@ def build_report(train_dir: str) -> dict:
         _check_budget_alloc(steps, metas, budget_doc),
         _check_quorum_schedule(steps, incidents, sched_meta,
                                sched_arrivals),
+        _check_controller_decision(ctl, tune, budget_doc, incidents),
     ]
     consistent = all(c["ok"] for c in checks)
     summary = {
@@ -736,6 +850,7 @@ def build_report(train_dir: str) -> dict:
             "fabric_probe_json": fabric_probe is not None,
             "budget_alloc_json": budget_doc is not None,
             "arrival_schedule_jsonl": len(sched_arrivals),
+            "controller_decision_json": ctl is not None,
         },
         "summary": summary,
         "timeline": events,

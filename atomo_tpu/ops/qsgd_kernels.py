@@ -82,17 +82,30 @@ PACK_KERNEL_MEASURED_WINS: dict = {
 }
 
 
-def pack_kernel_default() -> bool:
+def pack_kernel_default(
+    device_kind: Optional[str] = None, on_tpu: Optional[bool] = None
+) -> bool:
     """Resolve ``QsgdCodec.pack_kernel=None``: True only on a real TPU
     whose device kind has a measured win recorded in
     :data:`PACK_KERNEL_MEASURED_WINS`; False (the jnp oracle) everywhere
-    else — off-TPU backends fall back automatically by construction."""
-    if not is_tpu():
+    else — off-TPU backends fall back automatically by construction.
+
+    ``device_kind``/``on_tpu`` default to the live backend; passing them
+    explicitly is the graduation DRILL (tests and the controller's
+    pack-kernel pricing): a synthetic win recorded for a device-kind
+    substring must flip this default for that kind — and only that kind
+    — without any code-path change. The measurement procedure that earns
+    a real entry is documented in README "Graduating the pack kernel"."""
+    if on_tpu is None:
+        on_tpu = is_tpu()
+    if not on_tpu:
         return False
-    try:
-        kind = jax.devices()[0].device_kind.lower()
-    except Exception:
-        return False
+    if device_kind is None:
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return False
+    kind = str(device_kind).lower()
     for tag, rec in PACK_KERNEL_MEASURED_WINS.items():
         if tag in kind and rec.get("win"):
             return True

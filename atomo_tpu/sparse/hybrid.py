@@ -190,14 +190,21 @@ def infer_row_bounds(
     return out
 
 
-def _codec_leaf_payload_bytes(codec, leaf) -> int:
+def _codec_leaf_payload_bytes(codec, leaf, index=None) -> int:
     """The dense path's wire bytes for one leaf (static, via eval_shape —
     nothing materializes). ``codec=None`` would be a dense psum wire; the
-    hybrid step requires a codec, so this prices the compressed gather."""
+    hybrid step requires a codec, so this prices the compressed gather.
+    A per-leaf wrapper (``budget.PerLeafCodec`` — no whole-tensor encode
+    by design) resolves through ``codec_for(index)``, so the planner can
+    price a budget-allocated dense path (the joint ``+sp+ab``
+    controller candidates)."""
     import jax
     import jax.numpy as jnp
 
     from atomo_tpu.codecs.base import payload_nbytes
+
+    if index is not None and hasattr(codec, "codec_for"):
+        codec = codec.codec_for(index)
 
     shape = jax.eval_shape(
         lambda: codec.encode(
@@ -237,7 +244,7 @@ def plan_hybrid(
         shape = tuple(int(d) for d in leaf.shape)
         itemsize = np.dtype(leaf.dtype).itemsize
         dense_b = int(np.prod(shape or (1,))) * itemsize
-        codec_b = _codec_leaf_payload_bytes(codec, leaf)
+        codec_b = _codec_leaf_payload_bytes(codec, leaf, index=i)
         bound = row_bounds[i]
         d = float(densities[i])
         if bound is not None and len(shape) == 2 and shape[0] > 0:

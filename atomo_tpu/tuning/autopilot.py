@@ -108,7 +108,8 @@ def winner_knobs(row: dict) -> dict:
         k: row[k]
         for k in ("aggregate", "overlap", "superstep", "ring_bucket_size",
                   "plan", "stream_encode", "stream_bucket_bytes",
-                  "sparse_rows", "budget_alloc", "quorum", "staleness")
+                  "sparse_rows", "budget_alloc", "quorum", "staleness",
+                  "error_feedback")
         if k in row
     }
 
@@ -204,6 +205,12 @@ def tune(
     ring_bucket_size: int = 65536,
     context: Optional[dict] = None,
     fabric_probe: Optional[dict] = None,
+    error_feedback: bool = False,
+    extra_candidates: Optional[Sequence[dict]] = None,
+    candidate_filter: Optional[Callable[[dict], bool]] = None,
+    kind: str = "tune_decision",
+    codec_for_candidate: Optional[Callable[[dict], object]] = None,
+    hybrid_for_candidate: Optional[Callable[[dict], object]] = None,
     log_fn=print,
 ) -> dict:
     """Run the startup autopilot; returns the finished decision document
@@ -252,6 +259,28 @@ def tune(
     from the measured mesh, and the decision artifact's meta records
     the measured per-tier GB/s (``meta.fabric_tiers``) so the report's
     cross-artifact check can audit decision against probe.
+
+    ``error_feedback=True`` tunes the residual-carry runs (ISSUE-17
+    satellite): the candidate space is NARROWED to the flat blocking
+    programs EF composes with (overlap/sparse/quorum/hierarchical off,
+    ``num_aggregate`` forced 0 — the same conflict matrix the step
+    builder enforces loudly), every probe builds the EF step, and every
+    row + the meta carry ``error_feedback: "on"`` plus the BIAS CONTRACT
+    note: EF changes the estimator (residuals accumulate, gradients are
+    no longer unbiased per step), so its measured ms/step is comparable
+    to non-EF rows on wall-clock ONLY — never on steps-to-accuracy.
+
+    CONTROLLER HOOKS (tentpole; defaults reproduce the legacy autopilot
+    bit-identically): ``extra_candidates`` appends caller-built joint
+    candidates (each may carry its own per-leaf ``leaf_budgets``
+    override, which ``predict_step_s`` prices FIRST) to the enumerated
+    space before ranking; ``candidate_filter`` restricts the merged
+    space (the controller's degeneracy subspaces); ``kind`` names the
+    artifact document;
+    ``codec_for_candidate(cand)`` / ``hybrid_for_candidate(cand)``
+    override how the probe loop resolves the codec / hybrid plan per
+    candidate — the default is the legacy pair (budget-wrapped codec for
+    ``+ab`` rows, the one hybrid plan for ``+sp`` rows).
     """
     import jax
 
@@ -270,6 +299,30 @@ def tune(
     )
 
     t_start = time.perf_counter()
+    if error_feedback:
+        # EF's conflict matrix (parallel.replicated rejects these at
+        # build time): narrow the space HERE so the ladder never wastes
+        # probes on programs the builder would refuse
+        if zero1:
+            raise ValueError(
+                "error feedback shards residuals per replica; zero1's "
+                "sharded optimizer state conflicts — run EF without "
+                "--zero1 (the step builder rejects the pair)"
+            )
+        if allow_overlap or allow_sparse or allow_quorum or (
+            int(dcn_ways) > 1 or int(num_aggregate) > 0
+        ):
+            log_fn(
+                "Autopilot: --error-feedback narrows the candidate "
+                "space to flat blocking programs (overlap/sparse/"
+                "quorum/hierarchical/num-aggregate excluded — the EF "
+                "conflict matrix)"
+            )
+        allow_overlap = False
+        allow_sparse = False
+        allow_quorum = False
+        dcn_ways = 0
+        num_aggregate = 0
     fabric2 = None
     two_tier = int(dcn_ways) > 1 and n_dev > 1 and n_dev % int(dcn_ways) == 0
     if two_tier:
@@ -332,6 +385,16 @@ def tune(
         dcn_ways=int(dcn_ways) if two_tier else 0,
         plan_names=plan_names,
     )
+    if extra_candidates:
+        # the controller's joint candidates ride the SAME ranked ladder
+        # as the enumerated space — one predict_step_s ordering decides
+        # who gets probed, not four independent winners
+        cands = list(cands) + [dict(c) for c in extra_candidates]
+    if candidate_filter is not None:
+        # the controller's subspace restriction (degeneracy tests pin
+        # each legacy decider's winner when the search is confined to
+        # that decider's knob axes)
+        cands = [c for c in cands if candidate_filter(c)]
     ranked = rank_candidates(
         cands,
         dense_bytes=dense_b,
@@ -402,19 +465,34 @@ def tune(
             "reps": probe_reps,
             "top": probe_top,
         },
+        # the bias contract (tune() docstring): EF rows compare on
+        # wall-clock only — the estimator changed, so steps-to-accuracy
+        # is a different experiment
+        **({"error_feedback": "on"} if error_feedback else {}),
         **(context or {}),
     }
     ladder = ProbeLadder(
-        artifact_path, kind="tune_decision", meta=meta, log_fn=log_fn
+        artifact_path, kind=kind, meta=meta, log_fn=log_fn
+    )
+    ef_note = (
+        "error feedback changes the comparison basis: residual carry "
+        "makes the per-step gradient biased, so this row's ms/step is "
+        "comparable to non-EF rows on wall-clock only"
     )
     n_probe = max(1, min(int(probe_top), len(ranked)))
     for i, cand in enumerate(ranked):
+        # per-candidate leaf_budgets overrides are a PRICING input (the
+        # controller's joint candidates) — already consumed by the
+        # ranker; keep them out of the recorded rows and the knob vector
+        pub = {k: v for k, v in cand.items() if k != "leaf_budgets"}
+        if error_feedback:
+            pub["error_feedback"] = "on"
         if cand.get("quorum"):
             # priced, never probed (tune() docstring): the probe harness
             # runs straggler-free, so a measured quorum probe would omit
             # exactly the exposed wait the candidate exists to absorb
             ladder.record({
-                **cand,
+                **pub,
                 "probed": False,
                 "probe_note": (
                     "quorum candidates are priced by expected exposed "
@@ -424,7 +502,7 @@ def tune(
             })
             continue
         if i >= n_probe:
-            ladder.record({**cand, "probed": False})
+            ladder.record({**pub, "probed": False})
             continue
         knobs = {
             k: v
@@ -434,18 +512,27 @@ def tune(
                      "stream_encode", "stream_bucket_bytes",
                      "sparse_rows", "budget_alloc")
         }
+        if codec_for_candidate is not None:
+            run_codec = codec_for_candidate(cand)
+        else:
+            # +ab candidates probe the REAL program the run would
+            # dispatch: the per-leaf wrapped codec swaps in
+            run_codec = (
+                budget_codec
+                if knobs.get("budget_alloc") == "variance"
+                else codec
+            )
+        run_hybrid = (
+            hybrid_for_candidate(cand)
+            if hybrid_for_candidate is not None
+            else hybrid
+        )
         try:
             row = probe_candidate(
                 knobs,
                 model=model,
                 optimizer=optimizer,
-                # +ab candidates probe the REAL program the run would
-                # dispatch: the per-leaf wrapped codec swaps in
-                codec=(
-                    budget_codec
-                    if knobs.get("budget_alloc") == "variance"
-                    else codec
-                ),
+                codec=run_codec,
                 n_dev=n_dev,
                 sample_shape=sample_shape,
                 num_classes=num_classes,
@@ -463,14 +550,15 @@ def tune(
                 # tiers): probe at the value the run will execute with,
                 # not the builder default
                 ring_bucket_size=ring_bucket_size,
-                hybrid=hybrid,
+                hybrid=run_hybrid,
+                error_feedback=error_feedback,
             )
         except Exception as exc:  # noqa: BLE001 — one candidate failing
             # to compile/execute (OOM, a backend quirk) must not abort the
             # whole tune: record the failure, keep climbing the ladder
             # (the default config and eventual winner may be fine)
             row = {
-                **cand,
+                **pub,
                 "probed": False,
                 "probe_error": f"{type(exc).__name__}: {str(exc)[:200]}",
             }
@@ -482,6 +570,9 @@ def tune(
             )
             continue
         row["predicted_ms_per_step"] = cand["predicted_ms_per_step"]
+        if error_feedback:
+            row["error_feedback"] = "on"
+            row["probe_note"] = ef_note
         warn = calibration_warning(
             cand["predicted_ms_per_step"] / 1e3,
             row["measured_ms_per_step"] / 1e3,

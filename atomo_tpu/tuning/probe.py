@@ -195,6 +195,7 @@ def probe_candidate(
     ring_bucket_size: int = 65536,
     dcn_ways: int = 0,
     hybrid=None,
+    error_feedback: bool = False,
 ) -> dict:
     """Measure one candidate knob vector: build the REAL step program the
     train path would run (same builders, same knobs — zero1 / grad_accum
@@ -216,7 +217,14 @@ def probe_candidate(
     would dispatch. The probe batch stays the synthetic float batch;
     row-id workloads read it as low row ids, which under-exercises the
     power-law tail but prices the program structure honestly (the
-    lossless budget is static, so the timing is shape-faithful)."""
+    lossless budget is static, so the timing is shape-faithful).
+
+    ``error_feedback=True`` probes the residual-carry step (EF state
+    wrapped via ``init_ef_state`` after replication) — the ISSUE-17
+    satellite. The caller (``tune(error_feedback=True)``) is responsible
+    for narrowing the candidate space to the flat blocking programs EF
+    composes with; this function just builds what it is asked to and
+    lets the step builder's conflict matrix reject the rest loudly."""
     import jax
     import jax.numpy as jnp
 
@@ -227,6 +235,12 @@ def probe_candidate(
     )
 
     if n_dev <= 1:
+        if error_feedback:
+            raise ValueError(
+                "error-feedback probes need a multi-device mesh — EF "
+                "corrects the lossy EXCHANGE, and a single device has "
+                "no exchange to correct"
+            )
         from atomo_tpu.training import create_state, make_train_step
 
         state = create_state(
@@ -305,7 +319,12 @@ def probe_candidate(
             ),
             inner_axis=inner_axis, plan=plan,
             hybrid=hybrid if cand.get("sparse_rows") == "on" else None,
+            error_feedback=error_feedback,
         )
+        if error_feedback:
+            from atomo_tpu.parallel.replicated import init_ef_state
+
+            state = init_ef_state(mesh, state)
         if overlap == "delayed":
             state = init_delayed_state(mesh, state, codec)
         if k > 1:

@@ -104,6 +104,34 @@ def test_lint_covers_budget_subsystem_by_construction(tmp_path):
     ]
 
 
+def test_lint_covers_controller_subsystem_by_construction(tmp_path):
+    """The budget precedent applied to the NEW controller/ subsystem:
+    the AST walk covers atomo_tpu/controller/ with no allowlist to
+    forget — a json.dump smuggled next to controller_decision.json's
+    writer is flagged, and the real package (which writes through the
+    tune ladder's write_json_atomic) is clean."""
+    mod = _load_checker()
+    pkg = tmp_path / "atomo_tpu" / "controller"
+    pkg.mkdir(parents=True)
+    bad = pkg / "rogue.py"
+    bad.write_text(
+        "import json\n"
+        "def w(train_dir, obj):\n"
+        "    with open(train_dir + '/controller_decision.json', 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+    )
+    out = mod.scan_file(
+        str(bad), os.path.join("atomo_tpu", "controller", "rogue.py")
+    )
+    assert len(out) == 1 and "write_json_atomic" in out[0]
+    real = os.path.join(_REPO, "atomo_tpu", "controller")
+    assert os.path.isdir(real)
+    assert not [
+        v for v in mod.collect_violations(_REPO)
+        if "atomo_tpu/controller" in v
+    ]
+
+
 def test_lint_catches_a_script_train_dir_dump(tmp_path):
     mod = _load_checker()
     bad = tmp_path / "scripts" / "rogue.py"

@@ -717,3 +717,73 @@ def test_distributed_loop_retunes_on_injected_drift(tmp_path):
     assert drift[0]["action"] == "retune->ring"
     assert drift[0]["step"] % 2 == 0  # snapped to the save cadence
     assert tuner.switches == 1
+
+
+def test_tune_error_feedback_probes_narrowed_space(monkeypatch, tmp_path):
+    """EF x autopilot (ISSUE-17 satellite): --error-feedback runs ARE
+    tunable — the ladder narrows to the flat blocking programs EF
+    composes with, every probe builds the EF step, and the bias
+    contract is recorded (rows + meta carry error_feedback="on"; probed
+    rows carry the wall-clock-only probe_note)."""
+    import atomo_tpu.tuning.autopilot as ap
+
+    seen_ef = []
+
+    def fake_probe(cand, **kw):
+        seen_ef.append(kw.get("error_feedback"))
+        return {
+            **cand, "probed": True, "sync_ok": True,
+            "measured_ms_per_step": 10.0 + len(cand["name"]),
+            "probe_wall_s": 0.1,
+        }
+
+    monkeypatch.setattr("atomo_tpu.tuning.probe.probe_candidate",
+                        fake_probe)
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import QsgdCodec
+    from atomo_tpu.models import get_model
+    from atomo_tpu.training import make_optimizer
+    from atomo_tpu.tuning.probe import model_init_fn
+
+    model = get_model("lenet", 10)
+    narrowed = []
+    common = dict(
+        model=model,
+        optimizer=make_optimizer("sgd", lr=0.01, momentum=0.9),
+        codec=QsgdCodec(bits=8, bucket_size=512),
+        model_init_fn=model_init_fn(
+            model, jnp.zeros((1, 28, 28, 1), jnp.float32)
+        ),
+        n_dev=4, sample_shape=(28, 28, 1), num_classes=10, batch=8,
+        probe_top=3, probe_steps=1, probe_reps=1,
+    )
+    doc = ap.tune(
+        artifact_path=str(tmp_path / "td.json"),
+        error_feedback=True,
+        # ask for everything EF conflicts with: the tuner must narrow
+        # out loud, not build programs the step builder would refuse
+        allow_overlap=True, allow_stream=True,
+        allow_quorum=True, quorum_q=3,
+        log_fn=narrowed.append,
+        **common,
+    )
+    assert any("narrows the candidate space" in str(m) for m in narrowed)
+    assert doc["complete"] is True
+    assert doc["meta"]["error_feedback"] == "on"
+    assert seen_ef and all(v is True for v in seen_ef)
+    for r in doc["rows"]:
+        assert r["error_feedback"] == "on"
+        assert r["overlap"] == "off"
+        # stream encode composes with the residual carry and stays in;
+        # the conflict-matrix axes are out
+        assert "+q" not in r["name"] and "+sp" not in r["name"]
+        assert "hier[" not in r["name"]
+        if r.get("probed"):
+            assert "wall-clock only" in r["probe_note"]
+    assert doc["winner"]["knobs"]["error_feedback"] == "on"
+    # zero1's sharded optimizer state conflicts with the residual carry
+    with pytest.raises(ValueError, match="zero1"):
+        ap.tune(artifact_path=str(tmp_path / "td2.json"),
+                error_feedback=True, zero1=True,
+                log_fn=lambda *_: None, **common)

@@ -671,8 +671,11 @@ def test_cli_preflight_rejects():
     # budget conflicts
     with pytest.raises(SystemExit, match="budget-bytes"):
         pf(["--budget-bytes", "1000"])
-    with pytest.raises(SystemExit, match="--code svd"):
-        pf(["--budget-alloc", "variance", "--code", "qsgd"])
+    # qsgd bit allocation is a STATED law (B/(2^b-1)^2) — accepted now;
+    # terngrad's max-norm scale + sigma clip is not, and stays rejected
+    pf(["--budget-alloc", "variance", "--code", "qsgd"])
+    with pytest.raises(SystemExit, match="terngrad"):
+        pf(["--budget-alloc", "variance", "--code", "terngrad"])
     with pytest.raises(SystemExit, match="fixed_k"):
         pf(["--budget-alloc", "variance", "--code", "svd",
             "--sample", "topk"])
@@ -693,9 +696,10 @@ def test_cli_preflight_rejects():
     with pytest.raises(SystemExit, match="guard"):
         pf(["--error-feedback", "--code", "svd", "--n-devices", "4",
             "--grad-guard"])
-    with pytest.raises(SystemExit, match="auto tune"):
-        pf(["--error-feedback", "--code", "svd", "--n-devices", "4",
-            "--auto", "tune", "--train-dir", "/tmp/x"])
+    # EF x autopilot is now a probed composition (the tuner narrows its
+    # space to the EF-compatible candidates) — accepted, not rejected
+    pf(["--error-feedback", "--code", "svd", "--sample", "topk",
+        "--n-devices", "4", "--auto", "tune", "--train-dir", "/tmp/x"])
     # the contraction-pairing warning, not a reject
     with pytest.warns(UserWarning, match="CONTRACTION"):
         pf(["--error-feedback", "--code", "svd", "--n-devices", "4"])
@@ -733,3 +737,92 @@ def test_pack_kernel_default_consults_decision_record(monkeypatch):
     monkeypatch.setattr(qk, "is_tpu", lambda: False)
     FakeDev.device_kind = "TPU v5e"
     assert qk.pack_kernel_default() is False
+
+
+# ------------------------------------------------- qsgd bit allocation
+# The second water-filling target (same solver, different law): the
+# knob is the leaf's bit width b, the stated law is E q_err2 =
+# B_l / (2^b - 1)^2 with B_l = (1/6) sum_buckets n_b s_b^2, and the
+# pricing is the codec's own analytic leaf_payload_bytes.
+
+
+def test_qsgd_analytic_payload_matches_executed_across_knobs():
+    from atomo_tpu.codecs import QsgdCodec
+
+    grads = _grad_tree()
+    leaves = jax.tree_util.tree_leaves(grads)
+    for bits in (1, 2, 4, 8, 16):
+        for bucket in (64, 512):
+            qc = QsgdCodec(bits=bits, bucket_size=bucket)
+            _, stats = encode_tree(qc, jax.random.PRNGKey(0), grads)
+            assert stats.payload_bytes == sum(
+                qc.leaf_payload_bytes(tuple(l.shape)) for l in leaves
+            ), (bits, bucket)
+
+
+def test_qsgd_bit_allocation_wire_match_predicted_equals_executed():
+    from atomo_tpu.budget.allocator import MAX_BITS
+    from atomo_tpu.codecs import QsgdCodec
+
+    qc = QsgdCodec(bits=4, bucket_size=256)
+    grads = _grad_tree()
+    spectra = measure_spectra(qc, grads)
+    alloc = solve_allocation(qc, spectra, mode="variance")
+    assert all(1 <= b <= MAX_BITS for b in alloc.ks)
+    wrapped = budgeted_codec(qc, alloc.ks)
+    _, stats = encode_tree(wrapped, jax.random.PRNGKey(0), grads)
+    assert stats.payload_bytes == alloc.payload_bytes
+    # the per-leaf pairs the +ab candidates price with sum to the same
+    assert sum(p for _, p in allocation_leaf_budgets(
+        qc, spectra, alloc.ks
+    )) == alloc.payload_bytes
+    # and the recorded prediction is the stated bit law at those knobs
+    from atomo_tpu.budget import predicted_variance
+
+    assert alloc.predicted_variance == pytest.approx(
+        predicted_variance(spectra, alloc.ks, codec=qc)
+    )
+
+
+def test_qsgd_uniform_point_is_configured_bits_byte_for_byte():
+    from atomo_tpu.codecs import QsgdCodec
+
+    qc = QsgdCodec(bits=2, bucket_size=512)
+    grads = _grad_tree()
+    spectra = measure_spectra(qc, grads)
+    assert uniform_ks(spectra) == (2, 2, 2, 2)
+    wrapped = budgeted_codec(qc, uniform_ks(spectra))
+    key = jax.random.PRNGKey(11)
+    p0, s0 = encode_tree(qc, key, grads)
+    p1, s1 = encode_tree(wrapped, key, grads)
+    assert s0.payload_bytes == s1.payload_bytes
+    assert _eq(p0, p1)
+    assert _eq(decode_tree(qc, p0, grads), decode_tree(wrapped, p1, grads))
+
+
+def test_qsgd_bit_solver_pure_and_monotone():
+    from atomo_tpu.codecs import QsgdCodec
+
+    qc = QsgdCodec(bits=4, bucket_size=256)
+    spectra = measure_spectra(qc, _grad_tree())
+    a1 = solve_allocation(qc, spectra, mode="variance")
+    a2 = solve_allocation(qc, spectra, mode="variance")
+    assert a1 == a2
+    uni = solve_allocation(qc, spectra, mode="uniform")
+    rich = solve_allocation(
+        qc, spectra, budget_bytes=uni.payload_bytes * 2, mode="variance"
+    )
+    assert rich.predicted_variance <= uni.predicted_variance + 1e-9
+    tight = solve_allocation(
+        qc, spectra, budget_bytes=uni.payload_bytes * 3 // 4,
+        mode="variance",
+    )
+    assert tight.payload_bytes <= uni.payload_bytes * 3 // 4
+
+
+def test_qsgd_terngrad_scheme_refused():
+    from atomo_tpu.codecs import QsgdCodec
+
+    tern = QsgdCodec(bits=1, scheme="terngrad")
+    with pytest.raises(ValueError, match="terngrad"):
+        measure_spectra(tern, _grad_tree())

@@ -1,0 +1,685 @@
+"""Global controller (ISSUE-17 tentpole): one priced decision space,
+one artifact, one re-solve loop.
+
+Contracts pinned here (atomo_tpu/controller):
+
+  * The decision-space grammar is pure and deterministic: the joint
+    cross-term candidates (``+sp+ab``, ``+ab+se``, ``+ab`` under
+    delayed/hierarchical/quorum) are named through ``candidate_name``
+    and carry their own per-leaf pricing overrides.
+  * DEGENERACY: restricting the controller's search to one legacy
+    decider's knob axes reproduces that decider's winner bit-identically
+    (autopilot-only ladder, budget-only allocation, hybrid-only
+    assignment, topology-only plan) — the controller is a superset of
+    the old paths, not a fifth opinion.
+  * ``controller_decision.json`` is the ONE resume source of truth:
+    ``controller_reusable`` composes the tune-decision validity law with
+    the meta-section closure checks; kill->restart resumes from the
+    artifact; legacy train_dirs fall back to ``tune_decision.json`` (+
+    grafted ``budget_alloc.json``) out loud.
+  * ``ControllerRetuner`` composes the drift and budget reactors behind
+    one object satisfying both loop protocols; every APPLIED change is
+    one ``controller_redecide`` incident quoting the old/new knob vector
+    and the evidence both ways.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from atomo_tpu.budget import (
+    allocation_leaf_budgets,
+    budgeted_codec,
+    measure_spectra,
+    new_alloc_doc,
+    solve_allocation,
+    write_alloc,
+)
+from atomo_tpu.codecs import SvdCodec
+from atomo_tpu.controller import (
+    CONTROLLER_DECISION_NAME,
+    ControllerRetuner,
+    candidate_predicate,
+    controller_path,
+    controller_reusable,
+    joint_candidates,
+    load_resume_decision,
+    normalize_deciders,
+    read_controller,
+    solve_controller,
+)
+from atomo_tpu.models import get_model
+from atomo_tpu.sparse.hybrid import plan_hybrid
+from atomo_tpu.training import make_optimizer
+from atomo_tpu.tuning.probe import model_init_fn
+
+CODEC = SvdCodec(rank=3)
+
+
+def _grad_tree(key=0):
+    k = jax.random.PRNGKey(key)
+    return {
+        "conv": jax.random.normal(k, (5, 5, 10, 20)),
+        "fc": jax.random.normal(jax.random.fold_in(k, 1), (320, 50)) * 3.0,
+        "bias": jax.random.normal(jax.random.fold_in(k, 2), (10,)),
+        "fc2": jax.random.normal(jax.random.fold_in(k, 3), (50, 10)),
+    }
+
+
+def _budget_ctx(codec=CODEC):
+    spectra = measure_spectra(codec, _grad_tree())
+    alloc = solve_allocation(codec, spectra, mode="variance")
+    return {
+        "base_codec": codec,
+        "codec": budgeted_codec(codec, alloc.ks),
+        "spectra": spectra,
+        "alloc": alloc,
+        "doc": new_alloc_doc(codec, spectra, alloc),
+        "leaf_budgets": allocation_leaf_budgets(codec, spectra, alloc.ks),
+    }
+
+
+def _hybrid_plan(codec=CODEC):
+    grads = {
+        "emb": np.asarray(
+            jax.random.normal(jax.random.PRNGKey(7), (256, 16))
+        ),
+        "w": np.asarray(jax.random.normal(jax.random.PRNGKey(8), (16, 16))),
+    }
+    # canonical flatten order of the dict: ("emb", "w")
+    plan = plan_hybrid(codec, grads, [0.02, 1.0], [8, None])
+    assert plan.any_sparse  # the fixture must actually sparse-assign
+    return plan
+
+
+def _fake_probe(monkeypatch):
+    """Deterministic measured ms keyed on the candidate name — the same
+    candidate measures the same in every ladder, so two searches over
+    the same subspace pick the same winner iff they rank the same."""
+
+    def fake(cand, **kw):
+        h = sum(ord(c) * (i + 1) for i, c in enumerate(cand["name"]))
+        return {
+            **cand,
+            "probed": True,
+            "sync_ok": True,
+            "measured_ms_per_step": round(10.0 + (h % 997) / 100.0, 4),
+            "probe_wall_s": 0.01,
+        }
+
+    monkeypatch.setattr("atomo_tpu.tuning.probe.probe_candidate", fake)
+
+
+def _solve(tmp_path, *, deciders, name, **kw):
+    model = get_model("lenet", 10)
+    return solve_controller(
+        model=model,
+        optimizer=make_optimizer("sgd", lr=0.01, momentum=0.9),
+        codec=kw.pop("codec", CODEC),
+        model_init_fn=model_init_fn(
+            model, jnp.zeros((1, 28, 28, 1), jnp.float32)
+        ),
+        n_dev=4,
+        sample_shape=(28, 28, 1),
+        num_classes=10,
+        batch=8,
+        deciders=deciders,
+        artifact_path=str(tmp_path / name),
+        probe_steps=1,
+        probe_reps=1,
+        log_fn=lambda *_: None,
+        **kw,
+    )
+
+
+# ------------------------------------------------------- decision space
+
+
+def test_normalize_deciders_validates():
+    assert normalize_deciders(None) == frozenset(
+        ("autopilot", "budget", "hybrid", "topology")
+    )
+    assert normalize_deciders(["budget"]) == frozenset({"budget"})
+    with pytest.raises(ValueError, match="unknown decider"):
+        normalize_deciders(["budget", "vibes"])
+    with pytest.raises(ValueError, match="at least one"):
+        normalize_deciders([])
+
+
+def test_candidate_predicate_full_space_is_identity():
+    # None = no filtering — the default joint path pays zero overhead
+    assert candidate_predicate(None) is None
+
+
+def test_candidate_predicate_subspaces():
+    pred = candidate_predicate({"budget"})
+    assert pred({"aggregate": "gather", "overlap": "off", "superstep": 1,
+                 "budget_alloc": "variance"})
+    # autopilot excluded: its axes are frozen at the degenerate point
+    assert not pred({"aggregate": "ring", "overlap": "off", "superstep": 1})
+    assert not pred({"aggregate": "gather", "overlap": "delayed",
+                     "superstep": 1})
+    assert not pred({"aggregate": "gather", "overlap": "off",
+                     "superstep": 8})
+    assert not pred({"aggregate": "gather", "overlap": "off",
+                     "superstep": 1, "stream_encode": "on"})
+    assert not pred({"aggregate": "gather", "overlap": "off",
+                     "superstep": 1, "quorum": 3})
+    # other deciders' axes removed with them
+    assert not pred({"aggregate": "gather", "overlap": "off",
+                     "superstep": 1, "sparse_rows": "on"})
+    assert not pred({"aggregate": "hierarchical", "plan": "cring+ring",
+                     "overlap": "off", "superstep": 1})
+    # topology-only: ONLY the hierarchical candidates survive
+    topo = candidate_predicate({"topology"})
+    assert topo({"aggregate": "hierarchical", "plan": "cring+ring",
+                 "overlap": "off", "superstep": 1})
+    assert not topo({"aggregate": "gather", "overlap": "off",
+                     "superstep": 1})
+    # no budget: +ab dropped even in an otherwise-full space
+    nb = candidate_predicate({"autopilot", "hybrid", "topology"})
+    assert not nb({"aggregate": "gather", "overlap": "off", "superstep": 1,
+                   "budget_alloc": "variance"})
+
+
+def test_joint_candidates_cross_terms_and_grammar():
+    ctx = _budget_ctx()
+    plan_ab = _hybrid_plan(ctx["codec"])
+    kw = dict(
+        deciders=None,
+        have_budget=True,
+        have_sparse=True,
+        sparse_ab_leaf_budgets=plan_ab.leaf_budgets(),
+        allow_overlap=True,
+        allow_stream=True,
+        allow_quorum=True,
+        quorum_q=3,
+        quorum_staleness_options=(1, 2),
+        two_tier=True,
+        plan_names=("cring+ring",),
+    )
+    cands = joint_candidates(**kw)
+    names = [c["name"] for c in cands]
+    assert "gather+off+sp+ab+k1" in names
+    assert "gather+off+se+ab+k1" in names
+    assert "gather+delayed+ab+k1" in names
+    # the +qK suffix encodes the staleness bound; one candidate per
+    # staleness option at the run's pinned quorum size
+    assert "gather+off+ab+q1+k1" in names
+    assert "gather+off+ab+q2+k1" in names
+    assert "hier[cring+ring]+off+ab+k1" in names
+    # the +sp+ab cross term carries its OWN per-leaf pricing override
+    spab = next(c for c in cands if c["name"] == "gather+off+sp+ab+k1")
+    assert spab["leaf_budgets"] == [
+        (int(a), int(b)) for a, b in plan_ab.leaf_budgets()
+    ]
+    # pure and deterministic: same inputs, same list, same order
+    assert joint_candidates(**kw) == cands
+    # restricting deciders removes the corresponding cross terms
+    no_topo = joint_candidates(**{**kw, "deciders": ("autopilot", "budget",
+                                                     "hybrid")})
+    assert not any(n.startswith("hier[") for n in
+                   [c["name"] for c in no_topo])
+    budget_only = joint_candidates(**{**kw, "deciders": ("budget",)})
+    assert not any(
+        "+q" in c["name"] or c.get("sparse_rows") == "on"
+        for c in budget_only
+    )
+
+
+# ----------------------------------------------------- degeneracy: the
+# controller confined to one decider's axes == that decider standalone
+
+
+def test_degeneracy_autopilot_only_reproduces_tune_winner(
+    monkeypatch, tmp_path
+):
+    from atomo_tpu.tuning.autopilot import tune
+
+    _fake_probe(monkeypatch)
+    model = get_model("lenet", 10)
+    common = dict(
+        model=model,
+        optimizer=make_optimizer("sgd", lr=0.01, momentum=0.9),
+        codec=CODEC,
+        model_init_fn=model_init_fn(
+            model, jnp.zeros((1, 28, 28, 1), jnp.float32)
+        ),
+        n_dev=4,
+        sample_shape=(28, 28, 1),
+        num_classes=10,
+        batch=8,
+        probe_steps=1,
+        probe_reps=1,
+        log_fn=lambda *_: None,
+    )
+    legacy = tune(artifact_path=str(tmp_path / "legacy.json"), **common)
+    joint = solve_controller(
+        deciders={"autopilot"},
+        artifact_path=str(tmp_path / "ctl.json"),
+        **common,
+    )
+    assert joint["kind"] == "controller_decision"
+    assert joint["winner"]["name"] == legacy["winner"]["name"]
+    assert joint["winner"]["knobs"] == legacy["winner"]["knobs"]
+    # same subspace, same ladder: every candidate row, in the same order
+    assert [r["name"] for r in joint["rows"]] == [
+        r["name"] for r in legacy["rows"]
+    ]
+
+
+def test_degeneracy_budget_only_reproduces_allocation(monkeypatch, tmp_path):
+    _fake_probe(monkeypatch)
+    ctx = _budget_ctx()
+    doc = _solve(tmp_path, deciders={"budget"}, name="ctl.json",
+                 budget_ctx=ctx)
+    # the artifact's allocation section IS the standalone water-filling
+    # solver's output — the controller composed it, not re-derived it
+    assert doc["meta"]["allocation"]["ks"] == [int(k) for k in
+                                               ctx["alloc"].ks]
+    assert doc["meta"]["allocation"]["payload_bytes"] == int(
+        ctx["alloc"].payload_bytes
+    )
+    assert doc["meta"]["allocation"]["predicted_variance"] == float(
+        ctx["alloc"].predicted_variance
+    )
+    # the search was confined to the budget decider's axis: flat
+    # blocking gather at superstep 1, with and without +ab — nothing else
+    for r in doc["rows"]:
+        assert r["aggregate"] == "gather"
+        assert r["overlap"] == "off" and r["superstep"] == 1
+        assert "sparse_rows" not in r or r["sparse_rows"] != "on"
+    assert {r["name"] for r in doc["rows"]} == {
+        "gather+off+k1", "gather+off+ab+k1"
+    }
+
+
+def test_degeneracy_hybrid_only_reproduces_assignment(monkeypatch, tmp_path):
+    _fake_probe(monkeypatch)
+    plan = _hybrid_plan()
+    doc = _solve(tmp_path, deciders={"hybrid"}, name="ctl.json",
+                 hybrid=plan)
+    rec = doc["meta"]["hybrid"]
+    assert rec["payload_bytes"] == int(plan.payload_bytes())
+    assert [
+        (a["index"], a["kind"], a["payload_bytes"])
+        for a in rec["assignments"]
+    ] == [
+        (int(a.index), a.kind, int(a.payload_bytes))
+        for a in plan.assignments
+    ]
+    assert {r["name"] for r in doc["rows"]} == {
+        "gather+off+k1", "gather+off+sp+k1"
+    }
+
+
+def test_degeneracy_topology_only_reproduces_choose_plan(
+    monkeypatch, tmp_path
+):
+    from atomo_tpu.topology.fabric import resolve_two_tier
+    from atomo_tpu.topology.schedule import choose_plan
+    from atomo_tpu.tuning.probe import byte_budget
+
+    _fake_probe(monkeypatch)
+    doc = _solve(tmp_path, deciders={"topology"}, name="ctl.json",
+                 dcn_ways=2, probe_top=1)
+    win = doc["winner"]["knobs"]
+    assert win["aggregate"] == "hierarchical"
+    # probe_top=1 probes exactly the predicted-first hierarchical
+    # candidate, so the measured pool is the plan ranking's own argmin —
+    # the standalone choose_plan pick at the same pricing inputs
+    model = get_model("lenet", 10)
+    dense_b, payload_b = byte_budget(
+        CODEC,
+        model_init_fn(model, jnp.zeros((1, 28, 28, 1), jnp.float32)),
+    )
+    plan, _ = choose_plan(
+        dense_bytes=dense_b,
+        payload_bytes=payload_b,
+        fabric=resolve_two_tier("auto", dcn_ways=2, n_dev=4, n_proc=1),
+    )
+    assert win["plan"] == plan.name
+    assert all(r["aggregate"] == "hierarchical" for r in doc["rows"])
+
+
+def test_joint_cross_terms_ride_the_same_ladder(monkeypatch, tmp_path):
+    """The full joint space: cross-term candidates appear in the SAME
+    artifact rows as the enumerated space, named through the one
+    grammar, and the +sp+ab re-planned crossover lands in meta."""
+    _fake_probe(monkeypatch)
+    ctx = _budget_ctx()
+    grads = {
+        "emb": np.asarray(
+            jax.random.normal(jax.random.PRNGKey(7), (256, 16))
+        ),
+        "w": np.asarray(jax.random.normal(jax.random.PRNGKey(8), (16, 16))),
+    }
+    inputs = {"grads_like": grads, "densities": [0.02, 1.0],
+              "row_bounds": [8, None]}
+    plan = plan_hybrid(CODEC, **inputs)
+    doc = _solve(
+        tmp_path, deciders=None, name="ctl.json",
+        budget_ctx=ctx, hybrid=plan, hybrid_inputs=inputs,
+        allow_stream=True,
+    )
+    names = {r["name"] for r in doc["rows"]}
+    assert "gather+off+sp+ab+k1" in names
+    assert "gather+off+se+ab+k1" in names
+    assert "gather+delayed+ab+k1" in names
+    # the pricing override never leaks into the recorded rows
+    assert all("leaf_budgets" not in r for r in doc["rows"])
+    # the re-planned crossover is recorded next to the base assignment
+    assert "ab_assignments" in doc["meta"]["hybrid"]
+    ab = plan_hybrid(ctx["codec"], **inputs)
+    assert [
+        (a["index"], a["kind"]) for a in doc["meta"]["hybrid"]
+        ["ab_assignments"]
+    ] == [(int(a.index), a.kind) for a in ab.assignments]
+    assert doc["meta"]["controller"]["supersedes"] == [
+        "tune_decision.json", "budget_alloc.json"
+    ]
+    assert "pack_kernel" in doc["meta"]["controller"]
+
+
+# ------------------------------------------------- artifact + resume
+
+
+def test_controller_reusable_refusal_matrix(monkeypatch, tmp_path):
+    _fake_probe(monkeypatch)
+    ctx = _budget_ctx()
+    doc = _solve(tmp_path, deciders=None, name=CONTROLLER_DECISION_NAME,
+                 budget_ctx=ctx)
+    axes = doc["meta"]["mesh_axes"]
+    ok, why = controller_reusable(doc, n_dev=4, mesh_axes=axes)
+    assert ok, why
+    # the composed tune-decision validity law still applies
+    ok, why = controller_reusable(doc, n_dev=3, mesh_axes=axes)
+    assert not ok and "n_devices" in why
+    # a tune_decision document is NOT a controller decision
+    legacy = {**doc, "kind": "tune_decision"}
+    ok, why = controller_reusable(legacy, n_dev=4, mesh_axes=axes)
+    assert not ok and "not a controller decision" in why
+    # closure: a knob vector referencing a meta section the artifact
+    # does not carry is not executable
+    broken = json.loads(json.dumps(doc))
+    broken["winner"]["knobs"]["budget_alloc"] = "variance"
+    broken["meta"].pop("allocation", None)
+    ok, why = controller_reusable(broken, n_dev=4, mesh_axes=axes)
+    assert not ok and "meta.allocation" in why
+    broken = json.loads(json.dumps(doc))
+    broken["winner"]["knobs"]["sparse_rows"] = "on"
+    broken["meta"].pop("hybrid", None)
+    ok, why = controller_reusable(broken, n_dev=4, mesh_axes=axes)
+    assert not ok and "meta.hybrid" in why
+
+
+def test_kill_restart_resumes_from_controller_artifact(
+    monkeypatch, tmp_path
+):
+    """The restart path: the artifact written by the first solve is read
+    back whole and vetted reusable — no re-probe, one source of truth."""
+    _fake_probe(monkeypatch)
+    ctx = _budget_ctx()
+    doc = _solve(tmp_path, deciders=None, name=CONTROLLER_DECISION_NAME,
+                 budget_ctx=ctx)
+    assert os.path.exists(controller_path(str(tmp_path)))
+    again, source = load_resume_decision(str(tmp_path),
+                                         log_fn=lambda *_: None)
+    assert source == "controller"
+    assert again == read_controller(str(tmp_path))
+    assert again["winner"] == doc["winner"]
+    assert again["meta"]["allocation"] == doc["meta"]["allocation"]
+    ok, why = controller_reusable(
+        again, n_dev=4, mesh_axes=again["meta"]["mesh_axes"]
+    )
+    assert ok, why
+
+
+def test_load_resume_decision_legacy_fallback(tmp_path):
+    """A pre-controller train_dir (tune_decision.json +
+    budget_alloc.json) keeps resuming: the fallback is stated and the
+    legacy allocation epoch is grafted into the one decision shape."""
+    logged = []
+    # no artifacts at all
+    doc, source = load_resume_decision(str(tmp_path), log_fn=logged.append)
+    assert (doc, source) == (None, "none")
+    legacy = {
+        "kind": "tune_decision",
+        "complete": True,
+        "winner": {"name": "gather+off+k1",
+                   "knobs": {"aggregate": "gather", "overlap": "off",
+                             "superstep": 1}},
+        "meta": {"n_devices": 4},
+    }
+    with open(tmp_path / "tune_decision.json", "w") as f:
+        json.dump(legacy, f)
+    ctx = _budget_ctx()
+    write_alloc(str(tmp_path), ctx["doc"])
+    doc, source = load_resume_decision(str(tmp_path), log_fn=logged.append)
+    assert source == "legacy"
+    assert doc["winner"]["name"] == "gather+off+k1"
+    assert doc["meta"]["allocation"]["ks"] == [int(k) for k in
+                                               ctx["alloc"].ks]
+    assert "budget_alloc.json" in doc["meta"]["allocation"]["source"]
+    assert any("falling back" in m for m in logged)
+
+
+# ------------------------------------------------- one re-solve loop
+
+
+class _Incidents:
+    def __init__(self):
+        self.rows = []
+
+    def append(self, kind, **kw):
+        self.rows.append((kind, kw))
+
+
+class _StubDrift:
+    """OnlineRetuner protocol stub: one pending switch to ring."""
+
+    def __init__(self):
+        self.probe_fn = lambda mode: {"gather": 9.0, "ring": 5.0}[mode]
+        self.pending = None
+        self.state = "drift-state"
+        self.bound = None
+
+    def bind(self, incidents=None, log_fn=None):
+        self.bound = incidents
+        return self
+
+    def observe(self, dts):
+        return None
+
+    def maybe_retune(self, step, current_mode):
+        # the recording wrapper installed by ControllerRetuner must see
+        # both probes (evidence quotes the pair)
+        self.probe_fn("gather")
+        self.probe_fn("ring")
+        return "ring"
+
+
+class _StubAlloc:
+    def __init__(self, ks, var, epoch):
+        self.ks = tuple(ks)
+        self.predicted_variance = var
+        self.epoch = epoch
+
+
+class _StubBudget:
+    """BudgetRetuner protocol stub: one applied re-allocation."""
+
+    def __init__(self):
+        self.alloc = _StubAlloc((3, 3), 0.5, 0)
+        self.bound = None
+
+    def bind(self, incidents=None, recorder=None, log_fn=None):
+        self.bound = (incidents, recorder)
+        return self
+
+    def maybe_realloc(self, step):
+        self.alloc = _StubAlloc((5, 1), 0.25, 1)
+        return object()  # the re-wrapped codec
+
+
+def test_controller_retuner_redecides_with_one_incident_stream():
+    inc = _Incidents()
+    drift, budget = _StubDrift(), _StubBudget()
+    ctl = ControllerRetuner(
+        tuner=drift, budget_tuner=budget,
+        knobs={"aggregate": "gather", "budget_alloc": "variance"},
+        log_fn=lambda *_: None,
+    )
+    # one bind fans out to BOTH inner reactors (the loop calls it as
+    # tuner= and again as budget_tuner= — idempotent)
+    ctl.bind(incidents=inc, recorder="rec", log_fn=lambda *_: None)
+    ctl.bind(incidents=inc, recorder="rec", log_fn=lambda *_: None)
+    assert drift.bound is inc and budget.bound == (inc, "rec")
+    assert ctl.state == "drift-state" and ctl.pending is None
+
+    assert ctl.maybe_retune(100, "gather") == "ring"
+    assert ctl.knobs["aggregate"] == "ring"
+    kinds = [k for k, _ in inc.rows]
+    assert kinds == ["controller_redecide"]
+    _, rec = inc.rows[0]
+    assert rec["axis"] == "aggregate"
+    assert rec["knobs_old"]["aggregate"] == "gather"
+    assert rec["knobs_new"]["aggregate"] == "ring"
+    assert rec["evidence"]["probed_ms_per_step"] == {
+        "gather": 9.0, "ring": 5.0
+    }
+    assert rec["evidence"]["old_mode_ms"] == 9.0
+    assert rec["evidence"]["new_mode_ms"] == 5.0
+
+    assert ctl.maybe_realloc(200) is not None
+    assert ctl.knobs["budget_epoch"] == 1
+    _, rec = inc.rows[1]
+    assert rec["axis"] == "allocation"
+    assert rec["evidence"]["ks_old"] == [3, 3]
+    assert rec["evidence"]["ks_new"] == [5, 1]
+    assert rec["evidence"]["predicted_variance_old"] == 0.5
+    assert rec["evidence"]["predicted_variance_new"] == 0.25
+    # the knob vector in the incident is the WHOLE vector, both ways
+    assert rec["knobs_old"]["aggregate"] == "ring"
+    # a hybrid re-plan is restart territory — the record says so
+    assert "not online-movable" in rec["hybrid_note"]
+    assert ctl.redecisions == 2
+
+
+def test_controller_retuner_none_reactors_are_inert():
+    ctl = ControllerRetuner(knobs={"aggregate": "gather"})
+    assert ctl.maybe_retune(1, "gather") is None
+    assert ctl.maybe_realloc(1) is None
+    assert ctl.observe([0.01]) is None
+    assert ctl.pending is None and ctl.state is None
+    ctl.bind(incidents=_Incidents())  # no inner reactors: still fine
+    assert ctl.redecisions == 0
+
+
+def test_controller_prices_a_graduated_pack_kernel(monkeypatch, tmp_path):
+    """Pack-kernel graduation drill (satellite): a recorded measured win
+    flips ``pack_kernel_default()`` on the matching device kind, and the
+    controller's artifact PRICES the selection — the meta record shows
+    which encode path the winner's programs resolve to and the win table
+    that decided it, so a future real-TPU win is auditable in the one
+    decision document."""
+    from atomo_tpu.codecs import QsgdCodec
+    from atomo_tpu.ops import qsgd_kernels as qk
+
+    monkeypatch.setitem(
+        qk.PACK_KERNEL_MEASURED_WINS, "v5e",
+        {"win": True, "evidence": "synthetic-test-entry"},
+    )
+    monkeypatch.setattr(qk, "is_tpu", lambda: True)
+
+    class FakeDev:
+        device_kind = "TPU v5e"
+
+    monkeypatch.setattr(qk.jax, "devices", lambda *a, **k: [FakeDev()])
+    _fake_probe(monkeypatch)
+    doc = _solve(tmp_path, deciders={"autopilot"}, name="ctl.json",
+                 codec=QsgdCodec(bits=8, bucket_size=512))
+    rec = doc["meta"]["controller"]["pack_kernel"]
+    assert rec["codec_has_knob"] is True
+    assert rec["measured_wins"]["v5e"]["win"] is True
+    assert rec["selected"] is True
+    assert rec["source"] == "resolved from the measured-win table"
+    # a codec-pinned value wins over the table, and the record says so
+    from atomo_tpu.controller.solve import pack_kernel_record
+
+    pinned = pack_kernel_record(QsgdCodec(bits=8, pack_kernel=False))
+    assert pinned["selected"] is False
+    assert pinned["source"] == "pinned by the codec"
+    # an SVD codec has no pack stage: the record states that instead of
+    # inventing a selection
+    svd_rec = pack_kernel_record(CODEC)
+    assert svd_rec["codec_has_knob"] is False
+    assert "selected" not in svd_rec
+
+
+# ------------------------------------------------- report cross-check
+
+
+def test_controller_decision_consistent_report_check(
+    monkeypatch, tmp_path
+):
+    """The report's ``controller_decision_consistent`` check: a freshly
+    solved artifact passes; a coexisting legacy artifact that
+    contradicts the controller's winner on a shared knob axis fails the
+    check (and therefore flips ``consistent`` — the ``--strict`` rc=3
+    surface); a broken redecide audit chain fails too."""
+    from atomo_tpu.obs.report import build_report
+
+    chk_of = lambda rep: next(  # noqa: E731
+        c for c in rep["checks"]
+        if c["name"] == "controller_decision_consistent"
+    )
+    # no artifact: skipped, never failed
+    rep = build_report(str(tmp_path))
+    assert chk_of(rep)["ok"] and chk_of(rep)["skipped"]
+    assert rep["sources"]["controller_decision_json"] is False
+
+    _fake_probe(monkeypatch)
+    ctx = _budget_ctx()
+    doc = _solve(tmp_path, deciders=None, name=CONTROLLER_DECISION_NAME,
+                 budget_ctx=ctx)
+    rep = build_report(str(tmp_path))
+    chk = chk_of(rep)
+    assert chk["ok"] and not chk["skipped"], chk
+    assert rep["sources"]["controller_decision_json"] is True
+
+    # a superseded tune_decision.json contradicting a shared knob axis
+    # is two artifacts claiming the knob vector — the check fails and
+    # the report's consistent bit (the --strict exit) flips with it
+    win_agg = doc["winner"]["knobs"]["aggregate"]
+    legacy = {
+        "kind": "tune_decision", "complete": True,
+        "winner": {"name": "contradiction", "knobs": {
+            "aggregate": "ring" if win_agg != "ring" else "gather",
+        }},
+    }
+    with open(tmp_path / "tune_decision.json", "w") as f:
+        json.dump(legacy, f)
+    rep = build_report(str(tmp_path))
+    chk = chk_of(rep)
+    assert not chk["ok"] and "contradicts" in chk["detail"]
+    assert rep["consistent"] is False
+    os.unlink(tmp_path / "tune_decision.json")
+
+    # a redecide whose knobs_old does not chain off the decision breaks
+    # the audit stream
+    from atomo_tpu.utils.tracing import IncidentLog
+
+    inc = IncidentLog.for_train_dir(str(tmp_path))
+    inc.append(
+        "controller_redecide", step=50, axis="aggregate",
+        knobs_old={"aggregate": "never-was"},
+        knobs_new={"aggregate": "ring"},
+    )
+    rep = build_report(str(tmp_path))
+    chk = chk_of(rep)
+    assert not chk["ok"] and "audit chain" in chk["detail"]
