@@ -28,8 +28,10 @@ Subcommands:
   evaluate   checkpoint-polling evaluator (src/distributed_evaluator.py)
   tune       LR grid search (src/tune.sh + src/tiny_tuning_parser.py)
   lm         LM training over any parallelism layout — dp, dp-sp (ring or
-             Ulysses), dp-tp (Megatron), dp-ep (switch-MoE), dp-pp (GPipe);
-             no reference analogue (DP-only, CV-only)
+             Ulysses), dp-tp (Megatron), dp-ep (switch-MoE), dp-pp (GPipe),
+             dp-tp-sp (3-D) — all compiled through the one mesh path with
+             the compressed dp exchange; no reference analogue (DP-only,
+             CV-only)
 
 `python -m atomo_tpu.cli <flags>` with no subcommand behaves like `train`,
 matching `python distributed_nn.py <flags>`.
@@ -3099,18 +3101,26 @@ def cmd_lm(args: argparse.Namespace) -> int:
     the framework supports, drivable from the CLI (no reference analogue —
     the reference is DP-only and CV-only, SURVEY.md §2.1/§5.7).
 
-    --layout picks the mesh composition; --ways sizes the model axis:
-      dp     pure compressed data parallelism
-      dp-sp  sequence parallelism (ring or Ulysses attention, --attn-impl)
-      dp-tp  Megatron tensor parallelism
-      dp-ep  switch-MoE expert parallelism
-      dp-pp  GPipe pipeline parallelism
+    --layout picks the mesh composition (the ``MeshSpec.from_layout``
+    grammar); --ways sizes the model axis:
+      dp        pure compressed data parallelism
+      dp-sp     sequence parallelism (ring/Ulysses attention, --attn-impl)
+      dp-tp     Megatron tensor parallelism
+      dp-ep     switch-MoE expert parallelism
+      dp-pp     GPipe pipeline parallelism
+      dp-tp-sp  3-D tensor x sequence (--ways sizes tp, --sp-ways sizes sp)
+
+    Every layout compiles through the ONE mesh path
+    (``parallel.model_axes.build_model_axis_program``): the dp gradient
+    exchange rides the compressed stack (gather/psum/ring,
+    --stream-encode), the model-axis collectives ride
+    ``mesh.collectives`` so the comm model can price them.
     """
     import jax
     import numpy as np
 
     from atomo_tpu.codecs import get_codec
-    from atomo_tpu.parallel import launch, make_mesh
+    from atomo_tpu.parallel import launch
     from atomo_tpu.training import make_optimizer
 
     launch.initialize()
@@ -3121,7 +3131,17 @@ def cmd_lm(args: argparse.Namespace) -> int:
             f"--ways {args.ways} only applies to layouts with a model axis; "
             "--layout dp is pure data parallelism — ignoring it"
         )
-    ways = 1 if layout == "dp" else args.ways
+    if args.sp_ways != 2 and layout != "dp-tp-sp":  # 2 is the default
+        warnings.warn(
+            "--sp-ways only applies to --layout dp-tp-sp (the 2-D layouts "
+            "size their one model axis with --ways); ignoring it"
+        )
+    if layout == "dp-tp-sp":
+        ways_arg = (args.ways, args.sp_ways)
+        ways = args.ways * args.sp_ways
+    else:
+        ways = 1 if layout == "dp" else args.ways
+        ways_arg = ways
     if n_dev % ways:
         raise SystemExit(f"--ways {ways} does not divide {n_dev} devices")
     dp = n_dev // ways
@@ -3204,14 +3224,29 @@ def cmd_lm(args: argparse.Namespace) -> int:
     compute_dtype = jax.numpy.bfloat16 if args.bf16 else None
 
     aggregate = args.aggregate
+    if aggregate == "ring" and codec is None:
+        raise SystemExit(
+            "--aggregate ring streams CODEC payloads around the dp axis; "
+            "a dense code has no payloads to rotate — use psum (or pick a "
+            "compressing --code)"
+        )
+    if args.stream_encode and codec is None:
+        warnings.warn(
+            "--stream-encode interleaves CODEC encode with the exchange; "
+            "a dense code has nothing to encode — ignoring it"
+        )
     if aggregate == "auto":
-        # the lm path has no hierarchical mode and therefore NO topology
-        # plan space (allow_hierarchical=False stays load-bearing: the
-        # model axes — sp/tp/ep/pp — already own the second mesh
+        # The lm dp exchange now prices the FULL axis-layout space the
+        # replicated path ships — gather vs psum vs ring over the dp axis
+        # of any model-axis layout (DpExchange routes all three through
+        # the one compressed stack). Hierarchical alone stays out, for a
+        # structural reason (controller.space.MODEL_AXIS_REJECTS
+        # ["hierarchical"], the same reason every reject in that space
+        # states): the model axes — sp/tp/ep/pp — own the second mesh
         # dimension, so there is no free inner data axis for a two-level
-        # schedule to reduce over); auto picks gather vs psum over the dp
-        # axis. Byte budget from the unsharded LM (tp/ep/pp shard both
-        # sides of the ratio equally — decision-equivalent heuristic)
+        # schedule to reduce over. Byte budget from the unsharded LM
+        # (tp/ep/pp shard both sides of the ratio equally —
+        # decision-equivalent heuristic)
         from atomo_tpu.models.transformer import TransformerLM as _LM
         from atomo_tpu.tuning.probe import model_init_fn
 
@@ -3219,75 +3254,55 @@ def cmd_lm(args: argparse.Namespace) -> int:
         _init_params = model_init_fn(_LM(**cfg), sample)
         aggregate = _resolve_auto_aggregate(
             args, codec, _init_params, dp, allow_hierarchical=False,
-            allow_ring=False,  # the lm layouts ship gather/psum only
+        )
+    # ring / stream-encode run through the DpExchange tail (the
+    # compressed-stack route); the plain gather/psum knobs keep
+    # exchange=None — the legacy tail, byte-for-byte (the degeneracy
+    # contract tests/test_model_axes.py pins)
+    exchange = None
+    if args.stream_encode and codec is not None and aggregate == "psum":
+        warnings.warn(
+            "--stream-encode interleaves encode with the FACTOR exchange "
+            "(gather/ring); psum moves the dense decoded tree — ignoring it"
+        )
+    elif aggregate == "ring" or (args.stream_encode and codec is not None):
+        from atomo_tpu.parallel.lm import DpExchange
+
+        exchange = DpExchange(
+            aggregate=aggregate,
+            ring_bucket_size=args.ring_bucket_size,
+            stream_encode=bool(args.stream_encode and codec is not None),
+            stream_bucket_bytes=args.stream_bucket_bytes,
         )
 
     # layout-inapplicable flags: warn, don't silently ignore (the train
     # subcommand's _warn_dead_flags precedent)
     defaults = {"attn_impl": "ring", "num_experts": 8, "microbatches": 2}
-    applicable = {"dp-sp": "attn_impl", "dp-ep": "num_experts", "dp-pp": "microbatches"}
+    applicable = {
+        "attn_impl": ("dp-sp", "dp-tp-sp"),
+        "num_experts": ("dp-ep",),
+        "microbatches": ("dp-pp",),
+    }
     for flag, default in defaults.items():
-        if getattr(args, flag) != default and applicable.get(layout) != flag:
+        if getattr(args, flag) != default and layout not in applicable[flag]:
+            raise_for = "/".join(applicable[flag])
             warnings.warn(
                 f"--{flag.replace('_', '-')} only applies to layout "
-                f"{[k for k, v in applicable.items() if v == flag][0]}; "
-                f"ignored for --layout {layout}"
+                f"{raise_for}; ignored for --layout {layout}"
             )
 
-    specs = None  # stays None for replicated layouts; set by tp/ep/pp
-    if layout in ("dp", "dp-sp"):
-        from atomo_tpu.models.transformer import TransformerLM
-        from atomo_tpu.parallel.lm import make_lm_train_step, shard_tokens
-        from atomo_tpu.parallel.replicated import replicate_state
-        from atomo_tpu.training import create_state
-
-        if args.seq_len % ways:
-            raise SystemExit(f"--seq-len must be divisible by sp ways={ways}")
-        mesh = make_mesh(n_dev, axes=(("dp", dp), ("sp", ways)))
-        sample = jax.numpy.zeros((1, args.seq_len), jax.numpy.int32)
-        state = create_state(TransformerLM(**cfg), optimizer, key, sample)
-        state = replicate_state(mesh, state)
-        step = make_lm_train_step(
-            cfg, optimizer, mesh, codec, attn_impl=args.attn_impl,
-            compute_dtype=compute_dtype, aggregate=aggregate,
+    # layout preflight the builders cannot phrase as one-liners (they see
+    # shapes, not flags): keep the flag-named messages here
+    sp_size = ways if layout == "dp-sp" else (
+        args.sp_ways if layout == "dp-tp-sp" else 1
+    )
+    if args.seq_len % sp_size:
+        raise SystemExit(
+            f"--seq-len must be divisible by sp ways={sp_size}"
         )
-        shard = lambda t: shard_tokens(mesh, t)  # noqa: E731
-    elif layout == "dp-tp":
-        from atomo_tpu.parallel.tp import (
-            create_tp_lm_state, make_tp_lm_train_step, shard_tp_tokens,
-        )
-
-        mesh = make_mesh(n_dev, axes=(("dp", dp), ("tp", ways)))
-        try:
-            state, specs = create_tp_lm_state(mesh, cfg, optimizer, key)
-        except ValueError as e:  # sizing errors -> clean one-liner
-            raise SystemExit(str(e)) from None
-        step = make_tp_lm_train_step(
-            cfg, optimizer, mesh, specs, codec, compute_dtype=compute_dtype,
-            aggregate=aggregate,
-        )
-        shard = lambda t: shard_tp_tokens(mesh, t)  # noqa: E731
-    elif layout == "dp-ep":
-        from atomo_tpu.parallel.moe import (
-            create_moe_lm_state, make_moe_lm_train_step, shard_moe_tokens,
-        )
-
+    if layout == "dp-ep":
         cfg["num_experts"] = args.num_experts
-        mesh = make_mesh(n_dev, axes=(("dp", dp), ("ep", ways)))
-        try:
-            state, specs = create_moe_lm_state(mesh, cfg, optimizer, key)
-        except ValueError as e:
-            raise SystemExit(str(e)) from None
-        step = make_moe_lm_train_step(
-            cfg, optimizer, mesh, specs, codec, compute_dtype=compute_dtype,
-            aggregate=aggregate,
-        )
-        shard = lambda t: shard_moe_tokens(mesh, t)  # noqa: E731
-    elif layout == "dp-pp":
-        from atomo_tpu.parallel.pp import (
-            create_pp_lm_state, make_pp_lm_train_step, shard_pp_tokens,
-        )
-
+    if layout == "dp-pp":
         if args.depth % ways:
             raise SystemExit(
                 f"--depth {args.depth} must be divisible by pp ways={ways}"
@@ -3297,19 +3312,28 @@ def cmd_lm(args: argparse.Namespace) -> int:
                 f"per-replica batch {args.batch_size // dp} not divisible "
                 f"by --microbatches {args.microbatches}"
             )
-        mesh = make_mesh(n_dev, axes=(("dp", dp), ("pp", ways)))
-        try:
-            state, specs = create_pp_lm_state(mesh, cfg, optimizer, key)
-        except ValueError as e:
-            raise SystemExit(str(e)) from None
-        step = make_pp_lm_train_step(
-            cfg, optimizer, mesh, specs, codec,
+
+    # the ONE compile path: every layout resolves through MeshSpec +
+    # build_model_axis_program — same axes tuples, same builders, same
+    # compiled programs as the old per-layout ladder (bit-parity pinned
+    # by tests/test_model_axes.py)
+    from atomo_tpu.mesh.spec import MeshSpec
+    from atomo_tpu.parallel.model_axes import build_model_axis_program
+
+    try:
+        spec = MeshSpec.from_layout(layout, n_dev, ways_arg)
+        prog = build_model_axis_program(
+            spec, cfg, optimizer, key, codec,
+            attn_impl=args.attn_impl,
             num_microbatches=args.microbatches,
-            compute_dtype=compute_dtype, aggregate=aggregate,
+            compute_dtype=compute_dtype,
+            aggregate=aggregate,
+            exchange=exchange,
         )
-        shard = lambda t: shard_pp_tokens(mesh, t)  # noqa: E731
-    else:  # pragma: no cover - argparse choices guard this
-        raise SystemExit(f"unknown --layout {layout}")
+    except ValueError as e:  # sizing errors -> clean one-liner
+        raise SystemExit(str(e)) from None
+    mesh, state, specs = prog.mesh, prog.state, prog.state_specs
+    step, shard = prog.step, prog.shard_tokens
 
     rng = np.random.default_rng(args.seed)
 
@@ -3455,14 +3479,41 @@ def cmd_lm(args: argparse.Namespace) -> int:
             start = int(state.step)
             print(f"Resumed from {args.train_dir} at step {start}", flush=True)
 
+    recorder = None
+    if args.train_dir:
+        # flight-record the lm run so `report` can cross-check the
+        # RECORDED axis layout against what actually executed (a resumed
+        # run on a reshaped mesh contradicts its own metrics.jsonl)
+        from atomo_tpu.obs import FlightRecorder
+
+        recorder = FlightRecorder.for_train_dir(args.train_dir)
+        if start:
+            recorder.prune_past(start)
+        recorder.set_context(aggregate=aggregate)
+        recorder.write_meta({
+            "what": "model_axes",
+            "layout": layout,
+            "mesh_axes": spec.shape_dict(),
+            "exchange": (
+                None if exchange is None else {
+                    "aggregate": exchange.aggregate,
+                    "stream_encode": exchange.stream_encode,
+                }
+            ),
+        })
+
     save_freq = args.save_freq
     for i in range(start + 1, args.max_steps + 1):
         t0 = time.time()
         state, metrics = step(state, jax.random.fold_in(key, i), next_batch())
         loss = float(metrics["loss"])  # device sync: honest step timing
+        if recorder is not None:
+            recorder.record_block(
+                i, jax.device_get(metrics), wall_s=time.time() - t0
+            )
         if i % args.log_interval == 0 or i == args.max_steps:
             print(
-                f"LM: Step: {i}, Layout: {layout}({dp}x{ways}), "
+                f"LM: Step: {i}, Layout: {layout}({spec.describe()}), "
                 f"Loss: {loss:.4f}, PPL: {math.exp(min(loss, 30.0)):.2f}, "
                 f"Time Cost: {time.time() - t0:.4f}, "
                 f"Msg(MB): {float(metrics['msg_bytes']) / 1e6:.4f}, "
@@ -3617,12 +3668,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_lm = sub.add_parser(
         "lm",
-        help="LM training over any parallelism layout (dp/sp/tp/ep/pp)",
+        help="LM training over any parallelism layout "
+             "(dp/sp/tp/ep/pp/tp-sp), compressed dp exchange throughout",
     )
     p_lm.add_argument("--layout", type=str, default="dp",
-                      choices=["dp", "dp-sp", "dp-tp", "dp-ep", "dp-pp"])
+                      choices=["dp", "dp-sp", "dp-tp", "dp-ep", "dp-pp",
+                               "dp-tp-sp"])
     p_lm.add_argument("--ways", type=int, default=2, metavar="N",
-                      help="model-axis size (sp/tp/ep/pp shards)")
+                      help="model-axis size (sp/tp/ep/pp shards; the tp "
+                           "size for dp-tp-sp)")
+    p_lm.add_argument("--sp-ways", type=int, default=2, metavar="N",
+                      help="sp size for --layout dp-tp-sp (sequence shards "
+                           "inside each tp group)")
     p_lm.add_argument("--attn-impl", type=str, default="ring",
                       choices=["ring", "ulysses", "ulysses-flash"],
                       help="dp-sp sequence-parallel strategy; ulysses-flash "
@@ -3675,10 +3732,23 @@ def build_parser() -> argparse.ArgumentParser:
                            "explicit rank below the width floor warns "
                            "(artifacts/LM_CONVERGENCE.md)")
     p_lm.add_argument("--aggregate", type=str, default="auto",
-                      choices=["auto", "gather", "psum"],
+                      choices=["auto", "gather", "psum", "ring"],
                       help="dp gradient exchange: factor all_gather vs "
-                           "dense all-reduce; auto picks from the comm-cost "
-                           "model and prints why")
+                           "dense all-reduce vs streamed ring (the "
+                           "compressed stack's DpExchange route); auto "
+                           "picks from the comm-cost model and prints why")
+    p_lm.add_argument("--ring-bucket-size", type=int, default=0,
+                      metavar="B",
+                      help="--aggregate ring payload bucket elements "
+                           "(0 = unbucketed)")
+    p_lm.add_argument("--stream-encode", action="store_true", default=False,
+                      help="interleave per-layer encode with the factor "
+                           "exchange (gather/ring; the replicated path's "
+                           "stream-encode, now on the model-axis layouts)")
+    p_lm.add_argument("--stream-bucket-bytes", type=int, default=4 << 20,
+                      metavar="B",
+                      help="layer-bucket coalescing bound for "
+                           "--stream-encode")
     p_lm.add_argument("--fabric", type=str, default="auto", metavar="F",
                       help="fabric for --aggregate auto's advisory line: "
                            "auto | ici | dcn | eth10g | a per-chip GB/s "

@@ -46,6 +46,7 @@ from atomo_tpu.controller.space import (
     DECIDERS,
     candidate_predicate,
     joint_candidates,
+    lm_axis_candidates,
     normalize_deciders,
 )
 
@@ -134,6 +135,10 @@ def solve_controller(
     context: Optional[dict] = None,
     fabric_probe: Optional[dict] = None,
     error_feedback: bool = False,
+    mesh_spec=None,
+    lm_codec_tag: str = "",
+    lm_model_comm_s: float = 0.0,
+    lm_pipeline_bubble_s: float = 0.0,
     log_fn=print,
 ) -> dict:
     """One joint solve (module docstring); returns the finished decision
@@ -148,7 +153,19 @@ def solve_controller(
     ``plan_hybrid`` argument triple) additionally enables the
     ``+sp+ab`` cross term by re-planning under the wrapped codec —
     without it the cross term is skipped and the log says so (scoped
-    honestly, never guessed)."""
+    honestly, never guessed).
+
+    ``mesh_spec`` (a :class:`~atomo_tpu.mesh.spec.MeshSpec`) records the
+    run's FULL named-axis shape in ``meta.mesh_axes`` (so
+    ``decision_reusable``/``controller_reusable`` refuse a model-axis
+    shape mismatch on resume, not just a device-count change); when it
+    carries live model axes the space additionally gains the layout's
+    ``lm[...]`` candidates (:func:`~atomo_tpu.controller.space.
+    lm_axis_candidates`) — priced from the dp wire plus the
+    ``lm_model_comm_s`` / ``lm_pipeline_bubble_s`` axis-collective
+    floor, never probed (the quorum precedent: the probe harness builds
+    replicated-family programs). ``lm_codec_tag`` names the codec in
+    those rows (``lm[tp2]+qsgd8+...``)."""
     from atomo_tpu.tuning.autopilot import tune
 
     d = normalize_deciders(deciders)
@@ -206,6 +223,30 @@ def solve_controller(
         quorum_q=quorum_q,
         quorum_staleness_options=quorum_staleness_options,
     )
+    lm_axes = (
+        dict(mesh_spec.model_axes)
+        if mesh_spec is not None
+        and any(s > 1 for _, s in mesh_spec.model_axes)
+        else None
+    )
+    if lm_axes and not error_feedback:
+        lm_rows = lm_axis_candidates(
+            model_axes=lm_axes,
+            codec_tag=lm_codec_tag,
+            allow_ring=allow_ring,
+            ring_bucket_size=ring_bucket_size,
+            allow_stream=allow_stream,
+            stream_bucket_bytes=stream_bucket_bytes,
+            have_budget=have_budget,
+            model_comm_s=lm_model_comm_s,
+            pipeline_bubble_s=lm_pipeline_bubble_s,
+        )
+        extra = list(extra) + lm_rows
+        log_fn(
+            f"Controller: + {len(lm_rows)} model-axis lm candidates for "
+            f"{mesh_spec.describe()} (priced, never probed — the probe "
+            "harness builds replicated-family programs)"
+        )
     # EF keeps the budget dial (the wrapped codec composes with residual
     # carry) but tune() narrows everything else; the joint cross terms
     # above are exactly the programs EF rejects, so they are not built
@@ -229,6 +270,18 @@ def solve_controller(
             "deciders": sorted(d),
             "supersedes": ["tune_decision.json", "budget_alloc.json"],
             "pack_kernel": pack_kernel_record(codec),
+            # the model-axis layout this decision was solved FOR (None =
+            # pure data layout): report cross-checks it against the
+            # run's metrics.jsonl, and the full shape also lands in
+            # meta.mesh_axes via tune(mesh_spec=) for the resume refusal
+            **(
+                {
+                    "model_axes": lm_axes,
+                    "layout": mesh_spec.layout_name(),
+                }
+                if lm_axes
+                else {}
+            ),
         },
     }
     if have_budget and alloc is not None:
@@ -315,6 +368,7 @@ def solve_controller(
         candidate_filter=candidate_predicate(d),
         kind="controller_decision",
         hybrid_for_candidate=hybrid_for_candidate,
+        mesh_spec=mesh_spec,
         log_fn=log_fn,
     )
     return doc

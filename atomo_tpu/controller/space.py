@@ -181,3 +181,121 @@ def joint_candidates(
     for c in out:
         c["name"] = candidate_name(c)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Model-axis LM candidates: the lm[...] corner of the joint space
+# ---------------------------------------------------------------------------
+
+#: Codec compositions PROVEN on the model-axis dp exchange (bit-parity /
+#: bit-identical-payload tests, tests/test_model_axes.py) vs rejected
+#: with honest reasons. A knob absent from both maps composes freely.
+MODEL_AXIS_REJECTS = {
+    "hierarchical": (
+        "the model axes (tp/pp/ep/sp) own the second mesh dimension — "
+        "there is no free inner data axis for a two-level schedule to "
+        "reduce over"
+    ),
+    "sparse_rows": (
+        "the hybrid sparse-row planner is unproven on the LM param "
+        "trees (its row heuristics were fit to conv kernels); honest "
+        "reject until a parity test lands"
+    ),
+    "quorum": (
+        "quorum aggregation rides the replicated train loop's delayed "
+        "rig (ok-flags, staleness carry); the model-axis steps apply "
+        "the update inline — no rig to bound staleness with"
+    ),
+    "overlap_delayed": (
+        "delayed overlap needs the consume-next-step carry of the "
+        "replicated loop; the model-axis steps apply the update inline "
+        "— not implemented, honest reject"
+    ),
+}
+
+
+def model_axis_conflicts(cand: dict) -> Optional[str]:
+    """The honest-reject reason a knob vector cannot run on a model-axis
+    LM layout, or None when the composition is PROVEN (gather/psum/ring,
+    stream-encode, variance budget — the tested degenerate points).
+
+    This is the ISSUE's "conflict rejects lifted one by one" surface:
+    every lift deletes an entry from :data:`MODEL_AXIS_REJECTS` and adds
+    a parity test; every remaining entry names why, so a reject is a
+    statement, not a silent filter."""
+    if cand.get("aggregate") == "hierarchical":
+        return MODEL_AXIS_REJECTS["hierarchical"]
+    if cand.get("sparse_rows") == "on":
+        return MODEL_AXIS_REJECTS["sparse_rows"]
+    if cand.get("quorum"):
+        return MODEL_AXIS_REJECTS["quorum"]
+    if cand.get("overlap", "off") == "delayed":
+        return MODEL_AXIS_REJECTS["overlap_delayed"]
+    return None
+
+
+def lm_axis_candidates(
+    *,
+    model_axes: dict,
+    codec_tag: str = "",
+    allow_ring: bool = True,
+    ring_bucket_size: int = 65536,
+    allow_stream: bool = True,
+    stream_bucket_bytes: int = 4 << 20,
+    have_budget: bool = False,
+    model_comm_s: float = 0.0,
+    pipeline_bubble_s: float = 0.0,
+) -> list[dict]:
+    """Knob vectors for ONE model-axis LM layout — the ``lm[tp2]+qsgd8+se``
+    rows the controller enumerates next to the replicated candidates.
+
+    ``model_axes`` is the layout's model-axis shape dict (``{"tp": 2}``);
+    ``model_comm_s`` / ``pipeline_bubble_s`` are the layout's PRE-PRICED
+    axis-collective floor (``comm_model.tp_psum_wire_bytes`` /
+    ``moe_all_to_all_wire_bytes`` / ``pipeline_bubble_s`` over the
+    measured fabric) that ``predict_step_s`` adds to every prediction.
+    Only PROVEN compositions are emitted (:func:`model_axis_conflicts`
+    returns None for each, asserted); like quorum rows, these are priced,
+    never probed — the probe harness builds replicated-family programs.
+    Pure and deterministic."""
+    from atomo_tpu.utils.comm_model import candidate_name
+
+    axes = {
+        str(a): int(s)
+        for a, s in dict(model_axes).items()
+        if a not in ("dp", "ici")
+    }
+    if not axes:
+        raise ValueError(
+            "lm_axis_candidates needs at least one model axis; a pure "
+            "data layout's candidates come from enumerate_candidates"
+        )
+    shared = {
+        "model_axes": axes,
+        "overlap": "off",
+        "superstep": 1,
+        "model_comm_s": float(model_comm_s),
+        "pipeline_bubble_s": float(pipeline_bubble_s),
+    }
+    if codec_tag:
+        shared["codec"] = str(codec_tag)
+    out: list[dict] = []
+    aggs = ["gather", "psum"] + (["ring"] if allow_ring else [])
+    for agg in aggs:
+        base = {**shared, "aggregate": agg}
+        if agg == "ring":
+            base["ring_bucket_size"] = int(ring_bucket_size)
+        out.append(dict(base))
+        if allow_stream and agg in ("gather", "ring"):
+            out.append({
+                **base,
+                "stream_encode": "on",
+                "stream_bucket_bytes": int(stream_bucket_bytes),
+            })
+        if have_budget:
+            out.append({**base, "budget_alloc": "variance"})
+    for c in out:
+        reason = model_axis_conflicts(c)
+        assert reason is None, f"emitted a rejected composition: {reason}"
+        c["name"] = candidate_name(c)
+    return out

@@ -24,7 +24,11 @@ from atomo_tpu.mesh.update import (
     sharded_state_from_params,
     sharded_update_state,
 )
-from atomo_tpu.mesh.reshard import reshard_plan, reshard_sharded_update
+from atomo_tpu.mesh.reshard import (
+    reshard_model_axes,
+    reshard_plan,
+    reshard_sharded_update,
+)
 
 __all__ = [
     "MeshSpec",
@@ -34,6 +38,7 @@ __all__ = [
     "chunk_len",
     "flat_opt_state",
     "place_sharded_update",
+    "reshard_model_axes",
     "reshard_plan",
     "reshard_sharded_update",
     "sharded_state_from_params",

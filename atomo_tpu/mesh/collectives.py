@@ -46,3 +46,32 @@ def all_gather_tiled(x, axis):
 def psum_mean(x, axis):
     """Dense mean over named data axes (the psum exchange)."""
     return jax.lax.pmean(x, axis)
+
+
+def psum(x, axis):
+    """Dense sum over a named axis — the model-axis completion collective
+    (Megatron TP partial-product reduction, pp/moe gradient completion:
+    model shards hold PARTS of one replica's value, so sum, don't mean)."""
+    return jax.lax.psum(x, axis)
+
+
+def pipeline_perm(n: int) -> list[tuple[int, int]]:
+    """The pipeline forward shift ``i -> i+1 (mod n)``: stage i's
+    activations move to stage i+1 each tick (the GPipe microbatch chain).
+    The opposite rotation from :func:`ring_perm` — activations flow DOWN
+    the stage order while ring payload chunks flow up it."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def ppermute_pipeline(x, axis: str, n: int):
+    """One pipeline tick: shift ``x`` to the next stage over ``axis``."""
+    return jax.lax.ppermute(x, axis, pipeline_perm(n))
+
+
+def all_to_all_tiled(x, axis: str, *, split_axis: int, concat_axis: int):
+    """Tiled all_to_all over a named axis — the MoE dispatch/return
+    shuffle (split one array dim across the axis peers, concatenate what
+    arrives along another)."""
+    return jax.lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )

@@ -97,3 +97,113 @@ def reshard_plan(
     captures both shapes either way."""
     new = MeshSpec.from_world(n_devices, dcn_ways)
     return None if new == old_spec else new
+
+
+# ---------------------------------------------------------------------------
+# Model-axis layout redistribution: lm <-> tp as a reshard, not a restart
+# ---------------------------------------------------------------------------
+
+#: Which param-tree LAYOUT each LM mesh layout stores: the replicated
+#: layouts hold the plain TransformerLM tree, the tensor-parallel ones
+#: hold the head-sliced re-layout (``parallel.tp.lm_params_to_tp``).
+#: dp-ep / dp-pp are absent ON PURPOSE: their param trees are
+#: layout-owned (expert- / stage-sharded shapes with no bijection to the
+#: flat tree proven here) — redistribution for them goes through the
+#: checkpoint round-trip, and :func:`reshard_model_axes` says so.
+_LAYOUT_PARAM_FAMILY = {
+    "dp": "lm",
+    "dp-sp": "lm",
+    "dp-tp": "tp",
+    "dp-tp-sp": "tp",
+}
+
+
+def reshard_model_axes(
+    state,
+    old_spec: MeshSpec,
+    new_spec: MeshSpec,
+    lm_config: dict,
+    *,
+    devices=None,
+):
+    """Redistribute a LIVE LM train state between model-axis layouts —
+    e.g. a replicated ``dp`` run onto a ``dp-tp`` mesh (or back) without
+    a checkpoint round-trip.
+
+    The param re-layout is the same pure bijection the builders use
+    (``lm_params_to_tp`` / ``tp_params_to_lm``), applied to the params
+    AND to every optimizer-state subtree that mirrors the param tree
+    (the momentum/mu/nu family) — so the resharded run continues the
+    SAME optimizer trajectory, bit-for-bit, exactly as if the target
+    layout had been built fresh from these host values (tested:
+    reshard == fresh-build + continue, tests/test_model_axes.py).
+
+    Returns ``(mesh, state, state_specs)`` with ``state_specs`` None for
+    the replicated target layouts — the same triple
+    ``build_model_axis_program`` hands a driver.
+    """
+    old_layout = old_spec.layout_name()
+    new_layout = new_spec.layout_name()
+    fam_old = _LAYOUT_PARAM_FAMILY.get(old_layout)
+    fam_new = _LAYOUT_PARAM_FAMILY.get(new_layout)
+    if fam_old is None or fam_new is None:
+        bad = old_layout if fam_old is None else new_layout
+        raise ValueError(
+            f"layout {bad!r} stores a layout-owned param tree (expert/"
+            "stage sharded); live redistribution is proven only between "
+            f"{sorted(_LAYOUT_PARAM_FAMILY)} — go through a checkpoint "
+            "save/restore instead"
+        )
+    # lazy: mesh.* must not import parallel.* at module level (cycle)
+    from atomo_tpu.parallel.tp import lm_params_to_tp, tp_params_to_lm
+    from atomo_tpu.training.trainer import TrainState
+
+    num_heads = int(lm_config["num_heads"])
+    params = jax.device_get(state.params)
+    opt = jax.device_get(state.opt_state)
+    stats = jax.device_get(state.batch_stats)
+    if not jax.tree_util.tree_leaves(stats):
+        # the LM families carry no batch stats; normalize the empty
+        # container (create_state's FrozenDict vs create_tp_lm_state's
+        # dict) so the specs tree matches the target builder's exactly
+        stats = {}
+    if fam_old != fam_new:
+        convert = lm_params_to_tp if fam_new == "tp" else tp_params_to_lm
+        p_def = jax.tree_util.tree_structure(params)
+
+        def params_like(node) -> bool:
+            return jax.tree_util.tree_structure(node) == p_def
+
+        params = convert(params, num_heads)
+        # momentum carried EXACTLY: the same bijection on every
+        # params-shaped optimizer buffer, scalars (counts) untouched
+        opt = jax.tree_util.tree_map(
+            lambda sub: convert(sub, num_heads) if params_like(sub) else sub,
+            opt,
+            is_leaf=params_like,
+        )
+    mesh = new_spec.build(devices)
+    host = TrainState(
+        step=jnp.asarray(jax.device_get(state.step), jnp.int32),
+        params=params,
+        batch_stats=stats,
+        opt_state=opt,
+    )
+    if fam_new == "lm":
+        from atomo_tpu.parallel.replicated import replicate_state
+
+        return mesh, replicate_state(mesh, host), None
+    n_tp = dict(new_spec.axes)["tp"]
+    if lm_config["num_heads"] % n_tp or lm_config["vocab_size"] % n_tp:
+        raise ValueError(
+            f"num_heads {lm_config['num_heads']} / vocab_size "
+            f"{lm_config['vocab_size']} must divide by tp={n_tp}"
+        )
+    from atomo_tpu.parallel.tp import (
+        make_tp_state_specs,
+        shard_tp_state,
+        tp_param_specs,
+    )
+
+    specs = make_tp_state_specs(host, tp_param_specs(params, "tp"))
+    return mesh, shard_tp_state(mesh, host, specs), specs

@@ -30,6 +30,24 @@ from typing import Optional, Sequence
 
 import jax
 
+#: Model axes the layout grammar understands, in the order they appear in
+#: a layout name. These shard the MODEL (or the sequence), not the batch
+#: replicas: gradients are completed ACROSS them (psum / pmean) before the
+#: data-parallel exchange, so the compressed dp wire never sees them.
+MODEL_AXES = ("tp", "pp", "ep", "sp")
+
+#: The LM layout grammar (cli ``lm --layout``): layout name -> the model
+#: axes it adds after ``dp``. ``dp-tp-sp`` is the 3-D Megatron x ring
+#: composition; everything else is 2-D.
+LAYOUT_MODEL_AXES = {
+    "dp": (),
+    "dp-sp": ("sp",),
+    "dp-tp": ("tp",),
+    "dp-ep": ("ep",),
+    "dp-pp": ("pp",),
+    "dp-tp-sp": ("tp", "sp"),
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
@@ -75,6 +93,58 @@ class MeshSpec:
         return cls((("dp", n),))
 
     @classmethod
+    def from_layout(
+        cls, layout: str, n_devices: int, ways=1
+    ) -> "MeshSpec":
+        """The ONE resolution of (``--layout``, ``--ways``) to a mesh shape
+        — the LM model-axis counterpart of :meth:`from_world`.
+
+        Reproduces exactly the axes tuples ``cli.cmd_lm`` used to hand
+        ``make_mesh`` (same axes -> same mesh -> same compiled program):
+        ``dp`` is ``(dp=N, sp=1)`` (the dp x sp step with a degenerate
+        sequence axis — same program text, degenerate shape), the 2-D
+        layouts are ``(dp=N/ways, <axis>=ways)``, and ``dp-tp-sp`` takes
+        ``ways`` as a ``(tp, sp)`` pair. Divisibility mirrors the CLI
+        preflight: the model ways must divide the device count.
+        """
+        if layout not in LAYOUT_MODEL_AXES:
+            raise ValueError(
+                f"unknown layout {layout!r}; expected one of "
+                f"{sorted(LAYOUT_MODEL_AXES)}"
+            )
+        n = int(n_devices)
+        if n < 1:
+            raise ValueError(f"n_devices must be >= 1, got {n}")
+        model = LAYOUT_MODEL_AXES[layout]
+        if layout == "dp-tp-sp":
+            try:
+                tp_ways, sp_ways = (int(w) for w in ways)
+            except TypeError:
+                raise ValueError(
+                    "layout 'dp-tp-sp' takes ways as a (tp, sp) pair"
+                ) from None
+            sizes = (tp_ways, sp_ways)
+        else:
+            sizes = (int(ways),) * len(model)
+        m = 1
+        for s in sizes:
+            if s < 1:
+                raise ValueError(f"model ways must be >= 1, got {s}")
+            m *= s
+        if n % m:
+            raise ValueError(
+                f"model ways {m} (layout {layout!r}) does not divide "
+                f"{n} devices"
+            )
+        if layout == "dp":
+            # cmd_lm's dp layout runs the dp x sp program with sp=1 —
+            # keep the axes tuple identical so the program family is too
+            return cls((("dp", n), ("sp", 1)))
+        return cls(
+            (("dp", n // m),) + tuple(zip(model, sizes))
+        )
+
+    @classmethod
     def from_shape_dict(cls, d) -> Optional["MeshSpec"]:
         """Inverse of :meth:`shape_dict` for artifact round-trips.
 
@@ -110,6 +180,16 @@ class MeshSpec:
         return tuple(n for n in self.names if n in ("dp", "ici"))
 
     @property
+    def model_axes(self) -> tuple[tuple[str, int], ...]:
+        """The non-data (model/sequence) axes with their sizes, in mesh
+        order — empty for the pure data-parallel shapes. Degenerate
+        size-1 model axes are included (they are part of the program
+        family: ``dp4 x sp1`` and ``dp4`` lower differently)."""
+        return tuple(
+            (n, s) for n, s in self.axes if n not in ("dp", "ici")
+        )
+
+    @property
     def inner_axis(self) -> Optional[str]:
         return "ici" if "ici" in self.names else None
 
@@ -137,6 +217,21 @@ class MeshSpec:
         """Human grammar: ``dp4``, ``dp2xici2`` — the string log lines and
         bench rows print."""
         return "x".join(f"{n}{s}" for n, s in self.axes)
+
+    def layout_name(self) -> str:
+        """The ``--layout`` string this shape answers to: the inverse of
+        :meth:`from_layout` up to degenerate model axes (``dp4 x sp1``
+        renders as ``dp`` — that IS the layout the CLI built it from).
+        Raises for shapes outside the LM layout grammar (an ``ici``
+        two-tier mesh is a data layout, not a model layout)."""
+        live = tuple(n for n, s in self.model_axes if s > 1)
+        name = "-".join(("dp",) + live)
+        if "ici" in self.names or name not in LAYOUT_MODEL_AXES:
+            raise ValueError(
+                f"mesh shape {self.describe()} is not an LM model-axis "
+                f"layout (grammar: {sorted(LAYOUT_MODEL_AXES)})"
+            )
+        return name
 
     def build(self, devices: Optional[Sequence["jax.Device"]] = None):
         """Materialize the ``jax.sharding.Mesh`` (first ``n_devices`` of

@@ -729,6 +729,56 @@ def _check_controller_decision(ctl, tune, budget_doc, incidents) -> dict:
     )
 
 
+def _check_model_axes_layout(ctl, metas) -> dict:
+    """``model_axes_layout_consistent`` — the RECORDED axis layout must
+    be one story across artifacts: the controller decision's
+    ``meta.controller.layout``/``mesh_axes`` (what the knobs were solved
+    FOR) against the run's own ``metrics.jsonl`` ``model_axes`` meta
+    record (what the lm loop actually executed). A contradiction means
+    the decision was resumed onto a reshaped mesh — a different program
+    family wearing the old knob vector (``--strict`` exits 3, like every
+    consistency check). Skipped when either side is unrecorded."""
+    name = "model_axes_layout_consistent"
+    run_meta = next(
+        (m for m in metas if m.get("what") == "model_axes"), None
+    )
+    ctl_meta = ((ctl or {}).get("meta") or {})
+    ctl_controller = ctl_meta.get("controller") or {}
+    ctl_layout = ctl_controller.get("layout")
+    if run_meta is None or ctl_layout is None:
+        return _check(
+            name,
+            True,
+            "layout recorded on one side at most (no cross-check "
+            "possible)",
+            skipped=True,
+        )
+    bad = []
+    run_layout = run_meta.get("layout")
+    if run_layout != ctl_layout:
+        bad.append(
+            f"controller decision was solved for layout {ctl_layout!r} "
+            f"but metrics.jsonl records the run executing {run_layout!r}"
+        )
+    ctl_axes = ctl_meta.get("mesh_axes")
+    run_axes = run_meta.get("mesh_axes")
+    if (
+        isinstance(ctl_axes, dict)
+        and isinstance(run_axes, dict)
+        and dict(ctl_axes) != dict(run_axes)
+    ):
+        bad.append(
+            f"controller decision mesh {dict(ctl_axes)} contradicts the "
+            f"executed mesh {dict(run_axes)}"
+        )
+    return _check(
+        name,
+        not bad,
+        "; ".join(bad)
+        or f"decision and run agree on layout {ctl_layout!r}",
+    )
+
+
 def build_report(train_dir: str) -> dict:
     """Join the run's artifacts into the report document (see module
     docstring). Pure read — writing run_report.json is the caller's move
@@ -827,6 +877,7 @@ def build_report(train_dir: str) -> dict:
         _check_quorum_schedule(steps, incidents, sched_meta,
                                sched_arrivals),
         _check_controller_decision(ctl, tune, budget_doc, incidents),
+        _check_model_axes_layout(ctl, metas),
     ]
     consistent = all(c["ok"] for c in checks)
     summary = {

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from atomo_tpu.mesh.collectives import psum as _axis_psum
 from atomo_tpu.training.trainer import TrainState
 
 
@@ -233,7 +234,7 @@ def complete_model_axis_grads(grads, param_specs, axis: str, divide_by: int = 1)
 
     def one(g, sp):
         sharded = any(a == axis for a in sp if a is not None)
-        full = g if sharded else jax.lax.psum(g, axis)
+        full = g if sharded else _axis_psum(g, axis)
         return full / divide_by if divide_by != 1 else full
 
     return jax.tree_util.tree_map(one, grads, param_specs)

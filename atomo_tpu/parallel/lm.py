@@ -19,6 +19,7 @@ scalar.
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 
 
@@ -31,10 +32,15 @@ from atomo_tpu.codecs import (
     decode_mean_tree,
     decode_tree,
     encode_tree,
+    encode_tree_streamed,
     tree_nbytes,
 )
+from atomo_tpu.mesh.collectives import ppermute_ring
+from atomo_tpu.parallel.common import plan_layer_buckets
+from atomo_tpu.parallel.compile import compile_step
 from atomo_tpu.parallel.ring import ATTENTION_IMPLS
 from atomo_tpu.training.trainer import TrainState, cast_params
+from atomo_tpu.utils.tracing import named_phase
 
 
 def sp_boundary_targets_and_mask(tokens, sp_axis: str, n_sp: int):
@@ -44,10 +50,9 @@ def sp_boundary_targets_and_mask(tokens, sp_axis: str, n_sp: int):
     is masked out. Returns (targets, valid) of shape (B, S_local) — the
     contract shared by the dp x sp and dp x tp x sp loss functions, so
     sharded and unsharded training compute the same scalar CE."""
-    nxt = jax.lax.ppermute(
-        tokens[:, :1], sp_axis,
-        [(i, (i - 1) % n_sp) for i in range(n_sp)],
-    )
+    # one ring hop (mesh.collectives.ring_perm — the SAME rotation every
+    # ring schedule uses): shard i's first column arrives at shard i-1
+    nxt = ppermute_ring(tokens[:, :1], sp_axis, n_sp)
     targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
     valid = jnp.ones(targets.shape, jnp.float32)
     is_last = (jax.lax.axis_index(sp_axis) == n_sp - 1).astype(jnp.float32)
@@ -118,6 +123,170 @@ def compressed_dp_update(
     return new_state, metrics
 
 
+@dataclasses.dataclass(frozen=True)
+class DpExchange:
+    """The data-parallel gradient-exchange recipe of a model-axis step —
+    the knob vector of the compressed stack, carried as ONE static value.
+
+    Passing ``exchange=`` to a model-axis step builder routes its dp tail
+    through :func:`compressed_dp_exchange` (the scoped, full-stack tail:
+    ring aggregation, stream-encode buckets, per-leaf budget codecs all
+    compose); ``exchange=None`` keeps the legacy
+    :func:`compressed_dp_update` tail byte-for-byte. The fields mirror the
+    replicated family's knob names (``utils.comm_model.candidate_name``
+    algebra), so a controller candidate maps onto this dataclass
+    field-for-field.
+    """
+
+    aggregate: str = "gather"  # gather | psum | ring
+    ring_bucket_size: int = 0
+    stream_encode: bool = False
+    stream_bucket_bytes: int = 4 << 20
+
+    def __post_init__(self):
+        if self.aggregate not in ("gather", "psum", "ring"):
+            raise ValueError(
+                f"unknown aggregate mode {self.aggregate!r}; the model-axis "
+                "dp exchange ships gather | psum | ring"
+            )
+
+
+def compressed_dp_exchange(
+    optimizer,
+    codec,
+    state: TrainState,
+    k_codec,
+    grads,
+    loss,
+    *,
+    dp_axis: str,
+    n_dp: int,
+    exchange: DpExchange,
+):
+    """The full-stack dp tail of the model-axis steps: the same contract as
+    :func:`compressed_dp_update` (encode this shard's completed gradient,
+    exchange over dp, decode+mean identically everywhere, apply the
+    optimizer) with the rest of the compressed stack composed in —
+
+      * ``named_phase`` scopes (``encode`` / ``exchange`` / ``decode_mean``
+        / ``ring_exchange_decode``) label the traced regions, so ``report
+        timeline`` finds the same anchors in every model-axis program
+        family that it finds in the replicated family;
+      * ``aggregate="ring"`` streams payload chunks around the dp ring
+        (:func:`atomo_tpu.parallel.replicated._ring_stream_mean` — the
+        same canonical staged mean, so replicas stay bit-equal);
+      * ``stream_encode`` encodes per layer bucket
+        (:func:`atomo_tpu.parallel.common.plan_layer_buckets` — payloads
+        bit-identical to the monolithic encode, dataflow overlappable);
+      * per-leaf budget codecs (``--budget-alloc variance``'s PerLeafCodec)
+        flow through ``encode_tree``'s per-leaf resolution untouched.
+
+    Gradients may be model-sharded on other mesh axes: each shard
+    exchanges its own completed slice over dp, exactly as the legacy tail.
+    """
+    dense_bytes = tree_nbytes(grads)
+    agg = exchange.aggregate
+    if codec is None:
+        if agg == "ring":
+            raise ValueError(
+                "aggregate='ring' needs a codec: the ring streams encoded "
+                "payload chunks; a dense ring would just be a slower pmean"
+            )
+        with named_phase("exchange"):
+            mean_grads = jax.lax.pmean(grads, dp_axis)
+        msg_bytes = dense_bytes
+    elif agg == "psum":
+        with named_phase("encode"):
+            payloads, _ = encode_tree(codec, k_codec, grads)
+            decoded = decode_tree(codec, payloads, grads)
+        with named_phase("exchange"):
+            mean_grads = jax.lax.pmean(decoded, dp_axis)
+        msg_bytes = dense_bytes  # the wire truly carries dense bytes here
+    else:
+        # stream_encode: per-layer-bucket encode (reverse-topological
+        # plan, global-leaf-index keys) — bit-identical payloads whose
+        # dataflow lets each bucket's encode run under backprop of the
+        # layers feeding the next bucket; off keeps the monolithic call
+        # byte-for-byte (the replicated family's exact idiom)
+        lplan = (
+            plan_layer_buckets(grads, exchange.stream_bucket_bytes)
+            if exchange.stream_encode
+            else None
+        )
+        with named_phase("encode"):
+            if exchange.stream_encode:
+                payloads, stats = encode_tree_streamed(
+                    codec, k_codec, grads, lplan
+                )
+            else:
+                payloads, stats = encode_tree(codec, k_codec, grads)
+        msg_bytes = stats.payload_bytes
+        if agg == "gather":
+            with named_phase("exchange"):
+                gathered = jax.lax.all_gather(payloads, dp_axis)
+            with named_phase("decode_mean"):
+                mean_grads = decode_mean_tree(codec, gathered, grads, n_dp)
+        else:  # ring
+            # lazy: replicated.py does not import this module, but a
+            # module-level import here would cycle the other way around
+            # through parallel/__init__
+            from atomo_tpu.parallel.replicated import (
+                _ring_stream_mean,
+                _ring_stream_mean_layered,
+            )
+
+            my = jax.lax.axis_index(dp_axis)
+            with named_phase("ring_exchange_decode"):
+                if exchange.stream_encode:
+                    mean_grads, _ = _ring_stream_mean_layered(
+                        codec, payloads, grads, lplan,
+                        axis=dp_axis, n_dev=n_dp, my=my, n_contrib=n_dp,
+                        bucket_size=exchange.ring_bucket_size,
+                    )
+                else:
+                    mean_grads, _ = _ring_stream_mean(
+                        codec, payloads, grads,
+                        axis=dp_axis, n_dev=n_dp, my=my, n_contrib=n_dp,
+                        bucket_size=exchange.ring_bucket_size,
+                    )
+
+    updates, new_opt = optimizer.update(mean_grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    metrics = {
+        "loss": jax.lax.pmean(loss, dp_axis),
+        # float32, not int32 — same overflow rationale as the legacy tail
+        "msg_bytes": jnp.asarray(msg_bytes, jnp.float32),
+        "dense_bytes": jnp.asarray(dense_bytes, jnp.float32),
+    }
+    new_state = TrainState(
+        step=state.step + 1,
+        params=new_params,
+        batch_stats=state.batch_stats,
+        opt_state=new_opt,
+    )
+    return new_state, metrics
+
+
+def dp_exchange_tail(
+    optimizer, codec, state, k_codec, grads, loss, *,
+    dp_axis: str, n_dp: int, aggregate: str, exchange=None,
+):
+    """Dispatch one model-axis step's dp tail: the legacy
+    :func:`compressed_dp_update` when ``exchange`` is None (byte-for-byte
+    the pre-refactor program), :func:`compressed_dp_exchange` when the
+    caller hands a :class:`DpExchange` (``exchange.aggregate`` wins over
+    the legacy ``aggregate`` string — one source of truth per path)."""
+    if exchange is None:
+        return compressed_dp_update(
+            optimizer, codec, state, k_codec, grads, loss,
+            dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
+        )
+    return compressed_dp_exchange(
+        optimizer, codec, state, k_codec, grads, loss,
+        dp_axis=dp_axis, n_dp=n_dp, exchange=exchange,
+    )
+
+
 def make_lm_train_step(
     lm_config: dict,
     optimizer,
@@ -129,6 +298,7 @@ def make_lm_train_step(
     attn_impl: str = "ring",
     compute_dtype=None,
     aggregate: str = "gather",
+    exchange: DpExchange | None = None,
 ):
     """Jitted (state, key, tokens) -> (state, metrics) with tokens (B, S)
     sharded batch-over-dp and sequence-over-sp. ``lm_config`` are
@@ -190,19 +360,22 @@ def make_lm_train_step(
         # inflation verified empirically (tests/test_ring.py oracle parity).
         grads = jax.lax.pmean(grads, sp_axis)
 
-        return compressed_dp_update(
+        return dp_exchange_tail(
             optimizer, codec, state, k_codec, grads, loss,
             dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
+            exchange=exchange,
         )
 
-    sharded = jax.shard_map(
+    # the ONE compile path (parallel.compile): construction byte-identical
+    # to the hand-rolled jax.jit(jax.shard_map(...)) stack this builder
+    # used to assemble inline (tested per program family)
+    return compile_step(
         spmd_step,
-        mesh=mesh,
+        mesh,
         in_specs=(P(), P(), P(dp_axis, sp_axis)),
         out_specs=(P(), P()),
-        check_vma=False,
+        donate_argnums=(0,),
     )
-    return jax.jit(sharded, donate_argnums=(0,))
 
 
 def shard_tokens(mesh: Mesh, tokens, dp_axis: str = "dp", sp_axis: str = "sp"):

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from atomo_tpu.mesh.collectives import all_to_all_tiled
 from atomo_tpu.parallel.common import (
     attention_sublayer,
     dense_init as _dense_init,
@@ -47,7 +48,8 @@ from atomo_tpu.parallel.common import (
     shard_state,
     shard_tokens_with_spec,
 )
-from atomo_tpu.parallel.lm import compressed_dp_update
+from atomo_tpu.parallel.compile import compile_step
+from atomo_tpu.parallel.lm import DpExchange, dp_exchange_tail
 from atomo_tpu.training.trainer import TrainState, cast_params
 
 # ---------------------------------------------------------------------------
@@ -167,17 +169,17 @@ def moe_mlp(
     if ep_axis is not None:
         # dispatch collective: every chip keeps E/n expert rows and receives
         # the matching C-slot blocks from all n chips -> (E/n, n*C, W)
-        inputs = jax.lax.all_to_all(
-            inputs, ep_axis, split_axis=0, concat_axis=1, tiled=True
+        # (mesh.collectives.all_to_all_tiled — the shuffle
+        # utils.comm_model.moe_all_to_all_wire_bytes prices)
+        inputs = all_to_all_tiled(
+            inputs, ep_axis, split_axis=0, concat_axis=1
         )
     h = jax.nn.gelu(jnp.einsum("esw,ewf->esf", inputs, moe_params["up"]["kernel"]))
     y = jnp.einsum("esf,efw->esw", h, moe_params["down"]["kernel"])
     if ep_axis is not None:
         # return collective: slots travel back to the chips that own the
         # tokens -> (E, C, W) in this chip's original slot layout
-        y = jax.lax.all_to_all(
-            y, ep_axis, split_axis=1, concat_axis=0, tiled=True
-        )
+        y = all_to_all_tiled(y, ep_axis, split_axis=1, concat_axis=0)
     out = jnp.einsum("ecw,tec->tw", y, combine.astype(x.dtype))
 
     # switch aux loss: fraction routed x mean router prob, over local tokens
@@ -237,6 +239,7 @@ def make_moe_lm_train_step(
     aux_weight: float = 0.01,
     compute_dtype=None,
     aggregate: str = "gather",
+    exchange: DpExchange | None = None,
 ):
     """Jitted (state, key, tokens) -> (state, metrics): switch-MoE LM with
     experts sharded over ep and ATOMO-compressed gradient exchange over dp.
@@ -284,19 +287,19 @@ def make_moe_lm_train_step(
         # (no divide_by: the loss path crosses no psum — module docstring)
         grads = complete_model_axis_grads(grads, param_specs, ep_axis)
         replica_loss = jax.lax.psum(loss, ep_axis)
-        return compressed_dp_update(
+        return dp_exchange_tail(
             optimizer, codec, state, k_codec, grads, replica_loss,
             dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
+            exchange=exchange,
         )
 
-    sharded = jax.shard_map(
+    return compile_step(
         spmd_step,
-        mesh=mesh,
+        mesh,
         in_specs=(state_specs, P(), P((dp_axis, ep_axis), None)),
         out_specs=(state_specs, P()),
-        check_vma=False,
+        donate_argnums=(0,),
     )
-    return jax.jit(sharded, donate_argnums=(0,))
 
 
 def shard_moe_tokens(

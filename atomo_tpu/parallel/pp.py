@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from atomo_tpu.mesh.collectives import ppermute_pipeline
 from atomo_tpu.parallel.common import (
     attention_sublayer,
     dense_init as _dense_init,
@@ -44,7 +45,8 @@ from atomo_tpu.parallel.common import (
     shard_state,
     shard_tokens_with_spec,
 )
-from atomo_tpu.parallel.lm import compressed_dp_update
+from atomo_tpu.parallel.compile import compile_step
+from atomo_tpu.parallel.lm import DpExchange, dp_exchange_tail
 from atomo_tpu.training.trainer import TrainState, cast_params
 
 # ---------------------------------------------------------------------------
@@ -177,6 +179,7 @@ def make_pp_lm_train_step(
     num_microbatches: int = 2,
     compute_dtype=None,
     aggregate: str = "gather",
+    exchange: DpExchange | None = None,
 ):
     """Jitted (state, key, tokens) -> (state, metrics): GPipe pipeline over
     pp with ATOMO-compressed gradient exchange over dp.
@@ -200,7 +203,6 @@ def make_pp_lm_train_step(
         stage = jax.lax.axis_index(pp_axis)
         is_head = stage == 0
         is_tail = stage == n_pp - 1
-        fwd_perm = [(i, (i + 1) % n_pp) for i in range(n_pp)]
         my_dp = jax.lax.axis_index(dp_axis)
         k_codec = jax.random.fold_in(jax.random.fold_in(key, state.step), my_dp)
 
@@ -219,7 +221,9 @@ def make_pp_lm_train_step(
                 toks_in = jax.lax.dynamic_slice_in_dim(tokens, in_idx, mb, 0)
                 x_in = jnp.where(is_head, _embed(params, toks_in), acts)
                 y = _block_stack(local_blocks, x_in, cfg["num_heads"])
-                return jax.lax.ppermute(y, pp_axis, fwd_perm), y
+                # one pipeline tick (mesh.collectives.pipeline_perm): the
+                # hop utils.comm_model's bubble pricing counts per stage
+                return ppermute_pipeline(y, pp_axis, n_pp), y
 
             acts0 = jnp.zeros((mb, s, cfg["width"]), act_dtype)
             _, ys = jax.lax.scan(
@@ -246,19 +250,19 @@ def make_pp_lm_train_step(
         # loss path, so no divide_by)
         grads = complete_model_axis_grads(grads, param_specs, pp_axis)
         replica_loss = jax.lax.psum(loss, pp_axis)
-        return compressed_dp_update(
+        return dp_exchange_tail(
             optimizer, codec, state, k_codec, grads, replica_loss,
             dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
+            exchange=exchange,
         )
 
-    sharded = jax.shard_map(
+    return compile_step(
         spmd_step,
-        mesh=mesh,
+        mesh,
         in_specs=(state_specs, P(), P(dp_axis, None)),
         out_specs=(state_specs, P()),
-        check_vma=False,
+        donate_argnums=(0,),
     )
-    return jax.jit(sharded, donate_argnums=(0,))
 
 
 def shard_pp_tokens(mesh: Mesh, tokens, dp_axis: str = "dp"):

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from atomo_tpu.mesh.collectives import psum as _axis_psum
 from atomo_tpu.parallel.common import (
     layernorm as _layernorm,
     complete_model_axis_grads,
@@ -51,8 +52,10 @@ from atomo_tpu.parallel.common import (
     shard_state,
     shard_tokens_with_spec,
 )
+from atomo_tpu.parallel.compile import compile_step
 from atomo_tpu.parallel.lm import (
-    compressed_dp_update,
+    DpExchange,
+    dp_exchange_tail,
     sp_boundary_targets_and_mask,
 )
 from atomo_tpu.parallel.ring import ATTENTION_IMPLS, full_attention
@@ -192,7 +195,9 @@ def tp_lm_forward(
     )
 
     def _g(t):  # parallel-region exit: all-reduce the partial sums
-        return t if tp_axis is None else jax.lax.psum(t, tp_axis)
+        # mesh.collectives.psum: the priced model-axis collective — two per
+        # block (utils.comm_model.tp_psum_wire_bytes prices exactly these)
+        return t if tp_axis is None else _axis_psum(t, tp_axis)
 
     x = params["tok_emb"]["embedding"][tokens]
     x = x + params["pos_emb"]["embedding"][pos_offset + jnp.arange(s)][None]
@@ -259,12 +264,15 @@ def make_tp_lm_train_step(
     tp_axis: str = "tp",
     compute_dtype=None,
     aggregate: str = "gather",
+    exchange: DpExchange | None = None,
 ):
     """Jitted (state, key, tokens) -> (state, metrics): Megatron-TP forward/
     backward with ATOMO-compressed gradient exchange over dp.
 
     tokens are (B, S) sharded batch-over-dp, replicated over tp. ``state``
-    and ``state_specs`` come from :func:`create_tp_lm_state`.
+    and ``state_specs`` come from :func:`create_tp_lm_state`. ``exchange``
+    (a :class:`~atomo_tpu.parallel.lm.DpExchange`) upgrades the dp tail to
+    the full compressed stack; None keeps the legacy tail byte-for-byte.
     """
     n_dp = mesh.shape[dp_axis]
     n_tp = mesh.shape[tp_axis]
@@ -297,19 +305,19 @@ def make_tp_lm_train_step(
         # replicated leaves get psum/n = pmean.
         grads = complete_model_axis_grads(grads, param_specs, tp_axis, n_tp)
 
-        return compressed_dp_update(
+        return dp_exchange_tail(
             optimizer, codec, state, k_codec, grads, loss,
             dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
+            exchange=exchange,
         )
 
-    sharded = jax.shard_map(
+    return compile_step(
         spmd_step,
-        mesh=mesh,
+        mesh,
         in_specs=(state_specs, P(), P(dp_axis, None)),
         out_specs=(state_specs, P()),
-        check_vma=False,
+        donate_argnums=(0,),
     )
-    return jax.jit(sharded, donate_argnums=(0,))
 
 
 def shard_tp_tokens(mesh: Mesh, tokens, dp_axis: str = "dp"):
@@ -334,6 +342,7 @@ def make_tp_sp_lm_train_step(
     attn_impl: str = "ring",
     compute_dtype=None,
     aggregate: str = "gather",
+    exchange: DpExchange | None = None,
 ):
     """Jitted (state, key, tokens) -> (state, metrics) over a 3-D mesh:
     batch over dp, heads/hidden/vocab over tp, SEQUENCE over sp — the full
@@ -397,16 +406,16 @@ def make_tp_sp_lm_train_step(
         grads = complete_model_axis_grads(
             grads, param_specs, tp_axis, n_tp * n_sp
         )
-        return compressed_dp_update(
+        return dp_exchange_tail(
             optimizer, codec, state, k_codec, grads, loss,
             dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
+            exchange=exchange,
         )
 
-    sharded = jax.shard_map(
+    return compile_step(
         spmd_step,
-        mesh=mesh,
+        mesh,
         in_specs=(state_specs, P(), P(dp_axis, sp_axis)),
         out_specs=(state_specs, P()),
-        check_vma=False,
+        donate_argnums=(0,),
     )
-    return jax.jit(sharded, donate_argnums=(0,))

@@ -211,6 +211,7 @@ def tune(
     kind: str = "tune_decision",
     codec_for_candidate: Optional[Callable[[dict], object]] = None,
     hybrid_for_candidate: Optional[Callable[[dict], object]] = None,
+    mesh_spec=None,
     log_fn=print,
 ) -> dict:
     """Run the startup autopilot; returns the finished decision document
@@ -425,10 +426,16 @@ def tune(
         # the PROBED mesh's named-axis shape (insertion-ordered dict):
         # decision_reusable compares it on resume — an n_devices-only
         # check cannot tell dp4 from dp2 x ici2, which are different
-        # program families
-        "mesh_axes": MeshSpec.from_world(
-            n_dev, dcn_ways if two_tier else 0
-        ).shape_dict(),
+        # program families. A caller-supplied mesh_spec (the model-axis
+        # layouts: dp2 x tp2 etc.) wins over the data-axes-only
+        # reconstruction, so the record names tp/pp/ep/sp too.
+        "mesh_axes": (
+            mesh_spec.shape_dict()
+            if mesh_spec is not None
+            else MeshSpec.from_world(
+                n_dev, dcn_ways if two_tier else 0
+            ).shape_dict()
+        ),
         # the weight-update partition the run trains with (recorded for
         # the audit trail; candidates are partition-agnostic because
         # partition families are trajectory-compatible per codec)
@@ -498,6 +505,25 @@ def tune(
                     "quorum candidates are priced by expected exposed "
                     "wait, not probed — the straggler-free probe harness "
                     "cannot measure the wait they absorb"
+                ),
+            })
+            continue
+        if cand.get("model_axes"):
+            # priced, never probed (the quorum precedent): the probe
+            # harness builds replicated-family programs, not model-axis
+            # LM steps; these rows are priced from the wire model plus
+            # the layout's pre-priced axis-collective floor
+            # (model_comm_s / pipeline_bubble_s), and their measured
+            # evidence is bench's lm_compressed_dp_wire in-row gates
+            ladder.record({
+                **pub,
+                "probed": False,
+                "probe_note": (
+                    "model-axis lm candidates are priced (dp wire + "
+                    "axis-collective floor), not probed — the probe "
+                    "harness builds replicated-family programs; "
+                    "measured evidence lands in bench "
+                    "lm_compressed_dp_wire"
                 ),
             })
             continue

@@ -328,6 +328,71 @@ def stream_exposed_encode_s(encode_s: float, n_buckets: int) -> float:
     return max(float(encode_s), 0.0) / max(int(n_buckets), 1)
 
 
+def pipeline_bubble_fraction(n_stages: int, microbatches: int) -> float:
+    """Idle fraction of the GPipe schedule: ``(n-1) / (m + n-1)``.
+
+    The pipeline runs ``m + n-1`` ticks to push ``m`` microbatches through
+    ``n`` stages (parallel.pp's ``lax.scan`` length, exactly); each stage
+    computes on ``m`` of them and idles (or computes pipeline garbage —
+    same wall-clock) on the other ``n-1``. The classic GPipe bubble;
+    driving it down is why ``--microbatches`` exists."""
+    n = max(int(n_stages), 1)
+    m = max(int(microbatches), 1)
+    return (n - 1) / (m + n - 1)
+
+
+def pipeline_bubble_s(compute_s: float, n_stages: int, microbatches: int) -> float:
+    """Wall-clock the bubble ADDS to a replica step: ``compute * (n-1)/m``.
+
+    With bubble-free replica compute ``compute_s`` split over ``m``
+    microbatch ticks, the schedule's ``m + n-1`` ticks cost
+    ``compute_s * (m + n-1)/m`` — i.e. the bubble's surcharge is
+    ``compute_s * (n-1)/m``. This is the number ``overlap_report`` prices
+    NEXT TO encode exposure: both are critical-path time no dp-wire
+    compression can touch."""
+    n = max(int(n_stages), 1)
+    m = max(int(microbatches), 1)
+    return max(float(compute_s), 0.0) * (n - 1) / m
+
+
+def tp_psum_wire_bytes(
+    activation_bytes: float, ways: int, n_blocks: int
+) -> float:
+    """Per-chip wire bytes of the Megatron tp collectives for ONE step:
+    every block exits its two parallel regions with a psum of the
+    (B_local, S, W) residual activation — 2 per block forward, and the
+    shard_map transpose runs the SAME 2 again in backward (the transpose
+    of psum is psum) — each a ring all-reduce of ``activation_bytes``
+    over the ``ways`` tp peers:
+    ``4 * n_blocks * ring_allreduce_wire_bytes(act, ways)``. Priced from
+    the measured fabric like every other wire term (ISSUE: the comm
+    model must price the model-axis collectives, not just the dp wire)."""
+    return (
+        4.0
+        * max(int(n_blocks), 0)
+        * ring_allreduce_wire_bytes(float(activation_bytes), ways)
+    )
+
+
+def moe_all_to_all_wire_bytes(
+    dispatch_bytes: float, ways: int, n_layers: int
+) -> float:
+    """Per-chip wire bytes of the MoE expert shuffle for ONE step: each
+    layer runs two tiled ``all_to_all`` collectives (dispatch + return)
+    over the (E, C, W) slot buffer of ``dispatch_bytes``, and AD's
+    transpose runs both again in backward. A tiled all_to_all keeps 1/n
+    of the buffer local and wires the other ``(n-1)/n``:
+    ``4 * n_layers * dispatch_bytes * (ways-1)/ways``."""
+    w = max(int(ways), 1)
+    return (
+        4.0
+        * max(int(n_layers), 0)
+        * max(float(dispatch_bytes), 0.0)
+        * (w - 1)
+        / w
+    )
+
+
 def overlap_hidden_comm_s(comm_s: float, compute_s: float) -> float:
     """Seconds of the exchange+decode chain that ``--overlap delayed``
     hides underneath fwd/bwd+update: overlap hides min(comm, compute) —
@@ -356,6 +421,8 @@ def overlap_report(
     encode_s: float = 0.0,
     stream_encode: bool = False,
     stream_buckets: int = 1,
+    pipeline_stages: int = 1,
+    pipeline_microbatches: int = 1,
 ) -> dict:
     """Model what ``--overlap delayed`` buys at N ``ways`` over a fabric.
 
@@ -376,6 +443,12 @@ def overlap_report(
     :func:`stream_exposed_encode_s` (``encode_s / stream_buckets``) and
     the report states the pipeline accounting explicitly: the hidden
     share is a cost backprop absorbs, not a cost that vanished.
+
+    ``pipeline_stages > 1`` adds the GPipe bubble
+    (:func:`pipeline_bubble_s` on ``compute_s``) to BOTH step numbers —
+    like exposed encode it is critical-path time the dp-wire saving
+    cannot touch, so the ``dp x pp`` layouts report it side by side with
+    encode exposure instead of hiding it inside "compute".
     """
     if aggregate == "ring":
         wire = ring_stream_wire_bytes(payload_bytes, dense_bytes, ways)
@@ -388,6 +461,9 @@ def overlap_report(
     enc_exposed = (
         stream_exposed_encode_s(enc, stream_buckets) if stream_encode
         else enc
+    )
+    bubble = pipeline_bubble_s(
+        compute_s, pipeline_stages, pipeline_microbatches
     )
     return {
         "aggregate": aggregate,
@@ -402,11 +478,16 @@ def overlap_report(
         "encode_hidden_ms": round((enc - enc_exposed) * 1e3, 3),
         "stream_encode": bool(stream_encode),
         "stream_buckets": int(stream_buckets) if stream_encode else 1,
+        "pipeline_bubble_ms": round(bubble * 1e3, 3),
+        "pipeline_bubble_fraction": round(
+            pipeline_bubble_fraction(pipeline_stages, pipeline_microbatches),
+            4,
+        ),
         "blocking_step_ms": round(
-            (compute_s + comm_s + enc_exposed) * 1e3, 3
+            (compute_s + comm_s + enc_exposed + bubble) * 1e3, 3
         ),
         "delayed_step_ms": round(
-            (compute_s + exposed + enc_exposed) * 1e3, 3
+            (compute_s + exposed + enc_exposed + bubble) * 1e3, 3
         ),
         "assumptions": (
             "delayed overlaps exchange+decode with fwd/bwd+update; hides "
@@ -414,7 +495,9 @@ def overlap_report(
             "step's gradient — fully exposed without --stream-encode, and "
             "with it the layer-bucket pipeline hides all but the tail "
             "(exposed encode = max(0, encode_tail) = encode/n_buckets, "
-            "uniform-bucket model) — see atomo_tpu/utils/comm_model.py"
+            "uniform-bucket model); pipeline_stages>1 adds the GPipe "
+            "bubble compute*(n_stages-1)/microbatches to both step "
+            "numbers — see atomo_tpu/utils/comm_model.py"
         ),
     }
 
@@ -516,8 +599,20 @@ def candidate_name(cand: dict) -> str:
     """Stable display/sort key for a knob vector (also the tie-break of
     last resort in the autopilot's winner selection — deterministic).
     Hierarchical candidates carry their topology.schedule plan inline:
-    ``hier[psum+ring]+off+k1``."""
+    ``hier[psum+ring]+off+k1``; model-axis LM candidates lead with their
+    layout (and codec, when the vector pins one):
+    ``lm[tp2]+qsgd8+gather+off+se+k1``."""
     bits = []
+    ma = cand.get("model_axes")
+    if ma:
+        shape = "".join(
+            f"{a}{int(s)}"
+            for a, s in dict(ma).items()
+            if a not in ("dp", "ici") and int(s) > 1
+        )
+        bits.append(f"lm[{shape}]")
+        if cand.get("codec"):
+            bits.append(str(cand["codec"]))
     if cand.get("aggregate") == "hierarchical":
         bits.append(f"hier[{cand.get('plan', 'legacy')}]")
         bits.append(cand.get("overlap", "off"))
@@ -795,7 +890,19 @@ def predict_step_s(
     waits only the Q-th order statistic
     (:func:`quorum_exposed_wait_s`) — the entire wall-clock case for
     quorum aggregation, visible in the ranking exactly when a delay
-    vector exists."""
+    vector exists.
+
+    Model-axis LM candidates (``model_axes`` set) carry their axis
+    collectives PRE-PRICED as two floats the emitter computed from the
+    measured fabric — ``model_comm_s`` (tp psum / MoE all-to-all wire
+    over the INNER tier, :func:`tp_psum_wire_bytes` /
+    :func:`moe_all_to_all_wire_bytes`) and ``pipeline_bubble_s``
+    (:func:`pipeline_bubble_s`) — added to every non-hierarchical step
+    prediction: the dp-wire knobs compete on top of a floor the model
+    axes set, not instead of it."""
+    model_extra_s = float(cand.get("model_comm_s") or 0.0) + float(
+        cand.get("pipeline_bubble_s") or 0.0
+    )
     lb = cand.get("leaf_budgets")
     if lb is None and cand.get("sparse_rows") == "on":
         lb = sparse_leaf_budgets
@@ -840,12 +947,12 @@ def predict_step_s(
         rt = tax_s if tax_s is not None else (
             estimate_codec_tax_s(dense_bytes) if payload_bytes else 0.0
         )
-        return compute_s + rt + dispatch_s / k
+        return compute_s + rt + model_extra_s + dispatch_s / k
     agg = cand.get("aggregate", "psum")
     has_codec = bool(payload_bytes) and payload_bytes > 0
     if not has_codec:
         wire = ring_allreduce_wire_bytes(dense_bytes, ways)
-        return compute_s + wire / fabric_bw + dispatch_s / k
+        return compute_s + wire / fabric_bw + model_extra_s + dispatch_s / k
     if tax_s is None:
         tax_s = estimate_codec_tax_s(dense_bytes)
     encode_s = decode_s = tax_s / 2.0
@@ -880,7 +987,10 @@ def predict_step_s(
             )
         else:
             straggler_s = max(float(x) for x in quorum_delays)
-    return compute_s + encode_s + chain + straggler_s + dispatch_s / k
+    return (
+        compute_s + encode_s + chain + straggler_s + model_extra_s
+        + dispatch_s / k
+    )
 
 
 def rank_candidates(

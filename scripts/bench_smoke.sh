@@ -114,6 +114,17 @@
 #      same knobs as pinned literals, and the kill->controller_reusable
 #      ->rebuild resume drill bit-exact — the PR-17 global controller.
 #
+#  17. the model-axis wire contract (<60 s, forced 4-device CPU mesh):
+#      bench config 19 runs the compressed dp gradient exchange on the
+#      dp2 x tp2 TransformerLM layout (the one-mesh-path compile) and
+#      must exit 0 with the byte-match gate TRUE (executed per-shard
+#      msg_bytes == the per-leaf payload sum priced over the tp-LOCAL
+#      shard shapes, to the byte), the scoped DpExchange tail stepping
+#      bit-identical to the legacy compressed_dp_update tail (the
+#      degenerate-point contract), compressed wire strictly below
+#      dense, and the seed-ensemble loss no worse than dense within
+#      tolerance — the PR-18 model-axes compile path.
+#
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
 cd "$(dirname "$0")/.." || exit 2
@@ -149,7 +160,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/16]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/17]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -178,7 +189,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/16]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/17]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -215,7 +226,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/16]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/17]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -246,7 +257,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/16]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/17]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -273,7 +284,7 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/16]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/17]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
 EOF
 [ $? -ne 0 ] && exit 1
@@ -306,7 +317,7 @@ for r in probed:
     assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
     assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
 assert doc.get("why"), doc
-print(f"bench_smoke OK[6/16]: --auto tune picked {win['name']} "
+print(f"bench_smoke OK[6/17]: --auto tune picked {win['name']} "
       f"({win.get('measured_ms_per_step')} ms/step measured, "
       f"{len(probed)}/{len(doc['rows'])} candidates probed); "
       "decision artifact parses")
@@ -350,7 +361,7 @@ for p in plans:
     assert isinstance(p.get("predicted_ms_per_step"), (int, float)), p
 td = row.get("tune_decision") or {}
 assert td.get("hierarchical_probed"), row
-print(f"bench_smoke OK[7/16]: two-tier plans "
+print(f"bench_smoke OK[7/17]: two-tier plans "
       f"{[p['plan'] for p in plans]} measured with per-tier "
       "predicted-vs-measured bytes matching, per-plan bit_parity=True; "
       f"mini-tune probed {td['hierarchical_probed']} "
@@ -398,7 +409,7 @@ sys.path.insert(0, ".")
 from atomo_tpu.training.checkpoint import latest_valid_step
 
 assert latest_valid_step(d) == 8, latest_valid_step(d)
-print("bench_smoke OK[8/16]: die@3:1 shrank 4 -> 3 at a checkpoint "
+print("bench_smoke OK[8/17]: die@3:1 shrank 4 -> 3 at a checkpoint "
       "boundary (planned reshape, restart budget untouched), finished at "
       f"step {latest_valid_step(d)} with membership epochs "
       f"{[w[0] for w in worlds]} recorded")
@@ -434,7 +445,7 @@ for k in ("compute_ms", "encode_monolithic_ms", "encode_streamed_ms",
           "encode_hidden_stream_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 assert int(ph.get("n_buckets", 0)) > 1, row
-print(f"bench_smoke OK[9/16]: stream {row['value']} vs off "
+print(f"bench_smoke OK[9/17]: stream {row['value']} vs off "
       f"{row['off_ms_per_step']} ms/step; exposed encode "
       f"{ph['encode_exposed_stream_ms']} (stream, {ph['n_buckets']} "
       f"buckets) vs {ph['encode_exposed_off_ms']} (off) ms; "
@@ -483,7 +494,7 @@ assert doc["consistent"] is True, doc["checks"]
 ran = [c["name"] for c in doc["checks"] if not c["skipped"]]
 segs = [e for e in doc["timeline"] if e["kind"] == "metrics"]
 assert segs and segs[0]["first_step"] == 1 and segs[-1]["last_step"] == 6
-print("bench_smoke OK[10/16]: recorder+quality run left "
+print("bench_smoke OK[10/17]: recorder+quality run left "
       f"{len(steps)} step records ({len(steps[0]['q_rel'])}-layer "
       "quality columns), report verb joined a consistent timeline "
       f"(checks ran: {ran})")
@@ -523,7 +534,7 @@ for l in layers:
     assert 0.0 <= l["density"] <= 1.0, l
     if l["assignment"] == "sparse":
         assert l["payload_bytes"] < l["dense_bytes"], l
-print(f"bench_smoke OK[11/16]: hybrid {row['hybrid_wire_bytes']} B vs "
+print(f"bench_smoke OK[11/17]: hybrid {row['hybrid_wire_bytes']} B vs "
       f"all-dense {row['alldense_wire_bytes']} B on the wire "
       f"({row['wire_reduction']}x reduction, "
       f"{len(plan['sparse_leaves'])}/{plan['n_leaves']} leaves sparse); "
@@ -567,7 +578,7 @@ assert set(ratios) == {"ici", "dcn"} and all(
 # even on a contended host
 assert row["fabric_parity"] is True, row
 assert row["run_artifact_complete"] is True, row
-print(f"bench_smoke OK[12/16]: probed ici {tiers['ici']['bandwidth_gbps']} "
+print(f"bench_smoke OK[12/17]: probed ici {tiers['ici']['bandwidth_gbps']} "
       f"/ dcn {tiers['dcn']['bandwidth_gbps']} GB/s/chip "
       f"({tiers['ici']['latency_us']} / {tiers['dcn']['latency_us']} "
       "us/hop); measured-vs-preset ratios recorded; measured-priced vs "
@@ -608,7 +619,7 @@ assert shd < z1 < rep, (rep, z1, shd)
 assert row["state_bytes_reduction"] > 1.5, row
 for part in ("replicated", "zero1", "sharded_update"):
     assert row[f"{part}_ms_per_step"] > 0, row
-print(f"bench_smoke OK[13/16]: per-chip state {rep} -> {z1} (zero1) -> "
+print(f"bench_smoke OK[13/17]: per-chip state {rep} -> {z1} (zero1) -> "
       f"{shd} B (sharded-update, {row['state_bytes_reduction']}x); "
       f"ms/step {row['replicated_ms_per_step']} / "
       f"{row['zero1_ms_per_step']} / {row['sharded_update_ms_per_step']}; "
@@ -648,7 +659,7 @@ assert row["measured_variance_reduction"] > 0, row
 assert row["pareto_loss_ok"] is True, row
 # gate 4: bit-exact resume from the recorded allocation artifact
 assert row["resume_bit_exact"] is True, row
-print(f"bench_smoke OK[14/16]: variance alloc {alloc['variance_ks']} vs "
+print(f"bench_smoke OK[14/17]: variance alloc {alloc['variance_ks']} vs "
       f"uniform {alloc['uniform_ks']} at "
       f"{row['variance_row']['wire_bytes']} <= "
       f"{row['uniform_row']['wire_bytes']} B wire; measured q_err2 "
@@ -692,7 +703,7 @@ assert row["schedule_steps_recorded"] > 0, row
 # gates quorum < blocking)
 assert row["straggler_absorption_speedup"] > 1, row
 assert row["stale_dropped"] == 0, row
-print(f"bench_smoke OK[15/16]: quorum {row['value']} vs blocking "
+print(f"bench_smoke OK[15/17]: quorum {row['value']} vs blocking "
       f"{row['blocking_ms_per_step']} ms/step under one slow@ replica "
       f"({row['straggler_absorption_speedup']}x absorbed) at equal wire "
       f"({row['msg_bytes']} B); {row['schedule_steps_recorded']}-step "
@@ -737,7 +748,7 @@ assert row["pin_bit_parity"] is True, row
 assert row["pin_equal_wire"] is True, row
 assert row["resume_reusable"] is True, row
 assert row["resume_bit_parity"] is True, row
-print(f"bench_smoke OK[16/16]: controller picked "
+print(f"bench_smoke OK[16/17]: controller picked "
       f"{row['joint_winner']['name']} "
       f"({row['value']} ms/step vs best standalone "
       f"{row['best_single_ms_per_step']}); artifact-pin bit-exact at "
@@ -745,4 +756,44 @@ print(f"bench_smoke OK[16/16]: controller picked "
 EOF16
 [ $? -ne 0 ] && exit 1
 
-echo "bench_smoke: all 16 checks passed"
+# --- 17: config 19, model-axis compressed-dp-wire contract ---------------
+out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+      ATOMO_COMPILE_CACHE="$art/xla" \
+      ATOMO_BENCH_ARTIFACT="$art/c19.json" \
+      python bench.py --config 19 --no-baseline 2>/dev/null)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: config 19 exited rc=$rc (timeout or crash)"
+  exit 1
+fi
+printf '%s\n' "$out" > "$art/c19.out"
+python - "$art/c19.out" <<'EOF17'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+assert lines, "bench_smoke FAIL: config 19 emitted no JSON"
+row = json.loads(lines[-1])
+assert row["metric"] == "lm_compressed_dp_wire", row
+assert row["measurement_valid"], row.get("invalid_reason")
+# byte honesty: executed per-shard msg_bytes == the per-leaf payload
+# sum priced over the tp-LOCAL shard shapes, to the byte
+assert row["byte_match"] is True, row
+assert row["predicted_msg_bytes"] == row["msg_bytes"], row
+# the degenerate-point contract: the scoped full-stack DpExchange tail
+# steps bit-identical to the legacy compressed_dp_update tail
+assert row["degeneracy_bit_parity"] is True, row
+# the headline: compressed dp wire strictly below dense on the tp layout
+assert row["byte_reduction"] > 1, row
+# and the seed ensemble says the wire saving is not bought with loss
+assert row["loss_no_worse"] is True, row
+print(f"bench_smoke OK[17/17]: dp2xtp2 LM compressed dp wire "
+      f"{row['msg_bytes']} B vs dense {row['dense_bytes']} B "
+      f"({row['byte_reduction']}x), predicted == executed to the byte; "
+      f"scoped-vs-legacy bit-exact; ensemble loss "
+      f"{row['ensemble']['qsgd_mean_loss']} vs dense "
+      f"{row['ensemble']['dense_mean_loss']}")
+EOF17
+[ $? -ne 0 ] && exit 1
+
+echo "bench_smoke: all 17 checks passed"

@@ -306,6 +306,97 @@ def adaptive_recs(ways: int) -> dict:
     return {"lenet (adaptive budget)": out}
 
 
+def lm_recs(ways: int, tp: int = 2) -> dict:
+    """``--lm``: the model-axis LM scenario column — the dp x tp
+    TransformerLM (bench config 19's shape) with the controller's
+    ``lm[tp2]+...`` candidates, priced exactly the way
+    ``controller.solve`` prices them: the dp exchange over the tp-LOCAL
+    gradient shard (each tp shard exchanges its own slice — the same
+    per-leaf accounting bench config 19's byte-match gate pins to the
+    executed program) plus the layout's pre-priced axis-collective
+    floor (``comm_model.tp_psum_wire_bytes`` over the fabric). Opt-in
+    so the published historical table is stable; model-only ordering —
+    bench config 19 carries the measured evidence."""
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import QsgdCodec
+    from atomo_tpu.controller.space import lm_axis_candidates
+    from atomo_tpu.models.transformer import TransformerLM
+    from atomo_tpu.parallel.tp import lm_params_to_tp, tp_param_specs
+    from atomo_tpu.utils.comm_model import (
+        FABRICS,
+        codec_leaf_payload_bytes,
+        estimate_codec_tax_s,
+        estimate_compute_s,
+        rank_candidates,
+        tp_psum_wire_bytes,
+    )
+
+    cfg = dict(vocab_size=64, max_len=16, width=32, depth=2, num_heads=4)
+    batch, seq = 8, cfg["max_len"]
+    model = TransformerLM(**cfg)
+    lm_shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, seq), jnp.int32)
+        )["params"]
+    )
+    # the tp re-layout + its shard slicing, abstractly (eval_shape):
+    # local leaf shapes are what the dp exchange actually encodes
+    tp_shapes = jax.eval_shape(
+        lambda p: lm_params_to_tp(p, cfg["num_heads"]), lm_shapes
+    )
+    specs = tp_param_specs(tp_shapes, "tp")
+
+    def local(shape, spec):
+        return tuple(
+            d // tp if i < len(spec) and spec[i] == "tp" else d
+            for i, d in enumerate(shape)
+        )
+
+    leaves = [
+        local(l.shape, s)
+        for l, s in zip(
+            jax.tree_util.tree_leaves(tp_shapes),
+            jax.tree_util.tree_leaves(
+                specs, is_leaf=lambda x: not isinstance(x, (dict, list))
+            ),
+        )
+    ]
+    codec = QsgdCodec(bits=8, bucket_size=512)
+    dense_b = float(sum(4 * int(jnp.prod(jnp.array(s))) for s in leaves))
+    payload_b = float(
+        sum(codec_leaf_payload_bytes(codec, s) for s in leaves)
+    )
+    compute_ms = estimate_compute_s(dense_b) * 1e3
+    tax_ms = estimate_codec_tax_s(dense_b) * 1e3
+    act_bytes = 4.0 * batch * seq * cfg["width"]
+    n_dp = max(ways // tp, 1)
+    out = {}
+    for label, bw in sorted(FABRICS.items()):
+        cands = lm_axis_candidates(
+            model_axes={"tp": tp}, codec_tag="qsgd8",
+            model_comm_s=tp_psum_wire_bytes(act_bytes, tp, cfg["depth"])
+            / bw,
+        )
+        ranked = [
+            {
+                "code": "qsgd8",
+                "candidate": c["name"],
+                "predicted_ms_per_step": c["predicted_ms_per_step"],
+                "measured_1chip_ms": None,
+                "codec_tax_ms": round(tax_ms, 3),
+            }
+            for c in rank_candidates(
+                cands, dense_bytes=dense_b, payload_bytes=payload_b,
+                ways=n_dp, fabric_bw=bw, compute_s=compute_ms / 1e3,
+                tax_s=tax_ms / 1e3,
+            )
+        ]
+        out[label] = {"winner": ranked[0], "ranked": ranked}
+    return {f"lm dp{n_dp}xtp{tp}": out}
+
+
 def render(recs: dict, ways: int, source: str) -> str:
     lines = [
         f"| scenario | fabric | recommended config | predicted ms/step "
@@ -367,6 +458,14 @@ def main() -> int:
                          "bytes. Off by default so the published table's "
                          "historical rows are stable; bench config 13 "
                          "carries the measured sparse evidence")
+    ap.add_argument("--lm", action="store_true", default=False,
+                    help="add the model-axis LM scenario (dp x tp2 "
+                         "TransformerLM) with the controller's lm[tp2] "
+                         "candidates, priced over the tp-LOCAL gradient "
+                         "shard + the tp psum floor. Off by default so "
+                         "the published table's historical rows are "
+                         "stable; bench config 19 carries the measured "
+                         "evidence")
     ap.add_argument("--from-bench", type=str, default="",
                     help="read recommendations from a bench "
                          "scenario_matrix row / artifact instead of the "
@@ -407,6 +506,8 @@ def main() -> int:
         recs.update(sparse_recs(args.ways))
     if args.adaptive:
         recs.update(adaptive_recs(args.ways))
+    if args.lm:
+        recs.update(lm_recs(args.ways))
     source = (
         f"measured fabric, {args.from_probe} (compute/tax anchors stay "
         "the stated model-only estimates)"
