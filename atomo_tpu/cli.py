@@ -3235,6 +3235,27 @@ def cmd_lm(args: argparse.Namespace) -> int:
             "--stream-encode interleaves CODEC encode with the exchange; "
             "a dense code has nothing to encode — ignoring it"
         )
+    if args.overlap == "delayed":
+        # the model-axis delayed preflight — same contract the replicated
+        # train path enforces, phrased for the lm surface
+        if codec is None:
+            raise SystemExit(
+                "--overlap delayed carries the ENCODED payload between "
+                "steps; a dense --code has no payload to carry — pick a "
+                "compressing --code, or drop --overlap"
+            )
+        if dp <= 1:
+            raise SystemExit(
+                f"--overlap delayed needs a multi-replica dp axis; "
+                f"--layout {layout} at {n_dev} devices resolves to dp=1 — "
+                "no dp exchange to take off the critical path"
+            )
+        if aggregate == "psum":
+            raise SystemExit(
+                "--overlap delayed does not compose with --aggregate "
+                "psum: the dense all-reduce has no encoded payload to "
+                "carry between steps — use gather or ring"
+            )
     if aggregate == "auto":
         # The lm dp exchange now prices the FULL axis-layout space the
         # replicated path ships — gather vs psum vs ring over the dp axis
@@ -3255,7 +3276,14 @@ def cmd_lm(args: argparse.Namespace) -> int:
         aggregate = _resolve_auto_aggregate(
             args, codec, _init_params, dp, allow_hierarchical=False,
         )
-    # ring / stream-encode run through the DpExchange tail (the
+        if args.overlap == "delayed" and aggregate not in ("gather", "ring"):
+            raise SystemExit(
+                "--overlap delayed: --aggregate auto resolved to "
+                f"{aggregate!r} for this byte budget; pass --aggregate "
+                "gather or ring explicitly to keep the overlapped "
+                "schedule, or drop --overlap"
+            )
+    # ring / stream-encode / delayed run through the DpExchange tail (the
     # compressed-stack route); the plain gather/psum knobs keep
     # exchange=None — the legacy tail, byte-for-byte (the degeneracy
     # contract tests/test_model_axes.py pins)
@@ -3265,7 +3293,11 @@ def cmd_lm(args: argparse.Namespace) -> int:
             "--stream-encode interleaves encode with the FACTOR exchange "
             "(gather/ring); psum moves the dense decoded tree — ignoring it"
         )
-    elif aggregate == "ring" or (args.stream_encode and codec is not None):
+    elif (
+        aggregate == "ring"
+        or (args.stream_encode and codec is not None)
+        or args.overlap == "delayed"
+    ):
         from atomo_tpu.parallel.lm import DpExchange
 
         exchange = DpExchange(
@@ -3273,6 +3305,7 @@ def cmd_lm(args: argparse.Namespace) -> int:
             ring_bucket_size=args.ring_bucket_size,
             stream_encode=bool(args.stream_encode and codec is not None),
             stream_bucket_bytes=args.stream_bucket_bytes,
+            overlap=args.overlap,
         )
 
     # layout-inapplicable flags: warn, don't silently ignore (the train
@@ -3466,8 +3499,35 @@ def cmd_lm(args: argparse.Namespace) -> int:
         from atomo_tpu.parallel.mesh import replicated as _replicated
 
         if latest_step(args.train_dir) is not None:
+            from atomo_tpu.parallel.replicated import DelayedState as _DS
+
             template = jax.device_get(state)
-            if specs is None:
+            if isinstance(state, _DS):
+                # --overlap delayed: the carry (the in-flight encoded
+                # payload + its valid flag) is PART of the checkpointed
+                # state, so a kill->restart->resume continues the exact
+                # stale-by-one schedule — load the full DelayedState host
+                # tree, then place each half: train per the layout's
+                # specs, carry on its all-axes row sharding
+                from jax.sharding import NamedSharding
+
+                from atomo_tpu.parallel.lm import place_model_axis_carry
+
+                host = load_checkpoint(args.train_dir, template)
+                if specs is None:
+                    train = jax.device_put(host.train, _replicated(mesh))
+                else:
+                    train = jax.tree_util.tree_map(
+                        lambda leaf, sp: jax.device_put(
+                            leaf, NamedSharding(mesh, sp)
+                        ),
+                        host.train, specs,
+                    )
+                state = _DS(
+                    train=train,
+                    carry=place_model_axis_carry(mesh, host.carry),
+                )
+            elif specs is None:
                 state = jax.device_put(
                     load_checkpoint(args.train_dir, template),
                     _replicated(mesh),
@@ -3498,6 +3558,7 @@ def cmd_lm(args: argparse.Namespace) -> int:
                 None if exchange is None else {
                     "aggregate": exchange.aggregate,
                     "stream_encode": exchange.stream_encode,
+                    "overlap": exchange.overlap,
                 }
             ),
         })
@@ -3749,6 +3810,18 @@ def build_parser() -> argparse.ArgumentParser:
                       metavar="B",
                       help="layer-bucket coalescing bound for "
                            "--stream-encode")
+    p_lm.add_argument("--overlap", type=str, default="off",
+                      choices=["off", "delayed"],
+                      help="delayed = stale-by-one overlapped dp exchange "
+                           "on the model-axis layouts: each step applies "
+                           "the PREVIOUS step's encoded payload, so the "
+                           "gather/ring exchange+decode runs underneath "
+                           "this step's fwd/bwd (and, on dp-pp, the "
+                           "pipeline's drain-tick bubble — "
+                           "comm_model.overlap_report's bubble_hidden_ms "
+                           "term). Needs a compressing --code and "
+                           "--aggregate gather/ring; step 0 skips (carry "
+                           "starts empty)")
     p_lm.add_argument("--fabric", type=str, default="auto", metavar="F",
                       help="fabric for --aggregate auto's advisory line: "
                            "auto | ici | dcn | eth10g | a per-chip GB/s "

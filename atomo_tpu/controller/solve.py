@@ -237,6 +237,7 @@ def solve_controller(
             ring_bucket_size=ring_bucket_size,
             allow_stream=allow_stream,
             stream_bucket_bytes=stream_bucket_bytes,
+            allow_overlap=allow_overlap,
             have_budget=have_budget,
             model_comm_s=lm_model_comm_s,
             pipeline_bubble_s=lm_pipeline_bubble_s,

@@ -202,14 +202,11 @@ MODEL_AXIS_REJECTS = {
         "reject until a parity test lands"
     ),
     "quorum": (
-        "quorum aggregation rides the replicated train loop's delayed "
-        "rig (ok-flags, staleness carry); the model-axis steps apply "
-        "the update inline — no rig to bound staleness with"
-    ),
-    "overlap_delayed": (
-        "delayed overlap needs the consume-next-step carry of the "
-        "replicated loop; the model-axis steps apply the update inline "
-        "— not implemented, honest reject"
+        "the model-axis steps now carry the delayed rig (ok-flags, "
+        "staleness carry — parallel.lm.make_delayed_model_axis_step), "
+        "but the arrival-schedule rig (per-replica delay injection + "
+        "quorum wait) is not threaded through build_model_axis_program; "
+        "honest reject until it is"
     ),
 }
 
@@ -230,7 +227,16 @@ def model_axis_conflicts(cand: dict) -> Optional[str]:
     if cand.get("quorum"):
         return MODEL_AXIS_REJECTS["quorum"]
     if cand.get("overlap", "off") == "delayed":
-        return MODEL_AXIS_REJECTS["overlap_delayed"]
+        # delayed itself is PROVEN (stale-by-one carry threaded through
+        # every model-axis family, tests/test_model_axes.py) — but it
+        # carries an ENCODED payload, so the dense psum exchange has
+        # nothing to carry, and without a codec there is no payload
+        if cand.get("aggregate") == "psum" or not cand.get("codec"):
+            return (
+                "delayed overlap carries an ENCODED payload between "
+                "steps; a dense exchange (psum / no codec) has no "
+                "payload to carry — use a codec with gather or ring"
+            )
     return None
 
 
@@ -242,6 +248,7 @@ def lm_axis_candidates(
     ring_bucket_size: int = 65536,
     allow_stream: bool = True,
     stream_bucket_bytes: int = 4 << 20,
+    allow_overlap: bool = True,
     have_budget: bool = False,
     model_comm_s: float = 0.0,
     pipeline_bubble_s: float = 0.0,
@@ -257,7 +264,10 @@ def lm_axis_candidates(
     Only PROVEN compositions are emitted (:func:`model_axis_conflicts`
     returns None for each, asserted); like quorum rows, these are priced,
     never probed — the probe harness builds replicated-family programs.
-    Pure and deterministic."""
+    ``allow_overlap`` adds ``+delayed`` variants (plain and ``+se``) for
+    the payload-carrying aggregations when a codec is armed —
+    ``predict_step_s`` prices them with the compute AND pipeline-bubble
+    hiding budget. Pure and deterministic."""
     from atomo_tpu.utils.comm_model import candidate_name
 
     axes = {
@@ -286,14 +296,24 @@ def lm_axis_candidates(
         if agg == "ring":
             base["ring_bucket_size"] = int(ring_bucket_size)
         out.append(dict(base))
+        variants = [dict(base)]
         if allow_stream and agg in ("gather", "ring"):
-            out.append({
+            se = {
                 **base,
                 "stream_encode": "on",
                 "stream_bucket_bytes": int(stream_bucket_bytes),
-            })
+            }
+            out.append(dict(se))
+            variants.append(se)
         if have_budget:
             out.append({**base, "budget_alloc": "variance"})
+        if allow_overlap and codec_tag and agg in ("gather", "ring"):
+            # the stale-by-one carry composes with stream-encode (it
+            # restructures the PRODUCE side only); psum / codec-less
+            # rows have no payload to carry — model_axis_conflicts
+            # rejects them, so they are never emitted here
+            for v in variants:
+                out.append({**v, "overlap": "delayed"})
     for c in out:
         reason = model_axis_conflicts(c)
         assert reason is None, f"emitted a rejected composition: {reason}"

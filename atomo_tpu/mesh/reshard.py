@@ -125,6 +125,7 @@ def reshard_model_axes(
     lm_config: dict,
     *,
     devices=None,
+    codec=None,
 ):
     """Redistribute a LIVE LM train state between model-axis layouts —
     e.g. a replicated ``dp`` run onto a ``dp-tp`` mesh (or back) without
@@ -138,10 +139,42 @@ def reshard_model_axes(
     layout had been built fresh from these host values (tested:
     reshard == fresh-build + continue, tests/test_model_axes.py).
 
+    A delayed-overlap state (``parallel.replicated.DelayedState``) is
+    accepted when ``codec`` is given: the TRAIN half rides the bijection
+    above, but the carry's encoded payload shards are the OLD layout's
+    local gradient slices — no bijection exists — so the carry RESETS to
+    the fresh ``valid=0`` value on the new layout. That is exactly a
+    fresh build's start (the determinism contract holds: reshard ==
+    fresh-build from these host values), at the stated cost of the one
+    in-flight update: the step after the reshard skips, like step 0.
+
     Returns ``(mesh, state, state_specs)`` with ``state_specs`` None for
     the replicated target layouts — the same triple
     ``build_model_axis_program`` hands a driver.
     """
+    # lazy: mesh.* must not import parallel.* at module level (cycle)
+    from atomo_tpu.parallel.replicated import DelayedState
+
+    carry_in = None
+    if isinstance(state, DelayedState):
+        if codec is None:
+            raise ValueError(
+                "resharding a DelayedState needs the run's codec: the "
+                "fresh carry's zero-payload shapes come from the codec's "
+                "encode over the NEW layout's local shard shapes"
+            )
+        carry_in = state.carry
+        state = state.train
+
+    def _with_carry(mesh, new_state, new_specs):
+        if carry_in is None:
+            return mesh, new_state, new_specs
+        from atomo_tpu.parallel.lm import init_model_axis_delayed_state
+
+        return mesh, init_model_axis_delayed_state(
+            mesh, new_state, codec
+        ), new_specs
+
     old_layout = old_spec.layout_name()
     new_layout = new_spec.layout_name()
     fam_old = _LAYOUT_PARAM_FAMILY.get(old_layout)
@@ -192,7 +225,7 @@ def reshard_model_axes(
     if fam_new == "lm":
         from atomo_tpu.parallel.replicated import replicate_state
 
-        return mesh, replicate_state(mesh, host), None
+        return _with_carry(mesh, replicate_state(mesh, host), None)
     n_tp = dict(new_spec.axes)["tp"]
     if lm_config["num_heads"] % n_tp or lm_config["vocab_size"] % n_tp:
         raise ValueError(
@@ -206,4 +239,4 @@ def reshard_model_axes(
     )
 
     specs = make_tp_state_specs(host, tp_param_specs(params, "tp"))
-    return mesh, shard_tp_state(mesh, host, specs), specs
+    return _with_carry(mesh, shard_tp_state(mesh, host, specs), specs)

@@ -771,6 +771,20 @@ def _check_model_axes_layout(ctl, metas) -> dict:
             f"controller decision mesh {dict(ctl_axes)} contradicts the "
             f"executed mesh {dict(run_axes)}"
         )
+    # overlap is a program-family knob like the layout itself: a decision
+    # priced for the delayed (stale-by-one) schedule wearing a blocking
+    # run's metrics — or vice versa — is the same contradiction
+    knobs = (((ctl or {}).get("winner") or {}).get("knobs")) or {}
+    ctl_overlap = knobs.get("overlap")
+    run_exchange = run_meta.get("exchange")
+    if ctl_overlap is not None and isinstance(run_exchange, dict):
+        run_overlap = run_exchange.get("overlap", "off")
+        if run_overlap != ctl_overlap:
+            bad.append(
+                f"controller decision priced overlap={ctl_overlap!r} but "
+                f"metrics.jsonl records the run executing "
+                f"overlap={run_overlap!r}"
+            )
     return _check(
         name,
         not bad,

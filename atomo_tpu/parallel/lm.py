@@ -136,18 +136,38 @@ class DpExchange:
     replicated family's knob names (``utils.comm_model.candidate_name``
     algebra), so a controller candidate maps onto this dataclass
     field-for-field.
+
+    ``overlap="delayed"`` threads the replicated loop's consume-next-step
+    carry through the step (:func:`delayed_dp_exchange`): the dp exchange
+    consumes the PREVIOUS step's encoded payload while this step's
+    backward (and, on dp-pp, the pipeline's drain ticks) runs, so the
+    exposed exchange time drops to ``max(0, exchange - compute_tail)``.
+    ``overlap="off"`` (the default) is byte-identical HLO to a DpExchange
+    that predates the field (tested).
     """
 
     aggregate: str = "gather"  # gather | psum | ring
     ring_bucket_size: int = 0
     stream_encode: bool = False
     stream_bucket_bytes: int = 4 << 20
+    overlap: str = "off"  # off | delayed
 
     def __post_init__(self):
         if self.aggregate not in ("gather", "psum", "ring"):
             raise ValueError(
                 f"unknown aggregate mode {self.aggregate!r}; the model-axis "
                 "dp exchange ships gather | psum | ring"
+            )
+        if self.overlap not in ("off", "delayed"):
+            raise ValueError(
+                f"unknown overlap mode {self.overlap!r}; the model-axis dp "
+                "exchange ships off | delayed"
+            )
+        if self.overlap == "delayed" and self.aggregate == "psum":
+            raise ValueError(
+                "overlap='delayed' carries an ENCODED payload between "
+                "steps; the dense psum exchange has no payload to carry — "
+                "use aggregate='gather' or 'ring'"
             )
 
 
@@ -287,6 +307,266 @@ def dp_exchange_tail(
     )
 
 
+# ---------------------------------------------------------------------------
+# delayed overlap for the model-axis steps: the replicated loop's
+# consume-next-step carry (parallel.replicated.OverlapCarry/DelayedState)
+# generalized to every dp x {sp,tp,ep,pp} layout
+# ---------------------------------------------------------------------------
+
+
+def _delayed_produce_payload(codec, k_codec, grads, exchange: DpExchange):
+    """PRODUCE half of the delayed exchange: encode THIS step's completed
+    gradient under the same ``encode`` anchor (and the same stream-encode
+    restructure) as the blocking tail — the payload at step t is
+    bit-identical to what blocking mode would have put on the wire at
+    step t (same ``k_codec`` fold, same plan). Returns the carry-shaped
+    payload (leading per-device axis of length 1) and the byte stats."""
+    with named_phase("encode"):
+        if exchange.stream_encode:
+            payloads, stats = encode_tree_streamed(
+                codec, k_codec, grads,
+                plan_layer_buckets(grads, exchange.stream_bucket_bytes),
+            )
+        else:
+            payloads, stats = encode_tree(codec, k_codec, grads)
+    payload_x = jax.tree_util.tree_map(lambda a: a[None], payloads)
+    return payload_x, stats
+
+
+def _delayed_consume(
+    optimizer, codec, train, prev_payload, valid, *,
+    dp_axis: str, n_dp: int, exchange: DpExchange,
+):
+    """CONSUME half: exchange -> decode-mean -> optimizer update on the
+    PREVIOUS step's payload, computed from STEP-START values only. The
+    ``optimization_barrier`` pins that boundary (the replicated loop's
+    exact idiom): the chain is dataflow-independent of this step's
+    forward/backward — which is the overlap — and the separately-jitted
+    oracle's apply program compiles to the same arithmetic (bit-for-bit,
+    tested). Stream-encode restructures the PRODUCE side only; payloads
+    are bit-identical to the monolithic encode, so the consume side
+    stays monolithic (the replicated family's documented choice).
+
+    Step 0 consumes nothing (``valid=0``): params/opt state hold and
+    ``metrics["skipped"]`` is 1 — the stale-by-one schedule's defined
+    start."""
+    from atomo_tpu.training.resilience import select_state
+
+    params, opt_state, prev_payload, valid = jax.lax.optimization_barrier(
+        (train.params, train.opt_state, prev_payload, valid)
+    )
+    if exchange.aggregate == "gather":
+        with named_phase("exchange"):
+            gathered = jax.lax.all_gather(prev_payload, dp_axis)
+        with named_phase("decode_mean"):
+            mean_grads = decode_mean_tree(codec, gathered, params, n_dp)
+    else:  # ring — the same canonical staged mean as the blocking tail
+        from atomo_tpu.parallel.replicated import _ring_stream_mean
+
+        my = jax.lax.axis_index(dp_axis)
+        with named_phase("ring_exchange_decode"):
+            mean_grads, _ = _ring_stream_mean(
+                codec, prev_payload, params,
+                axis=dp_axis, n_dev=n_dp, my=my, n_contrib=n_dp,
+                bucket_size=exchange.ring_bucket_size,
+            )
+    updates, new_opt = optimizer.update(mean_grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    consume_ok = valid > 0  # step 0: nothing in flight -> full skip
+    new_params = select_state(consume_ok, new_params, params)
+    new_opt = select_state(consume_ok, new_opt, opt_state)
+    new_train = TrainState(
+        step=train.step + 1,
+        params=new_params,
+        batch_stats=train.batch_stats,
+        opt_state=new_opt,
+    )
+    return new_train, {"skipped": 1.0 - consume_ok.astype(jnp.float32)}
+
+
+def delayed_dp_exchange(
+    optimizer, codec, train, carry, k_codec, grads, loss, *,
+    dp_axis: str, n_dp: int, exchange: DpExchange,
+):
+    """The fused delayed dp tail of a model-axis step: produce this
+    step's payload (:func:`_delayed_produce_payload`), consume the
+    carried one (:func:`_delayed_consume`), return
+    ``(new_train, new_carry, metrics)``. The carry holds the ENCODED
+    payload on purpose (the :class:`~atomo_tpu.parallel.replicated.
+    OverlapCarry` contract): the consume chain reads only step-start
+    values, so the scheduler can run the exchange+decode underneath this
+    step's forward/backward — and, on dp-pp, underneath the pipeline's
+    drain ticks."""
+    from atomo_tpu.parallel.replicated import OverlapCarry
+
+    payload_x, stats = _delayed_produce_payload(codec, k_codec, grads, exchange)
+    prev_payload = jax.tree_util.tree_map(
+        lambda a: jnp.squeeze(a, 0), carry.payload
+    )
+    new_train, am = _delayed_consume(
+        optimizer, codec, train, prev_payload, carry.valid,
+        dp_axis=dp_axis, n_dp=n_dp, exchange=exchange,
+    )
+    metrics = {
+        "loss": jax.lax.pmean(loss, dp_axis),
+        "msg_bytes": jnp.asarray(stats.payload_bytes, jnp.float32),
+        "dense_bytes": jnp.asarray(tree_nbytes(grads), jnp.float32),
+        **am,
+    }
+    new_carry = OverlapCarry(
+        payload=payload_x, ok=carry.ok, valid=jnp.float32(1.0)
+    )
+    return new_train, new_carry, metrics
+
+
+def model_axis_carry_specs(mesh: Mesh):
+    """The carry's PartitionSpec tree on a model-axis mesh: the leading
+    per-device axis sharded over ALL mesh axes (every device owns the one
+    row holding its own encoded slice — uniform across layouts because
+    each shard encodes its model-sharded gradient locally), the scalar
+    ``valid`` replicated."""
+    from atomo_tpu.parallel.replicated import OverlapCarry
+
+    axes = tuple(mesh.axis_names)
+    return OverlapCarry(payload=P(axes), ok=P(axes), valid=P())
+
+
+def place_model_axis_carry(mesh: Mesh, carry):
+    """Place a host-side carry onto the mesh (fresh init, ``--resume``
+    and the reshard drain all MUST place identically, or a restored
+    trajectory drifts from an uninterrupted one — the replicated
+    ``_place_carry`` contract on the model-axis sharding)."""
+    from atomo_tpu.parallel.replicated import OverlapCarry
+
+    sh = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+    return OverlapCarry(
+        payload=jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.asarray(a), sh), carry.payload
+        ),
+        ok=jax.device_put(jnp.asarray(carry.ok), sh),
+        valid=jax.device_put(
+            jnp.asarray(carry.valid), NamedSharding(mesh, P())
+        ),
+    )
+
+
+def init_model_axis_delayed_state(mesh: Mesh, state, codec):
+    """Wrap a (possibly model-sharded) LM train state into the fresh
+    :class:`~atomo_tpu.parallel.replicated.DelayedState` a delayed
+    model-axis step consumes: zero payload rows shaped by eval_shape of
+    the codec's encode over each device's LOCAL param-shard shapes (the
+    gradient the device will encode), all-healthy flags, ``valid=0``."""
+    from atomo_tpu.parallel.replicated import DelayedState, OverlapCarry
+
+    n_total = 1
+    for a in mesh.axis_names:
+        n_total *= mesh.shape[a]
+
+    def local_sds(leaf):
+        return jax.ShapeDtypeStruct(
+            tuple(leaf.sharding.shard_shape(leaf.shape)), leaf.dtype
+        )
+
+    local = jax.tree_util.tree_map(local_sds, state.params)
+    shapes = jax.eval_shape(
+        lambda p: encode_tree(codec, jax.random.PRNGKey(0), p)[0], local
+    )
+    payload = jax.tree_util.tree_map(
+        lambda s: jnp.zeros((n_total,) + tuple(s.shape), s.dtype), shapes
+    )
+    carry = OverlapCarry(
+        payload=payload,
+        ok=jnp.ones((n_total,), jnp.float32),
+        valid=jnp.float32(0.0),
+    )
+    return DelayedState(
+        train=state, carry=place_model_axis_carry(mesh, carry)
+    )
+
+
+def make_delayed_model_axis_step(
+    grads_fn, optimizer, codec, mesh: Mesh, *,
+    dp_axis: str, n_dp: int, exchange: DpExchange,
+    state_specs, token_spec, oracle_parts: bool = False,
+):
+    """Compile the delayed variant of a model-axis family: ``grads_fn``
+    is the family's forward/backward closure — ``(train, key, tokens) ->
+    (k_codec, grads, loss)`` with grads COMPLETED over the model axes —
+    and this wrapper threads the stale-by-one carry around its dp tail.
+    The jitted step is ``(DelayedState, key, tokens) -> (DelayedState,
+    metrics)`` with the carry sharded per :func:`model_axis_carry_specs`.
+
+    ``oracle_parts=True`` returns ``{"produce", "apply"}`` instead: the
+    SAME closures, separately jitted — the two-program eager oracle
+    tests/bench drive host-side to prove the fused program's stale-by-one
+    schedule bit-exact (the replicated family's ``_oracle_parts``
+    precedent)."""
+    if codec is None:
+        raise ValueError(
+            "overlap='delayed' needs a codec: the carry holds encoded "
+            "payloads (a dense delayed exchange has nothing to carry)"
+        )
+    from atomo_tpu.parallel.replicated import DelayedState
+
+    sspec = state_specs if state_specs is not None else P()
+    carry_spec = model_axis_carry_specs(mesh)
+    axes_p = carry_spec.payload
+
+    if oracle_parts:
+
+        def produce_prog(train, key, tokens):
+            k_codec, grads, loss = grads_fn(train, key, tokens)
+            payload_x, stats = _delayed_produce_payload(
+                codec, k_codec, grads, exchange
+            )
+            pm = {
+                "loss": jax.lax.pmean(loss, dp_axis),
+                "msg_bytes": jnp.asarray(stats.payload_bytes, jnp.float32),
+                "dense_bytes": jnp.asarray(tree_nbytes(grads), jnp.float32),
+            }
+            return payload_x, pm
+
+        def apply_prog(train, payload_x, valid):
+            prev = jax.tree_util.tree_map(
+                lambda a: jnp.squeeze(a, 0), payload_x
+            )
+            return _delayed_consume(
+                optimizer, codec, train, prev, valid,
+                dp_axis=dp_axis, n_dp=n_dp, exchange=exchange,
+            )
+
+        produce_j = compile_step(
+            produce_prog, mesh,
+            in_specs=(sspec, P(), token_spec),
+            out_specs=(axes_p, P()),
+            check_vma=False,
+        )
+        apply_j = compile_step(
+            apply_prog, mesh,
+            in_specs=(sspec, axes_p, P()),
+            out_specs=(sspec, P()),
+            check_vma=False,
+        )
+        return {"produce": produce_j, "apply": apply_j}
+
+    def spmd_delayed(d, key, tokens):
+        k_codec, grads, loss = grads_fn(d.train, key, tokens)
+        new_train, new_carry, metrics = delayed_dp_exchange(
+            optimizer, codec, d.train, d.carry, k_codec, grads, loss,
+            dp_axis=dp_axis, n_dp=n_dp, exchange=exchange,
+        )
+        return DelayedState(train=new_train, carry=new_carry), metrics
+
+    d_spec = DelayedState(train=sspec, carry=carry_spec)
+    return compile_step(
+        spmd_delayed, mesh,
+        in_specs=(d_spec, P(), token_spec),
+        out_specs=(d_spec, P()),
+        donate_argnums=(0,),
+        check_vma=False,
+    )
+
+
 def make_lm_train_step(
     lm_config: dict,
     optimizer,
@@ -299,6 +579,7 @@ def make_lm_train_step(
     compute_dtype=None,
     aggregate: str = "gather",
     exchange: DpExchange | None = None,
+    oracle_parts: bool = False,
 ):
     """Jitted (state, key, tokens) -> (state, metrics) with tokens (B, S)
     sharded batch-over-dp and sequence-over-sp. ``lm_config`` are
@@ -319,7 +600,7 @@ def make_lm_train_step(
     n_sp = mesh.shape[sp_axis]
     n_dp = mesh.shape[dp_axis]
 
-    def spmd_step(state: TrainState, key, tokens):
+    def grads_fn(state: TrainState, key, tokens):
         model = TransformerLM(
             **lm_config,
             attention_fn=partial(
@@ -359,11 +640,22 @@ def make_lm_train_step(
         # them again would scale the gradient by n_sp — a silent effective-LR
         # inflation verified empirically (tests/test_ring.py oracle parity).
         grads = jax.lax.pmean(grads, sp_axis)
+        return k_codec, grads, loss
 
+    def spmd_step(state: TrainState, key, tokens):
+        k_codec, grads, loss = grads_fn(state, key, tokens)
         return dp_exchange_tail(
             optimizer, codec, state, k_codec, grads, loss,
             dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
             exchange=exchange,
+        )
+
+    if exchange is not None and exchange.overlap == "delayed":
+        return make_delayed_model_axis_step(
+            grads_fn, optimizer, codec, mesh,
+            dp_axis=dp_axis, n_dp=n_dp, exchange=exchange,
+            state_specs=None, token_spec=P(dp_axis, sp_axis),
+            oracle_parts=oracle_parts,
         )
 
     # the ONE compile path (parallel.compile): construction byte-identical

@@ -65,6 +65,7 @@ def build_model_axis_program(
     aggregate: str = "gather",
     exchange: Optional[DpExchange] = None,
     devices=None,
+    oracle_parts: bool = False,
 ) -> ModelAxisProgram:
     """Resolve a model-axis layout to its (mesh, state, specs, step,
     shard) bundle.
@@ -73,15 +74,33 @@ def build_model_axis_program(
     ``spec.layout_name()`` (raises for shapes outside the LM grammar).
     ``exchange=None`` keeps each family's legacy dp tail byte-for-byte;
     a :class:`DpExchange` routes it through the full compressed stack
-    (ring aggregation, stream-encode, per-leaf budget codecs). Sizing
-    errors (head/vocab/depth/expert divisibility) surface as the
+    (ring aggregation, stream-encode, per-leaf budget codecs).
+
+    ``exchange.overlap == "delayed"`` threads the stale-by-one carry:
+    ``state`` comes back as a :class:`~atomo_tpu.parallel.replicated.
+    DelayedState` (``.params``/``.step`` read through, so driver loops
+    are unchanged) and ``step`` consumes/returns it; ``state_specs``
+    still describes the TRAIN half (checkpoint placement, reshard).
+    ``oracle_parts=True`` (delayed only) swaps ``step`` for the
+    ``{"produce", "apply"}`` two-program oracle the parity tests drive.
+    Sizing errors (head/vocab/depth/expert divisibility) surface as the
     builders' ValueErrors, untranslated.
     """
     layout = spec.layout_name()
     mesh = spec.build(devices)
+    delayed = exchange is not None and exchange.overlap == "delayed"
     kw = dict(
         compute_dtype=compute_dtype, aggregate=aggregate, exchange=exchange
     )
+    if delayed:
+        kw["oracle_parts"] = oracle_parts
+
+    def finish(state, specs, step, shard_fn) -> ModelAxisProgram:
+        if delayed:
+            from atomo_tpu.parallel.lm import init_model_axis_delayed_state
+
+            state = init_model_axis_delayed_state(mesh, state, codec)
+        return ModelAxisProgram(spec, mesh, state, specs, step, shard_fn)
 
     if layout in ("dp", "dp-sp"):
         from atomo_tpu.models.transformer import TransformerLM
@@ -95,10 +114,7 @@ def build_model_axis_program(
         step = make_lm_train_step(
             lm_config, optimizer, mesh, codec, attn_impl=attn_impl, **kw
         )
-        return ModelAxisProgram(
-            spec, mesh, state, None, step,
-            lambda t: shard_tokens(mesh, t),
-        )
+        return finish(state, None, step, lambda t: shard_tokens(mesh, t))
 
     if layout == "dp-tp":
         from atomo_tpu.parallel.tp import (
@@ -109,10 +125,7 @@ def build_model_axis_program(
         step = make_tp_lm_train_step(
             lm_config, optimizer, mesh, specs, codec, **kw
         )
-        return ModelAxisProgram(
-            spec, mesh, state, specs, step,
-            lambda t: shard_tp_tokens(mesh, t),
-        )
+        return finish(state, specs, step, lambda t: shard_tp_tokens(mesh, t))
 
     if layout == "dp-tp-sp":
         from atomo_tpu.parallel.tp import (
@@ -126,8 +139,8 @@ def build_model_axis_program(
             lm_config, optimizer, mesh, specs, codec,
             attn_impl=attn_impl, **kw
         )
-        return ModelAxisProgram(
-            spec, mesh, state, specs, step,
+        return finish(
+            state, specs, step,
             lambda t: shard_tokens_with_spec(mesh, t, P("dp", "sp")),
         )
 
@@ -141,10 +154,7 @@ def build_model_axis_program(
             lm_config, optimizer, mesh, specs, codec,
             capacity_factor=capacity_factor, aux_weight=aux_weight, **kw
         )
-        return ModelAxisProgram(
-            spec, mesh, state, specs, step,
-            lambda t: shard_moe_tokens(mesh, t),
-        )
+        return finish(state, specs, step, lambda t: shard_moe_tokens(mesh, t))
 
     if layout == "dp-pp":
         from atomo_tpu.parallel.pp import (
@@ -156,10 +166,7 @@ def build_model_axis_program(
             lm_config, optimizer, mesh, specs, codec,
             num_microbatches=num_microbatches, **kw
         )
-        return ModelAxisProgram(
-            spec, mesh, state, specs, step,
-            lambda t: shard_pp_tokens(mesh, t),
-        )
+        return finish(state, specs, step, lambda t: shard_pp_tokens(mesh, t))
 
     raise ValueError(  # pragma: no cover - layout_name() guards this
         f"unhandled layout {layout!r}"

@@ -240,6 +240,7 @@ def make_moe_lm_train_step(
     compute_dtype=None,
     aggregate: str = "gather",
     exchange: DpExchange | None = None,
+    oracle_parts: bool = False,
 ):
     """Jitted (state, key, tokens) -> (state, metrics): switch-MoE LM with
     experts sharded over ep and ATOMO-compressed gradient exchange over dp.
@@ -252,7 +253,7 @@ def make_moe_lm_train_step(
     n_ep = mesh.shape[ep_axis]
     param_specs = state_specs.params
 
-    def spmd_step(state: TrainState, key, tokens):
+    def grads_fn(state: TrainState, key, tokens):
         b_local, s = tokens.shape
         t_local = b_local * s
         capacity = max(1, math.ceil(capacity_factor * t_local / cfg["num_experts"]))
@@ -287,10 +288,25 @@ def make_moe_lm_train_step(
         # (no divide_by: the loss path crosses no psum — module docstring)
         grads = complete_model_axis_grads(grads, param_specs, ep_axis)
         replica_loss = jax.lax.psum(loss, ep_axis)
+        return k_codec, grads, replica_loss
+
+    def spmd_step(state: TrainState, key, tokens):
+        k_codec, grads, replica_loss = grads_fn(state, key, tokens)
         return dp_exchange_tail(
             optimizer, codec, state, k_codec, grads, replica_loss,
             dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
             exchange=exchange,
+        )
+
+    if exchange is not None and exchange.overlap == "delayed":
+        from atomo_tpu.parallel.lm import make_delayed_model_axis_step
+
+        return make_delayed_model_axis_step(
+            grads_fn, optimizer, codec, mesh,
+            dp_axis=dp_axis, n_dp=n_dp, exchange=exchange,
+            state_specs=state_specs,
+            token_spec=P((dp_axis, ep_axis), None),
+            oracle_parts=oracle_parts,
         )
 
     return compile_step(

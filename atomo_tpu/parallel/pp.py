@@ -180,6 +180,7 @@ def make_pp_lm_train_step(
     compute_dtype=None,
     aggregate: str = "gather",
     exchange: DpExchange | None = None,
+    oracle_parts: bool = False,
 ):
     """Jitted (state, key, tokens) -> (state, metrics): GPipe pipeline over
     pp with ATOMO-compressed gradient exchange over dp.
@@ -192,7 +193,7 @@ def make_pp_lm_train_step(
     m = num_microbatches
     param_specs = state_specs.params
 
-    def spmd_step(state: TrainState, key, tokens):
+    def grads_fn(state: TrainState, key, tokens):
         b_local, s = tokens.shape
         if b_local % m:
             raise ValueError(
@@ -250,10 +251,28 @@ def make_pp_lm_train_step(
         # loss path, so no divide_by)
         grads = complete_model_axis_grads(grads, param_specs, pp_axis)
         replica_loss = jax.lax.psum(loss, pp_axis)
+        return k_codec, grads, replica_loss
+
+    def spmd_step(state: TrainState, key, tokens):
+        k_codec, grads, replica_loss = grads_fn(state, key, tokens)
         return dp_exchange_tail(
             optimizer, codec, state, k_codec, grads, replica_loss,
             dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
             exchange=exchange,
+        )
+
+    if exchange is not None and exchange.overlap == "delayed":
+        # the consume chain reads only step-start values, so the scheduler
+        # can run the dp exchange underneath the pipeline's drain ticks —
+        # the bubble becomes overlap headroom (comm_model.overlap_report's
+        # bubble_hidden_ms term prices exactly this)
+        from atomo_tpu.parallel.lm import make_delayed_model_axis_step
+
+        return make_delayed_model_axis_step(
+            grads_fn, optimizer, codec, mesh,
+            dp_axis=dp_axis, n_dp=n_dp, exchange=exchange,
+            state_specs=state_specs, token_spec=P(dp_axis, None),
+            oracle_parts=oracle_parts,
         )
 
     return compile_step(

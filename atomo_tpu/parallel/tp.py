@@ -265,6 +265,7 @@ def make_tp_lm_train_step(
     compute_dtype=None,
     aggregate: str = "gather",
     exchange: DpExchange | None = None,
+    oracle_parts: bool = False,
 ):
     """Jitted (state, key, tokens) -> (state, metrics): Megatron-TP forward/
     backward with ATOMO-compressed gradient exchange over dp.
@@ -279,7 +280,7 @@ def make_tp_lm_train_step(
     v_local = lm_config["vocab_size"] // n_tp
     param_specs = state_specs.params
 
-    def spmd_step(state: TrainState, key, tokens):
+    def grads_fn(state: TrainState, key, tokens):
         my_dp = jax.lax.axis_index(dp_axis)
         k_codec = jax.random.fold_in(jax.random.fold_in(key, state.step), my_dp)
 
@@ -304,11 +305,24 @@ def make_tp_lm_train_step(
         # uniform n-scaling: sharded leaves become their exact slice grad,
         # replicated leaves get psum/n = pmean.
         grads = complete_model_axis_grads(grads, param_specs, tp_axis, n_tp)
+        return k_codec, grads, loss
 
+    def spmd_step(state: TrainState, key, tokens):
+        k_codec, grads, loss = grads_fn(state, key, tokens)
         return dp_exchange_tail(
             optimizer, codec, state, k_codec, grads, loss,
             dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
             exchange=exchange,
+        )
+
+    if exchange is not None and exchange.overlap == "delayed":
+        from atomo_tpu.parallel.lm import make_delayed_model_axis_step
+
+        return make_delayed_model_axis_step(
+            grads_fn, optimizer, codec, mesh,
+            dp_axis=dp_axis, n_dp=n_dp, exchange=exchange,
+            state_specs=state_specs, token_spec=P(dp_axis, None),
+            oracle_parts=oracle_parts,
         )
 
     return compile_step(
@@ -343,6 +357,7 @@ def make_tp_sp_lm_train_step(
     compute_dtype=None,
     aggregate: str = "gather",
     exchange: DpExchange | None = None,
+    oracle_parts: bool = False,
 ):
     """Jitted (state, key, tokens) -> (state, metrics) over a 3-D mesh:
     batch over dp, heads/hidden/vocab over tp, SEQUENCE over sp — the full
@@ -373,7 +388,7 @@ def make_tp_sp_lm_train_step(
     v_local = lm_config["vocab_size"] // n_tp
     param_specs = state_specs.params
 
-    def spmd_step(state: TrainState, key, tokens):
+    def grads_fn(state: TrainState, key, tokens):
         s_local = tokens.shape[1]
         my_dp = jax.lax.axis_index(dp_axis)
         k_codec = jax.random.fold_in(jax.random.fold_in(key, state.step), my_dp)
@@ -406,10 +421,24 @@ def make_tp_sp_lm_train_step(
         grads = complete_model_axis_grads(
             grads, param_specs, tp_axis, n_tp * n_sp
         )
+        return k_codec, grads, loss
+
+    def spmd_step(state: TrainState, key, tokens):
+        k_codec, grads, loss = grads_fn(state, key, tokens)
         return dp_exchange_tail(
             optimizer, codec, state, k_codec, grads, loss,
             dp_axis=dp_axis, n_dp=n_dp, aggregate=aggregate,
             exchange=exchange,
+        )
+
+    if exchange is not None and exchange.overlap == "delayed":
+        from atomo_tpu.parallel.lm import make_delayed_model_axis_step
+
+        return make_delayed_model_axis_step(
+            grads_fn, optimizer, codec, mesh,
+            dp_axis=dp_axis, n_dp=n_dp, exchange=exchange,
+            state_specs=state_specs, token_spec=P(dp_axis, sp_axis),
+            oracle_parts=oracle_parts,
         )
 
     return compile_step(

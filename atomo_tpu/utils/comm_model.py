@@ -448,7 +448,13 @@ def overlap_report(
     (:func:`pipeline_bubble_s` on ``compute_s``) to BOTH step numbers —
     like exposed encode it is critical-path time the dp-wire saving
     cannot touch, so the ``dp x pp`` layouts report it side by side with
-    encode exposure instead of hiding it inside "compute".
+    encode exposure instead of hiding it inside "compute". Under delayed
+    the bubble is ALSO overlap headroom: the consume chain reads only
+    step-start values, so the scheduler runs it underneath the drain
+    ticks as well as the compute — whatever part of the chain spills
+    past the compute can still hide under the bubble
+    (``bubble_hidden_ms``), and only the remainder stays exposed in
+    ``delayed_step_ms``.
     """
     if aggregate == "ring":
         wire = ring_stream_wire_bytes(payload_bytes, dense_bytes, ways)
@@ -465,6 +471,11 @@ def overlap_report(
     bubble = pipeline_bubble_s(
         compute_s, pipeline_stages, pipeline_microbatches
     )
+    # bubble credit: the chain hides under compute first (hidden), then
+    # whatever spills past compute hides under the drain-tick bubble —
+    # exposed-under-delayed is only the excess over BOTH
+    bubble_hidden = min(exposed, bubble)
+    delayed_exposed = max(0.0, comm_s - float(compute_s) - bubble)
     return {
         "aggregate": aggregate,
         "ways": ways,
@@ -483,11 +494,12 @@ def overlap_report(
             pipeline_bubble_fraction(pipeline_stages, pipeline_microbatches),
             4,
         ),
+        "bubble_hidden_ms": round(bubble_hidden * 1e3, 3),
         "blocking_step_ms": round(
             (compute_s + comm_s + enc_exposed + bubble) * 1e3, 3
         ),
         "delayed_step_ms": round(
-            (compute_s + exposed + enc_exposed + bubble) * 1e3, 3
+            (compute_s + delayed_exposed + enc_exposed + bubble) * 1e3, 3
         ),
         "assumptions": (
             "delayed overlaps exchange+decode with fwd/bwd+update; hides "
@@ -497,7 +509,9 @@ def overlap_report(
             "(exposed encode = max(0, encode_tail) = encode/n_buckets, "
             "uniform-bucket model); pipeline_stages>1 adds the GPipe "
             "bubble compute*(n_stages-1)/microbatches to both step "
-            "numbers — see atomo_tpu/utils/comm_model.py"
+            "numbers, and under delayed the bubble is ALSO hiding budget "
+            "(bubble_hidden_ms): exposed = max(0, comm - compute - "
+            "bubble) — see atomo_tpu/utils/comm_model.py"
         ),
     }
 
@@ -976,7 +990,14 @@ def predict_step_s(
         wire = ring_allgather_wire_bytes(payload_bytes, ways)
     chain = wire / fabric_bw + decode_s
     if cand.get("overlap") == "delayed" and agg in ("gather", "ring"):
-        chain = overlap_exposed_comm_s(chain, compute_s)
+        # the consume chain reads only step-start values, so it hides
+        # under compute AND (for dp x pp candidates) the drain-tick
+        # bubble — the bubble the candidate is already charged for is
+        # simultaneously overlap headroom (overlap_report's
+        # bubble_hidden_ms term)
+        chain = overlap_exposed_comm_s(
+            chain, compute_s + float(cand.get("pipeline_bubble_s") or 0.0)
+        )
     straggler_s = 0.0
     if quorum_delays:
         # every synchronous step is gated by its stragglers: blocking

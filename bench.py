@@ -328,6 +328,39 @@ CONFIGS = {
     19: dict(metric="lm_compressed_dp_wire", kind="lmwire",
              width=32, depth=2, num_heads=4, vocab=64, seq=16, batch=8,
              n_dev=4, tp=2, ways=2, force_cpu_mesh=True),
+    # Config 20 (PR-19 delayed-overlap tentpole): lm_delayed_overlap —
+    # the stale-by-one compressed dp exchange on a MODEL-AXIS layout
+    # (dp2 x pp2 TransformerLM: the layout whose drain-tick bubble the
+    # pricing credits as overlap headroom), forced 4-device CPU mesh.
+    # The headline: delayed vs blocking fenced ms/step at EQUAL wire —
+    # the exchange+decode chain leaves the critical path, the bytes do
+    # not change. Gates, the configs 8-19 discipline: (1) OFF-MODE HLO
+    # BYTE IDENTITY — DpExchange(overlap="off") lowers to byte-identical
+    # HLO vs the overlap-less DpExchange (the carry threading cost
+    # nothing when off); (2) ORACLE BIT-PARITY — the fused delayed
+    # program steps bit-identical params AND carry payload vs the
+    # host-driven two-program produce/apply oracle (oracle_parts=True)
+    # running the same stale-by-one schedule (the replicated family's
+    # _oracle_parts drill, generalized — the replicated loop itself is
+    # CV-only and cannot host the LM, so the oracle IS the schedule
+    # contract); (3) EQUAL WIRE — delayed msg_bytes == blocking
+    # msg_bytes, same codec, same payload; (4) the RESUME DRILL — T
+    # steps + save_checkpoint (the carry is a sharded leaf of the
+    # checkpointed DelayedState) + fresh rebuild + load + place + T more
+    # steps replays bit-exact (params and carry) against the
+    # uninterrupted 2T-step run. Semantics + schedule-honesty evidence
+    # like configs 8-19, not a chip-speed claim (CPU dispatch cannot
+    # show the overlap win; overlap_report's modelled numbers ride in
+    # the row, bubble_hidden_ms included). Baseline "none".
+    20: dict(metric="lm_delayed_overlap", kind="lmdelayed",
+             width=32, depth=2, num_heads=2, vocab=64, seq=16, batch=8,
+             n_dev=4, pp=2, ways=2, microbatches=2, force_cpu_mesh=True,
+             # the resume drill compares TWO executables of the SAME HLO
+             # (the uninterrupted program vs the restarted rebuild); this
+             # backend's persistent-cache round-trip is not bit-faithful
+             # (the warm-cache parity hazard tests/conftest.py records),
+             # so the child must never inherit ATOMO_COMPILE_CACHE
+             no_compile_cache=True),
 }
 
 # Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
@@ -3017,6 +3050,225 @@ def measure_lm_wire(cfg: dict) -> dict:
     return out
 
 
+def measure_lm_delayed_overlap(cfg: dict) -> dict:
+    """Config-20: delayed-overlap vs blocking compressed dp exchange on
+    the dp2xpp2 model-axis LM layout (see CONFIGS[20] for the full row
+    contract).
+
+    ``value`` is the delayed step's fenced ms/step; the gates are
+    schedule honesty, not speed: off-mode HLO byte identity, fused-vs-
+    oracle bit parity (params AND carry payload), equal wire, and the
+    bit-exact carry resume drill."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from atomo_tpu.codecs import QsgdCodec
+    from atomo_tpu.mesh.spec import MeshSpec
+    from atomo_tpu.parallel.lm import DpExchange, place_model_axis_carry
+    from atomo_tpu.parallel.model_axes import build_model_axis_program
+    from atomo_tpu.parallel.replicated import DelayedState
+    from atomo_tpu.training import make_optimizer
+    from atomo_tpu.training.checkpoint import load_checkpoint, save_checkpoint
+    from atomo_tpu.utils.comm_model import overlap_report
+
+    fast = os.environ.get("ATOMO_BENCH_FAST") == "1"
+    dev = jax.devices()[0]
+    n_dev = min(int(cfg.get("n_dev", 4)), len(jax.devices()))
+    pp = int(cfg.get("pp", 2))
+    batch = int(cfg.get("batch", 8))
+    micro = int(cfg.get("microbatches", 2))
+    lm_cfg = dict(
+        vocab_size=cfg["vocab"], max_len=cfg["seq"], width=cfg["width"],
+        depth=cfg["depth"], num_heads=cfg["num_heads"],
+    )
+    base = dict(
+        metric=cfg["metric"], unit="ms/step", value=None,
+        byte_reduction=None, mfu=None, flops_per_step=None,
+        peak_tflops=None, platform=dev.platform, device=dev.device_kind,
+        ways=n_dev // pp, chips_measured=n_dev,
+        timing="dispatch-loop-scalar-fenced",
+        config=dict(kind="lmdelayed", **lm_cfg, batch=batch, n_dev=n_dev,
+                    pp=pp, microbatches=micro, layout="dp-pp",
+                    code="qsgd", bits=8, overlap="delayed"),
+        note=(f"stale-by-one dp exchange on the dp{n_dev // pp}xpp{pp} LM "
+              f"layout, {n_dev}-device {dev.platform} mesh; off-HLO-"
+              "identity + oracle-parity + equal-wire + carry-resume gates "
+              "in-row; not a chip-speed claim"),
+    )
+    if n_dev < 4 or n_dev % pp:
+        base.update(
+            measurement_valid=False,
+            invalid_reason=f"need a dp x pp mesh (pp={pp}), have {n_dev} "
+                           "devices",
+        )
+        return base
+
+    spec = MeshSpec.from_layout("dp-pp", n_dev, pp)
+    n_dp = n_dev // pp
+    opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
+    codec = QsgdCodec(bits=8, bucket_size=512)
+    key = jax.random.PRNGKey(1)
+    toks_host = np.random.default_rng(0).integers(
+        0, cfg["vocab"], size=(batch, cfg["seq"])
+    ).astype(np.int32)
+    steps = _env_int("ATOMO_BENCH_STEPS", 3 if fast else 10)
+    T = 3  # resume-drill half-length
+
+    def build(seed, exchange, **kw):
+        return build_model_axis_program(
+            spec, lm_cfg, opt, jax.random.PRNGKey(seed), codec,
+            exchange=exchange, num_microbatches=micro, **kw
+        )
+
+    ex_delayed = DpExchange(aggregate="gather", overlap="delayed")
+    out = dict(base, measurement_valid=True, invalid_reason=None)
+    try:
+        prog_d = build(0, ex_delayed)
+        prog_b = build(0, DpExchange(aggregate="gather"))
+        toks = prog_d.shard_tokens(toks_host)
+
+        # --- gate 1: off-mode HLO byte identity (the carry threading
+        # costs NOTHING when overlap is off)
+        prog_off = build(0, DpExchange(aggregate="gather", overlap="off"))
+        h_plain = prog_b.step.lower(prog_b.state, key, toks).as_text()
+        h_off = prog_off.step.lower(prog_off.state, key, toks).as_text()
+        out["off_hlo_byte_identical"] = bool(h_plain == h_off)
+        if not out["off_hlo_byte_identical"]:
+            _mark_invalid(
+                out,
+                "overlap='off' program lowered different HLO than the "
+                "overlap-less DpExchange (the off-mode identity contract)",
+            )
+
+        # --- gate 2: fused delayed program == host-driven produce/apply
+        # oracle over the same stale-by-one schedule, bit for bit
+        oracle = build(0, ex_delayed, oracle_parts=True)
+        st = prog_d.state
+        md = None
+        for i in range(2 * T):
+            st, md = prog_d.step(st, jax.random.fold_in(key, i), toks)
+        train = oracle.state.train
+        payload = oracle.state.carry.payload
+        valid = oracle.state.carry.valid
+        for i in range(2 * T):
+            k_i = jax.random.fold_in(key, i)
+            new_payload, _ = oracle.step["produce"](train, k_i, toks)
+            train, _ = oracle.step["apply"](train, payload, valid)
+            payload, valid = new_payload, jnp.float32(1.0)
+
+        def bit_eq(a, b):
+            return all(
+                np.array_equal(np.asarray(x), np.asarray(y))
+                for x, y in zip(
+                    jax.tree_util.tree_leaves(jax.device_get(a)),
+                    jax.tree_util.tree_leaves(jax.device_get(b)),
+                )
+            )
+
+        parity = bit_eq(st.train.params, train.params) and bit_eq(
+            st.carry.payload, payload
+        )
+        out["oracle_bit_parity"] = bool(parity)
+        if not parity:
+            _mark_invalid(
+                out,
+                "fused delayed program diverged from the produce/apply "
+                "oracle (params or carry payload)",
+            )
+
+        # --- gate 3: equal wire — delayed moves the SAME payload bytes
+        sb, mb = prog_b.state, None
+        for i in range(2):
+            sb, mb = prog_b.step(sb, jax.random.fold_in(key, i), toks)
+        msg_d = int(float(md["msg_bytes"]))
+        msg_b = int(float(mb["msg_bytes"]))
+        out["msg_bytes"] = msg_d
+        out["dense_bytes"] = int(float(md["dense_bytes"]))
+        out["equal_wire"] = bool(msg_d == msg_b)
+        if not out["equal_wire"]:
+            _mark_invalid(
+                out,
+                f"delayed msg_bytes {msg_d} != blocking msg_bytes {msg_b} "
+                "(same codec, same payload — the equal-wire contract)",
+            )
+        out["byte_reduction"] = round(
+            out["dense_bytes"] / max(msg_d, 1), 2
+        )
+
+        # --- gate 4: kill->restart->resume of the carry, bit-exact.
+        # Deterministic per-step tokens (the CLI's host data stream is
+        # stateful, so the drill drives the program directly)
+        st_a = build(7, ex_delayed).state
+        for i in range(2 * T):
+            st_a, _ = prog_d.step(st_a, jax.random.fold_in(key, i), toks)
+        st_b = build(7, ex_delayed).state
+        for i in range(T):
+            st_b, _ = prog_d.step(st_b, jax.random.fold_in(key, i), toks)
+        with tempfile.TemporaryDirectory() as tmp:
+            save_checkpoint(tmp, st_b)
+            fresh = build(7, ex_delayed)  # the restarted process
+            host = load_checkpoint(tmp, jax.device_get(fresh.state))
+        from jax.sharding import NamedSharding
+
+        train_r = jax.tree_util.tree_map(
+            lambda leaf, sp: jax.device_put(
+                leaf, NamedSharding(fresh.mesh, sp)
+            ),
+            host.train, fresh.state_specs,
+        )
+        st_r = DelayedState(
+            train=train_r,
+            carry=place_model_axis_carry(fresh.mesh, host.carry),
+        )
+        for i in range(T, 2 * T):
+            st_r, _ = fresh.step(st_r, jax.random.fold_in(key, i), toks)
+        resumed = bit_eq(st_a.train.params, st_r.train.params) and bit_eq(
+            st_a.carry.payload, st_r.carry.payload
+        )
+        out["resume_bit_exact"] = bool(resumed)
+        if not resumed:
+            _mark_invalid(
+                out,
+                "kill->restart->resume diverged from the uninterrupted "
+                "run (params or carry payload)",
+            )
+
+        # --- fenced ms/step, delayed vs blocking (equal wire) ---------
+        def timed(step_fn, st0):
+            st0, m = step_fn(st0, key, toks)  # warm
+            float(m["loss"])
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                st0, m = step_fn(st0, key, toks)
+            float(m["loss"])  # the fence
+            return (time.perf_counter() - t0) / steps
+
+        out["value"] = round(timed(prog_d.step, build(1, ex_delayed).state) * 1e3, 3)
+        out["blocking_ms_per_step"] = round(
+            timed(prog_b.step, build(1, DpExchange(aggregate="gather")).state)
+            * 1e3, 3
+        )
+        # the modelled account the controller prices from (CPU dispatch
+        # cannot show the overlap win; the model states what a real
+        # fabric buys, bubble credit included)
+        out["overlap_model"] = overlap_report(
+            dense_bytes=float(out["dense_bytes"]),
+            payload_bytes=float(msg_d),
+            ways=n_dp,
+            fabric_bw=1e9,
+            compute_s=out["blocking_ms_per_step"] / 1e3,
+            pipeline_stages=pp,
+            pipeline_microbatches=micro,
+        )
+    except Exception as exc:  # noqa: BLE001 — a failed drill is a failed row
+        _mark_invalid(out, f"lm delayed drill failed: {str(exc)[:200]}")
+    return out
+
+
 def measure_scenarios(cfg: dict) -> dict:
     """Config-10: the scenario matrix (autopilot regression gate).
 
@@ -3555,6 +3807,8 @@ def measure_ours(cfg: dict) -> dict:
         return measure_controller_joint(cfg)
     if cfg.get("kind") == "lmwire":
         return measure_lm_wire(cfg)
+    if cfg.get("kind") == "lmdelayed":
+        return measure_lm_delayed_overlap(cfg)
 
     model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)
@@ -4308,9 +4562,12 @@ def _bench_one(config: int, no_baseline: bool, try_tpu: bool = True) -> dict:
                  + str(cfg.get("n_dev", 4))).strip()
         # baseline is "none" by design for this row: build the child args
         # explicitly rather than conditioning on the tail's contents
+        child_env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags}
+        if cfg.get("no_compile_cache"):
+            child_env["ATOMO_COMPILE_CACHE"] = ""  # falsy -> cache off
         parsed, err = _run_child(
             ["--config", str(config), "--no-baseline"],
-            {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags},
+            child_env,
             timeout_s=int(min(CHILD_TIMEOUT_S, max(45, _remaining() - 10))),
         )
         if parsed is not None:

@@ -125,6 +125,17 @@
 #      dense, and the seed-ensemble loss no worse than dense within
 #      tolerance — the PR-18 model-axes compile path.
 #
+#  18. the delayed-overlap contract (<60 s, forced 4-device CPU mesh):
+#      bench config 20 runs the stale-by-one compressed dp exchange on
+#      the dp2 x pp2 TransformerLM layout and must exit 0 with the
+#      off-mode HLO byte-identity gate TRUE (overlap="off" lowers the
+#      exact blocking program), the fused delayed program bit-identical
+#      (params AND carry payload) to the host-driven produce/apply
+#      oracle over the same stale-by-one schedule, delayed msg_bytes
+#      equal to blocking msg_bytes (equal wire), and the carry resume
+#      drill bit-exact (save -> fresh rebuild -> load -> place -> replay
+#      vs the uninterrupted run) — the PR-19 delayed-overlap tentpole.
+#
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
 cd "$(dirname "$0")/.." || exit 2
@@ -160,7 +171,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/17]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/18]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -189,7 +200,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/17]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/18]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -226,7 +237,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/17]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/18]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -257,7 +268,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/17]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/18]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -284,7 +295,7 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/17]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/18]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
 EOF
 [ $? -ne 0 ] && exit 1
@@ -317,7 +328,7 @@ for r in probed:
     assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
     assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
 assert doc.get("why"), doc
-print(f"bench_smoke OK[6/17]: --auto tune picked {win['name']} "
+print(f"bench_smoke OK[6/18]: --auto tune picked {win['name']} "
       f"({win.get('measured_ms_per_step')} ms/step measured, "
       f"{len(probed)}/{len(doc['rows'])} candidates probed); "
       "decision artifact parses")
@@ -361,7 +372,7 @@ for p in plans:
     assert isinstance(p.get("predicted_ms_per_step"), (int, float)), p
 td = row.get("tune_decision") or {}
 assert td.get("hierarchical_probed"), row
-print(f"bench_smoke OK[7/17]: two-tier plans "
+print(f"bench_smoke OK[7/18]: two-tier plans "
       f"{[p['plan'] for p in plans]} measured with per-tier "
       "predicted-vs-measured bytes matching, per-plan bit_parity=True; "
       f"mini-tune probed {td['hierarchical_probed']} "
@@ -409,7 +420,7 @@ sys.path.insert(0, ".")
 from atomo_tpu.training.checkpoint import latest_valid_step
 
 assert latest_valid_step(d) == 8, latest_valid_step(d)
-print("bench_smoke OK[8/17]: die@3:1 shrank 4 -> 3 at a checkpoint "
+print("bench_smoke OK[8/18]: die@3:1 shrank 4 -> 3 at a checkpoint "
       "boundary (planned reshape, restart budget untouched), finished at "
       f"step {latest_valid_step(d)} with membership epochs "
       f"{[w[0] for w in worlds]} recorded")
@@ -445,7 +456,7 @@ for k in ("compute_ms", "encode_monolithic_ms", "encode_streamed_ms",
           "encode_hidden_stream_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 assert int(ph.get("n_buckets", 0)) > 1, row
-print(f"bench_smoke OK[9/17]: stream {row['value']} vs off "
+print(f"bench_smoke OK[9/18]: stream {row['value']} vs off "
       f"{row['off_ms_per_step']} ms/step; exposed encode "
       f"{ph['encode_exposed_stream_ms']} (stream, {ph['n_buckets']} "
       f"buckets) vs {ph['encode_exposed_off_ms']} (off) ms; "
@@ -494,7 +505,7 @@ assert doc["consistent"] is True, doc["checks"]
 ran = [c["name"] for c in doc["checks"] if not c["skipped"]]
 segs = [e for e in doc["timeline"] if e["kind"] == "metrics"]
 assert segs and segs[0]["first_step"] == 1 and segs[-1]["last_step"] == 6
-print("bench_smoke OK[10/17]: recorder+quality run left "
+print("bench_smoke OK[10/18]: recorder+quality run left "
       f"{len(steps)} step records ({len(steps[0]['q_rel'])}-layer "
       "quality columns), report verb joined a consistent timeline "
       f"(checks ran: {ran})")
@@ -534,7 +545,7 @@ for l in layers:
     assert 0.0 <= l["density"] <= 1.0, l
     if l["assignment"] == "sparse":
         assert l["payload_bytes"] < l["dense_bytes"], l
-print(f"bench_smoke OK[11/17]: hybrid {row['hybrid_wire_bytes']} B vs "
+print(f"bench_smoke OK[11/18]: hybrid {row['hybrid_wire_bytes']} B vs "
       f"all-dense {row['alldense_wire_bytes']} B on the wire "
       f"({row['wire_reduction']}x reduction, "
       f"{len(plan['sparse_leaves'])}/{plan['n_leaves']} leaves sparse); "
@@ -578,7 +589,7 @@ assert set(ratios) == {"ici", "dcn"} and all(
 # even on a contended host
 assert row["fabric_parity"] is True, row
 assert row["run_artifact_complete"] is True, row
-print(f"bench_smoke OK[12/17]: probed ici {tiers['ici']['bandwidth_gbps']} "
+print(f"bench_smoke OK[12/18]: probed ici {tiers['ici']['bandwidth_gbps']} "
       f"/ dcn {tiers['dcn']['bandwidth_gbps']} GB/s/chip "
       f"({tiers['ici']['latency_us']} / {tiers['dcn']['latency_us']} "
       "us/hop); measured-vs-preset ratios recorded; measured-priced vs "
@@ -619,7 +630,7 @@ assert shd < z1 < rep, (rep, z1, shd)
 assert row["state_bytes_reduction"] > 1.5, row
 for part in ("replicated", "zero1", "sharded_update"):
     assert row[f"{part}_ms_per_step"] > 0, row
-print(f"bench_smoke OK[13/17]: per-chip state {rep} -> {z1} (zero1) -> "
+print(f"bench_smoke OK[13/18]: per-chip state {rep} -> {z1} (zero1) -> "
       f"{shd} B (sharded-update, {row['state_bytes_reduction']}x); "
       f"ms/step {row['replicated_ms_per_step']} / "
       f"{row['zero1_ms_per_step']} / {row['sharded_update_ms_per_step']}; "
@@ -659,7 +670,7 @@ assert row["measured_variance_reduction"] > 0, row
 assert row["pareto_loss_ok"] is True, row
 # gate 4: bit-exact resume from the recorded allocation artifact
 assert row["resume_bit_exact"] is True, row
-print(f"bench_smoke OK[14/17]: variance alloc {alloc['variance_ks']} vs "
+print(f"bench_smoke OK[14/18]: variance alloc {alloc['variance_ks']} vs "
       f"uniform {alloc['uniform_ks']} at "
       f"{row['variance_row']['wire_bytes']} <= "
       f"{row['uniform_row']['wire_bytes']} B wire; measured q_err2 "
@@ -703,7 +714,7 @@ assert row["schedule_steps_recorded"] > 0, row
 # gates quorum < blocking)
 assert row["straggler_absorption_speedup"] > 1, row
 assert row["stale_dropped"] == 0, row
-print(f"bench_smoke OK[15/17]: quorum {row['value']} vs blocking "
+print(f"bench_smoke OK[15/18]: quorum {row['value']} vs blocking "
       f"{row['blocking_ms_per_step']} ms/step under one slow@ replica "
       f"({row['straggler_absorption_speedup']}x absorbed) at equal wire "
       f"({row['msg_bytes']} B); {row['schedule_steps_recorded']}-step "
@@ -748,7 +759,7 @@ assert row["pin_bit_parity"] is True, row
 assert row["pin_equal_wire"] is True, row
 assert row["resume_reusable"] is True, row
 assert row["resume_bit_parity"] is True, row
-print(f"bench_smoke OK[16/17]: controller picked "
+print(f"bench_smoke OK[16/18]: controller picked "
       f"{row['joint_winner']['name']} "
       f"({row['value']} ms/step vs best standalone "
       f"{row['best_single_ms_per_step']}); artifact-pin bit-exact at "
@@ -787,7 +798,7 @@ assert row["degeneracy_bit_parity"] is True, row
 assert row["byte_reduction"] > 1, row
 # and the seed ensemble says the wire saving is not bought with loss
 assert row["loss_no_worse"] is True, row
-print(f"bench_smoke OK[17/17]: dp2xtp2 LM compressed dp wire "
+print(f"bench_smoke OK[17/18]: dp2xtp2 LM compressed dp wire "
       f"{row['msg_bytes']} B vs dense {row['dense_bytes']} B "
       f"({row['byte_reduction']}x), predicted == executed to the byte; "
       f"scoped-vs-legacy bit-exact; ensemble loss "
@@ -796,4 +807,50 @@ print(f"bench_smoke OK[17/17]: dp2xtp2 LM compressed dp wire "
 EOF17
 [ $? -ne 0 ] && exit 1
 
-echo "bench_smoke: all 17 checks passed"
+# --- 18: config 20, delayed-overlap model-axis contract ------------------
+# NO compile cache here: the resume drill compares two executables of
+# the SAME HLO (uninterrupted vs restarted rebuild), and this backend's
+# persistent-cache round-trip is not bit-faithful (the warm-cache
+# parity hazard tests/conftest.py records) — measured as a
+# deterministic resume-drill divergence with any cache dir set.
+# bench.py strips ATOMO_COMPILE_CACHE from the config-20 child too
+# (CONFIGS[20]["no_compile_cache"]), so this is belt and suspenders.
+out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+      ATOMO_COMPILE_CACHE="" \
+      ATOMO_BENCH_ARTIFACT="$art/c20.json" \
+      python bench.py --config 20 --no-baseline 2>/dev/null)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: config 20 exited rc=$rc (timeout or crash)"
+  exit 1
+fi
+printf '%s\n' "$out" > "$art/c20.out"
+python - "$art/c20.out" <<'EOF18'
+import json, sys
+
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+assert lines, "bench_smoke FAIL: config 20 emitted no JSON"
+row = json.loads(lines[-1])
+assert row["metric"] == "lm_delayed_overlap", row
+assert row["measurement_valid"], row.get("invalid_reason")
+# the off-mode identity contract: threading the carry costs nothing off
+assert row["off_hlo_byte_identical"] is True, row
+# the schedule contract: fused delayed == host-driven produce/apply
+# oracle, params AND carry payload, bit for bit
+assert row["oracle_bit_parity"] is True, row
+# equal wire: delayed moves the same payload bytes as blocking
+assert row["equal_wire"] is True, row
+# the carry is a durable sharded leaf: kill->restart->resume bit-exact
+assert row["resume_bit_exact"] is True, row
+# the modelled account rides in-row, bubble credit included
+assert "bubble_hidden_ms" in row["overlap_model"], row
+print(f"bench_smoke OK[18/18]: dp2xpp2 LM delayed overlap "
+      f"{row['value']} ms/step vs blocking "
+      f"{row['blocking_ms_per_step']} ms/step at equal wire "
+      f"({row['msg_bytes']} B); off-HLO identical, oracle + resume "
+      f"bit-exact")
+EOF18
+[ $? -ne 0 ] && exit 1
+
+echo "bench_smoke: all 18 checks passed"

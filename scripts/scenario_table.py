@@ -314,9 +314,12 @@ def lm_recs(ways: int, tp: int = 2) -> dict:
     gradient shard (each tp shard exchanges its own slice — the same
     per-leaf accounting bench config 19's byte-match gate pins to the
     executed program) plus the layout's pre-priced axis-collective
-    floor (``comm_model.tp_psum_wire_bytes`` over the fabric). Opt-in
+    floor (``comm_model.tp_psum_wire_bytes`` over the fabric). The
+    candidate space includes the ``+delayed`` stale-by-one rows
+    (``overlap`` column: the exchange priced as ``max(0, chain -
+    compute - bubble)`` hidden behind the NEXT step's compute). Opt-in
     so the published historical table is stable; model-only ordering —
-    bench config 19 carries the measured evidence."""
+    bench configs 19/20 carry the measured evidence."""
     import jax
     import jax.numpy as jnp
 
@@ -383,6 +386,7 @@ def lm_recs(ways: int, tp: int = 2) -> dict:
             {
                 "code": "qsgd8",
                 "candidate": c["name"],
+                "overlap": c.get("overlap", "off"),
                 "predicted_ms_per_step": c["predicted_ms_per_step"],
                 "measured_1chip_ms": None,
                 "codec_tax_ms": round(tax_ms, 3),
@@ -461,11 +465,12 @@ def main() -> int:
     ap.add_argument("--lm", action="store_true", default=False,
                     help="add the model-axis LM scenario (dp x tp2 "
                          "TransformerLM) with the controller's lm[tp2] "
-                         "candidates, priced over the tp-LOCAL gradient "
+                         "candidates — +delayed stale-by-one rows "
+                         "included — priced over the tp-LOCAL gradient "
                          "shard + the tp psum floor. Off by default so "
                          "the published table's historical rows are "
-                         "stable; bench config 19 carries the measured "
-                         "evidence")
+                         "stable; bench configs 19/20 carry the "
+                         "measured evidence")
     ap.add_argument("--from-bench", type=str, default="",
                     help="read recommendations from a bench "
                          "scenario_matrix row / artifact instead of the "

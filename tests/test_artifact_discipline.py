@@ -132,6 +132,35 @@ def test_lint_covers_controller_subsystem_by_construction(tmp_path):
     ]
 
 
+def test_lint_covers_parallel_subsystem_by_construction(tmp_path):
+    """The controller precedent applied to atomo_tpu/parallel/ — the
+    package the delayed-overlap carry grew in (PR-19): the AST walk
+    covers it with no allowlist to forget — a json.dump smuggled next
+    to the carry checkpointing helpers is flagged, and the real package
+    (whose state moves through flax serialization + save_checkpoint,
+    never ad-hoc json) is clean."""
+    mod = _load_checker()
+    pkg = tmp_path / "atomo_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    bad = pkg / "rogue.py"
+    bad.write_text(
+        "import json\n"
+        "def w(train_dir, obj):\n"
+        "    with open(train_dir + '/carry_meta.json', 'w') as f:\n"
+        "        json.dump(obj, f)\n"
+    )
+    out = mod.scan_file(
+        str(bad), os.path.join("atomo_tpu", "parallel", "rogue.py")
+    )
+    assert len(out) == 1 and "write_json_atomic" in out[0]
+    real = os.path.join(_REPO, "atomo_tpu", "parallel")
+    assert os.path.isdir(real)
+    assert not [
+        v for v in mod.collect_violations(_REPO)
+        if "atomo_tpu/parallel" in v
+    ]
+
+
 def test_lint_catches_a_script_train_dir_dump(tmp_path):
     mod = _load_checker()
     bad = tmp_path / "scripts" / "rogue.py"

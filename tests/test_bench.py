@@ -29,7 +29,7 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     assert bench.main() == 0
     assert order == [2, 1, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16,
-                     17, 18, 19]
+                     17, 18, 19, 20]
 
     lines = [
         json.loads(ln)
@@ -42,7 +42,8 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     assert aggs[-1]["configs_complete"] is True
     assert [c["metric"] for c in aggs[-1]["configs"]] == [
         "m1", "m2", "m3", "m4", "m5", "m6", "m7", "m8", "m9", "m10",
-        "m11", "m12", "m13", "m14", "m15", "m16", "m17", "m18", "m19"
+        "m11", "m12", "m13", "m14", "m15", "m16", "m17", "m18", "m19",
+        "m20"
     ]
     # an aggregate exists right after the FIRST config completes
     assert "configs" in lines[1]
@@ -179,7 +180,8 @@ def test_artifact_rows_written_atomically_as_they_complete(
     assert doc["tpu_probe"] == {"ok": False, "skipped": "JAX_PLATFORMS=cpu"}
     assert [r["metric"] for r in doc["rows"]] == [
         "m2", "m1", "m3", "m4", "m5", "m6", "m7", "m8", "m9", "m10",
-        "m11", "m12", "m13", "m14", "m15", "m16", "m17", "m18", "m19"
+        "m11", "m12", "m13", "m14", "m15", "m16", "m17", "m18", "m19",
+        "m20"
     ]
     # atomicity: no torn temp file left behind
     assert not list(tmp_path.glob("*.tmp.*"))
@@ -351,6 +353,26 @@ def test_lm_compressed_dp_wire_config_forces_cpu_mesh(monkeypatch):
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench, "_DEADLINE", bench.time.monotonic() + 900.0)
     row = bench._bench_one(19, no_baseline=True)
+    assert row["measurement_valid"] is True
+    assert len(seen) == 1
+    assert seen[0]["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=4" in seen[0]["XLA_FLAGS"]
+
+
+def test_lm_delayed_overlap_config_forces_cpu_mesh(monkeypatch):
+    """Config 20 (lm_delayed_overlap) rides the same forced-CPU-mesh
+    path as configs 8-19: ONE child, no TPU attempts — the dp2xpp2
+    stale-by-one schedule needs the real 4-device mesh."""
+    seen = []
+
+    def fake_run_child(tail, env, timeout_s=None):
+        seen.append(env)
+        return {"metric": "lm_delayed_overlap", "value": 5.0,
+                "measurement_valid": True, "platform": "cpu"}, ""
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench, "_DEADLINE", bench.time.monotonic() + 900.0)
+    row = bench._bench_one(20, no_baseline=True)
     assert row["measurement_valid"] is True
     assert len(seen) == 1
     assert seen[0]["JAX_PLATFORMS"] == "cpu"

@@ -67,6 +67,11 @@ def test_hierarchical_dense_codec_equals_global_pmean():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+# ~12 s of SVD compiles on 1 core — full-suite only; the hierarchical
+# parity family keeps its tier-1 witnesses in
+# test_hierarchical_dense_codec_equals_global_pmean and
+# test_hierarchical_learns
+@pytest.mark.slow
 def test_hierarchical_svd_replicas_identical_and_bytes_win():
     """SVD over the slow axis: all 8 replicas hold bit-identical params
     after a step (the replicated-PS invariant survives the 2-axis mode),

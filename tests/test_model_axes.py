@@ -22,6 +22,13 @@ Contracts pinned here:
     a tp layout bit-identically to a fresh build from the same host
     values, momentum carried exactly, round-trip exact.
   * RESUME — a recorded decision refuses a model-axis shape mismatch.
+  * DELAYED (ISSUE-19) — ``overlap="delayed"`` threads the stale-by-one
+    carry through the family steps: off-mode lowers byte-identical to
+    the pre-PR path, anchors survive under delayed, the fused step
+    replays the two-program oracle's schedule bit-exact on the
+    replicated-degenerate layout, the candidate grammar emits (and the
+    pricing bubble-credits) ``+delayed`` rows, and a resharded
+    DelayedState resets its carry to the fresh valid=0 value.
 """
 
 import jax
@@ -131,6 +138,16 @@ def test_dp_exchange_validates_aggregate():
     assert DpExchange(aggregate="ring", ring_bucket_size=1024).aggregate
 
 
+def test_dp_exchange_validates_overlap():
+    with pytest.raises(ValueError, match="off | delayed"):
+        DpExchange(overlap="eager")
+    # delayed carries an ENCODED payload; dense psum has none to carry
+    with pytest.raises(ValueError, match="gather.*ring"):
+        DpExchange(aggregate="psum", overlap="delayed")
+    assert DpExchange(aggregate="gather", overlap="delayed").overlap
+    assert DpExchange(aggregate="ring", overlap="delayed").overlap
+
+
 def test_ring_exchange_requires_codec():
     with pytest.raises(ValueError, match="needs a codec"):
         compressed_dp_exchange(
@@ -143,11 +160,15 @@ def test_ring_exchange_requires_codec():
 
 
 def test_model_axis_rejects_name_their_reasons():
+    # overlap_delayed is GONE — the ISSUE-19 lift, delete-not-bypass
     assert set(MODEL_AXIS_REJECTS) == {
-        "hierarchical", "sparse_rows", "quorum", "overlap_delayed",
+        "hierarchical", "sparse_rows", "quorum",
     }
     for reason in MODEL_AXIS_REJECTS.values():
         assert len(reason) > 20  # a statement, not a flag
+    # the quorum reason names the ACTUAL remaining gap, not the old
+    # "no delayed rig" story (the rig exists now)
+    assert "build_model_axis_program" in MODEL_AXIS_REJECTS["quorum"]
 
 
 @pytest.mark.parametrize(
@@ -156,11 +177,28 @@ def test_model_axis_rejects_name_their_reasons():
         ({"aggregate": "hierarchical"}, "hierarchical"),
         ({"sparse_rows": "on"}, "sparse_rows"),
         ({"quorum": 3}, "quorum"),
-        ({"overlap": "delayed"}, "overlap_delayed"),
     ],
 )
 def test_model_axis_conflicts_reject_unproven(cand, key):
     assert model_axis_conflicts(cand) == MODEL_AXIS_REJECTS[key]
+
+
+def test_model_axis_conflicts_delayed_lifted():
+    """Delayed overlap is PROVEN on gather/ring with a codec; the only
+    remaining reject is structural — a dense exchange (psum / no codec)
+    has no encoded payload to carry between steps."""
+    assert model_axis_conflicts(
+        {"aggregate": "gather", "overlap": "delayed", "codec": "qsgd8"}
+    ) is None
+    assert model_axis_conflicts(
+        {"aggregate": "ring", "overlap": "delayed", "codec": "qsgd8"}
+    ) is None
+    for bad in (
+        {"aggregate": "psum", "overlap": "delayed", "codec": "qsgd8"},
+        {"aggregate": "gather", "overlap": "delayed"},
+    ):
+        reason = model_axis_conflicts(bad)
+        assert reason is not None and "payload" in reason
 
 
 def test_model_axis_conflicts_pass_proven():
@@ -187,6 +225,32 @@ def test_lm_axis_candidates_grammar():
         assert r["model_axes"] == {"tp": 2}
     with pytest.raises(ValueError, match="pure data layout"):
         lm_axis_candidates(model_axes={"dp": 4})
+
+
+def test_lm_axis_candidates_emit_delayed():
+    """The ISSUE-19 lift in the candidate grammar: +delayed rows (plain
+    and +se) for the payload-carrying aggregations when a codec is
+    armed — never for psum, never without a codec."""
+    rows = lm_axis_candidates(model_axes={"pp": 2}, codec_tag="qsgd8")
+    names = [r["name"] for r in rows]
+    assert "lm[pp2]+qsgd8+gather+delayed+k1" in names
+    assert "lm[pp2]+qsgd8+gather+delayed+se+k1" in names
+    assert any(
+        "ring" in n and "delayed" in n and "se" not in n for n in names
+    )
+    assert not any("psum" in n and "delayed" in n for n in names)
+    # every emitted row still passes the conflict predicate (asserted
+    # inside the enumerator too — this pins it from the outside)
+    for r in rows:
+        assert model_axis_conflicts(r) is None
+    # no codec -> no payload to carry -> no delayed rows at all
+    dense = lm_axis_candidates(model_axes={"pp": 2}, codec_tag="")
+    assert not any("delayed" in r["name"] for r in dense)
+    # and the knob can be turned off wholesale
+    off = lm_axis_candidates(
+        model_axes={"pp": 2}, codec_tag="qsgd8", allow_overlap=False,
+    )
+    assert not any("delayed" in r["name"] for r in off)
 
 
 # ------------------------------------------------------------ the pricing
@@ -455,6 +519,189 @@ def test_tp_family_stream_encode_parity():
     assert float(m0["loss"]) == float(m1["loss"])
 
 
+# ------------------------------------------ delayed overlap (ISSUE-19)
+#
+# The fill-the-bubble family: the dp exchange consumes the PREVIOUS
+# step's encoded payload while this step's backward runs. Budget
+# discipline: the dp-tp gather anchor drill and the replicated-degenerate
+# (pure-dp) oracle parity drill are the tier-1 witnesses; ring and the
+# dp-pp family ride the slow lane. The dp-pp end-to-end gates (off-HLO
+# byte identity on the pipelined family, equal wire, bit-exact carry
+# resume) run in bench config 20 / bench_smoke check 18.
+
+
+def _delayed(aggregate="gather"):
+    return DpExchange(aggregate=aggregate, overlap="delayed")
+
+
+def test_delayed_off_mode_hlo_byte_identical():
+    """``--overlap off`` is the pre-PR path byte-for-byte: an exchange
+    with the explicit field lowers to exactly the text of one that
+    predates it (no carry threading leaks into the off path). Lower-only
+    — no compile — so this stays a cheap tier-1 gate."""
+    _, plain = _family_program("dp-tp", DpExchange(aggregate="gather"))
+    _, off = _family_program(
+        "dp-tp", DpExchange(aggregate="gather", overlap="off")
+    )
+    toks = plain.shard_tokens(_tokens(1))
+    key = jax.random.PRNGKey(1)
+    assert plain.step.lower(plain.state, key, toks).as_text() == (
+        off.step.lower(off.state, key, toks).as_text()
+    )
+
+
+def test_tp_family_delayed_anchors_and_schedule():
+    """dp-tp gather under delayed: the timeline anchors survive the
+    compiled HLO; step 0 produces but SKIPS the apply (valid=0 carry —
+    params bit-identical, though the counter still ticks); step 1
+    applies the stale payload."""
+    _, prog = _family_program("dp-tp", _delayed())
+    toks = prog.shard_tokens(_tokens(1))
+    txt = prog.step.lower(
+        prog.state, jax.random.PRNGKey(1), toks
+    ).compile().as_text()
+    for anchor in ("encode", "exchange", "decode_mean"):
+        assert anchor in txt, anchor
+
+    assert float(jax.device_get(prog.state.carry.valid)) == 0.0
+    p0 = jax.device_get(prog.state.params)
+    d1, m1 = _run_one(prog)
+    assert float(jax.device_get(d1.carry.valid)) == 1.0
+    assert _leaves_equal(p0, d1.params)  # step-0 apply skipped
+    assert 0.0 < float(m1["msg_bytes"]) < float(m1["dense_bytes"])
+    d2, _ = prog.step(
+        d1, jax.random.PRNGKey(8), prog.shard_tokens(_tokens(8))
+    )
+    assert not _leaves_equal(p0, d2.params)
+
+
+def test_dp_family_delayed_oracle_parity():
+    """Replicated-degenerate bit-parity drill: on the pure-dp layout the
+    fused delayed step replays EXACTLY the two-program oracle's
+    host-driven stale-by-one schedule — produce this step's payload from
+    the PRE-apply params, apply the previous step's (step 0 skips). Full
+    train tree AND carry payload bit-equal after T steps."""
+    T = 3
+    spec = MeshSpec.from_layout("dp", 4, 1)
+    fused = build_model_axis_program(
+        spec, CFG, _opt(), jax.random.PRNGKey(0), CODEC,
+        num_microbatches=2, exchange=_delayed(),
+    )
+    oracle = build_model_axis_program(
+        spec, CFG, _opt(), jax.random.PRNGKey(0), CODEC,
+        num_microbatches=2, exchange=_delayed(), oracle_parts=True,
+    )
+    key = jax.random.PRNGKey(42)
+
+    train = oracle.state.train
+    payload = oracle.state.carry.payload
+    valid = oracle.state.carry.valid
+    for i in range(T):
+        k = jax.random.fold_in(key, i)
+        toks = oracle.shard_tokens(_tokens(100 + i))
+        new_payload, _ = oracle.step["produce"](train, k, toks)
+        train, _ = oracle.step["apply"](train, payload, valid)
+        payload, valid = new_payload, jnp.float32(1.0)
+
+    d = fused.state
+    for i in range(T):
+        k = jax.random.fold_in(key, i)
+        toks = fused.shard_tokens(_tokens(100 + i))
+        d, _ = fused.step(d, k, toks)
+
+    assert _leaves_equal(d.train, train)
+    assert _leaves_equal(d.carry.payload, payload)
+
+
+@pytest.mark.slow
+def test_tp_family_delayed_ring_anchor():
+    """Ring aggregation composes with the delayed carry on dp-tp: the
+    ring scope survives the compiled HLO and the step runs (step-0 skip
+    intact)."""
+    _, prog = _family_program("dp-tp", _delayed("ring"))
+    toks = prog.shard_tokens(_tokens(1))
+    txt = prog.step.lower(
+        prog.state, jax.random.PRNGKey(1), toks
+    ).compile().as_text()
+    assert "ring_exchange_decode" in txt and "encode" in txt
+    p0 = jax.device_get(prog.state.params)
+    d1, _ = _run_one(prog)
+    assert float(jax.device_get(d1.carry.valid)) == 1.0
+    assert _leaves_equal(p0, d1.params)
+
+
+@pytest.mark.slow
+def test_pp_family_delayed_anchors():
+    """The pipelined family — where the bubble the carry fills actually
+    exists — keeps its anchors under delayed, for gather AND ring."""
+    for agg, anchor in (("gather", "decode_mean"),
+                        ("ring", "ring_exchange_decode")):
+        _, prog = _family_program("dp-pp", _delayed(agg))
+        toks = prog.shard_tokens(_tokens(1))
+        txt = prog.step.lower(
+            prog.state, jax.random.PRNGKey(1), toks
+        ).compile().as_text()
+        assert "encode" in txt and anchor in txt, agg
+        d1, _ = _run_one(prog)
+        assert float(jax.device_get(d1.carry.valid)) == 1.0, agg
+
+
+def test_overlap_report_credits_bubble_under_delayed():
+    """The pricing half of the lift: under delayed the pipeline bubble
+    is ALSO hiding budget — exposed = max(0, comm - compute - bubble) —
+    and the report names the credited slice (bubble_hidden_ms)."""
+    kw = dict(dense_bytes=4e6, payload_bytes=1e6, ways=4, fabric_bw=1e9)
+    rep = overlap_report(
+        compute_s=0.0005, pipeline_stages=4, pipeline_microbatches=2,
+        **kw,
+    )
+    bubble = pipeline_bubble_s(0.0005, 4, 2)
+    comm = rep["comm_chain_ms"] / 1e3
+    exposed = max(0.0, comm - 0.0005)
+    assert rep["bubble_hidden_ms"] == pytest.approx(
+        min(exposed, bubble) * 1e3, abs=2e-3
+    )
+    assert rep["bubble_hidden_ms"] > 0.0
+    # exposed_ms keeps its compute-only meaning; only delayed_step_ms
+    # takes the bubble credit
+    assert rep["exposed_ms"] == pytest.approx(exposed * 1e3, abs=2e-3)
+    want_exposed = max(0.0, comm - 0.0005 - bubble)
+    assert rep["delayed_step_ms"] == pytest.approx(
+        (0.0005 + want_exposed + bubble) * 1e3
+        + rep["encode_exposed_ms"],
+        abs=2e-3,
+    )
+    flat = overlap_report(compute_s=0.0005, **kw)
+    assert flat["bubble_hidden_ms"] == 0.0
+
+
+def test_predict_step_s_credits_bubble_for_delayed():
+    """A delayed candidate's predicted step hides its exchange behind
+    compute PLUS the pipeline bubble: with a bubble big enough to
+    swallow the whole chain, adding it costs LESS than its floor (the
+    exchange it ate), and the floor itself is never waived."""
+    kw = dict(
+        dense_bytes=4e6, payload_bytes=4e6, ways=4, fabric_bw=1e9,
+        compute_s=0.001,
+    )
+    cand = {
+        "aggregate": "gather", "overlap": "delayed", "superstep": 1,
+        "model_axes": {"pp": 2}, "pipeline_bubble_s": 0.1,
+    }
+    with_bubble = predict_step_s(cand, **kw)
+    no_bubble = predict_step_s(dict(cand, pipeline_bubble_s=0.0), **kw)
+    # the 4 MB gather chain (~12 ms) dwarfs the 1 ms compute, so without
+    # the bubble most of it is exposed; the 100 ms bubble hides ALL of
+    # it — the delta is strictly less than the 100 ms floor
+    assert with_bubble - no_bubble < 0.1
+    assert with_bubble >= 0.001 + 0.1  # the bubble floor is still paid
+    # a blocking candidate with the same bubble pays the full chain
+    blocking = predict_step_s(
+        dict(cand, overlap="off"), **kw
+    )
+    assert blocking > with_bubble
+
+
 # --------------------------------------------------------------- reshard
 
 
@@ -532,3 +779,40 @@ def test_reshard_rejects_layout_owned_trees():
         reshard_model_axes(
             prog.state, spec_dp, MeshSpec.from_layout("dp-ep", 4, 2), CFG
         )
+
+
+def test_reshard_delayed_state_resets_carry():
+    """Resharding a DelayedState: the TRAIN half rides the param
+    bijection exactly as a bare TrainState would, and the carry RESETS
+    to the fresh valid=0 value on the new layout (the old payload shards
+    are the OLD layout's local slices — no bijection exists). Needs the
+    run's codec to shape the fresh zero payload; refuses without it."""
+    from atomo_tpu.parallel.replicated import DelayedState
+
+    spec_dp = MeshSpec.from_layout("dp", 4)
+    spec_tp = MeshSpec.from_layout("dp-tp", 4, 2)
+    prog = build_model_axis_program(
+        spec_dp, CFG, _opt(), jax.random.PRNGKey(0), CODEC,
+        exchange=_delayed(),
+    )
+    assert isinstance(prog.state, DelayedState)
+    with pytest.raises(ValueError, match="needs the run's codec"):
+        reshard_model_axes(prog.state, spec_dp, spec_tp, CFG)
+
+    mesh, got, specs = reshard_model_axes(
+        prog.state, spec_dp, spec_tp, CFG, codec=CODEC
+    )
+    assert isinstance(got, DelayedState)
+    assert float(jax.device_get(got.carry.valid)) == 0.0
+    # the train half matches a bare-TrainState reshard bit-for-bit
+    _, want, _ = reshard_model_axes(
+        jax.device_get(prog.state.train), spec_dp, spec_tp, CFG
+    )
+    assert _leaves_equal(got.train, want)
+    # the fresh carry's payload shapes come from the NEW layout's local
+    # shards: identical to a fresh dp-tp delayed build's carry
+    fresh = build_model_axis_program(
+        spec_tp, CFG, _opt(), jax.random.PRNGKey(0), CODEC,
+        exchange=_delayed(),
+    )
+    assert _leaves_equal(got.carry, fresh.state.carry)

@@ -252,10 +252,10 @@ def _plan_parity(codec, pname, n_outer=2, n_inner=2):
 @pytest.mark.parametrize(
     "cname,pname",
     [("qsgd", p) for p in PLAN_NAMES]
-    + [("svd", "cring+gather"), ("svd", "psum+ring")]
+    + [("svd", "psum+ring")]
     + [
         pytest.param("svd", p, marks=pytest.mark.slow)
-        for p in ("psum+gather", "cring+ring", "cring+psum")
+        for p in ("cring+gather", "psum+gather", "cring+ring", "cring+psum")
     ],
 )
 def test_planned_operator_bit_identical_to_canonical(cname, pname):
