@@ -404,17 +404,33 @@ def _add_fit_args(parser: argparse.ArgumentParser) -> None:
                         "guard-masked replica as an unbiased "
                         "survivors-only mean (needs --grad-guard), and at "
                         "the next checkpoint boundary SHRINK the world to "
-                        "the surviving roster — exit code 29 tells the "
-                        "--max-restarts supervisor to re-exec with "
-                        "--n-devices N-1 (a planned reshape, never "
-                        "charged against the restart budget) and "
-                        "re-shard the data stream deterministically. "
+                        "the surviving roster — by default LIVE, in "
+                        "process (state/mesh/step program reshaped at "
+                        "the boundary, no exit; see --elastic-reshard); "
+                        "when the loop cannot reshape in place, exit "
+                        "code 29 tells the --max-restarts supervisor to "
+                        "re-exec with --n-devices N-1 (a planned "
+                        "reshape, never charged against the restart "
+                        "budget) and re-shard the data stream "
+                        "deterministically. "
                         "Bit-exact per membership epoch: the shrunken leg "
                         "matches a fresh --n-devices N-1 run resumed "
                         "from the same checkpoint (tested). Flat "
                         "gather/ring/psum meshes only; conflicts with "
                         "--zero1, --overlap delayed, --aggregate "
                         "hierarchical, --phase-metrics")
+    t.add_argument("--elastic-reshard", choices=("live", "reexec"),
+                   default="live",
+                   help="how a committed membership epoch reshapes the "
+                        "run. live (default): re-place the replicated "
+                        "state on the new-world mesh in process "
+                        "(mesh.reshard.reshard_replicated) — zero "
+                        "downtime, bit-exact vs a fresh new-world build "
+                        "resumed from the boundary checkpoint; re-exec "
+                        "(rc=29) remains the RECORDED fallback "
+                        "(reshard_fallback incident quotes why). "
+                        "reexec: always exit rc=29 and let the "
+                        "supervisor relaunch (the historical path)")
     t.add_argument("--elastic-patience", type=int, default=6, metavar="N",
                    help="consecutive guard-masked steps before a replica "
                         "is declared absent (one masked step is a "
@@ -891,10 +907,13 @@ def _argv_preflight(args: argparse.Namespace) -> None:
             )
         if getattr(args, "elastic", False):
             raise SystemExit(
-                "--elastic runs the replicated update for now (a "
-                "membership reshape re-shards live state via "
-                "mesh.reshard, which the elastic loop does not drive "
-                "yet); drop --partition sharded-update"
+                "--elastic runs the replicated update for now (the live "
+                "reshape path, mesh.reshard.reshard_replicated, moves "
+                "the replicated layout; the sharded-update master "
+                "shards are world-shaped — "
+                "mesh.reshard.reshard_sharded_update exists but the "
+                "elastic loop does not drive it); drop --partition "
+                "sharded-update"
             )
         if args.on_diverge != "off":
             raise SystemExit(
@@ -1795,6 +1814,12 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
         # quorum = everyone who is NOT persistently slowed (floor 1):
         # the Q that absorbs exactly the injected stragglers
         quorum_q = max(1, n_dev - slowed)
+    from atomo_tpu.fleet.control import current_roster_hash as _frh
+
+    # stamped into every new decision artifact (and checked on resume):
+    # the host roster the decision was produced under — device count and
+    # mesh shape cannot tell two swapped hosts apart
+    fleet_hash = _frh(args.train_dir)
     doc = None
     if args.resume:
         # a resumed run (including a supervised restart's appended
@@ -1839,6 +1864,7 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             except (OSError, ValueError):
                 prior = None
             check = decision_reusable
+        from atomo_tpu.fleet.control import current_roster_hash
         from atomo_tpu.mesh import MeshSpec
 
         reusable, why = check(
@@ -1847,6 +1873,10 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             # the chaos-derived Q this run would explore (staleness=None:
             # K was the recorded ladder's pick, any value is consistent)
             quorum=quorum_q if allow_quorum else None,
+            # the host-roster fingerprint: a replaced/swapped host keeps
+            # n_devices AND mesh_axes identical — only the fleet record
+            # (hosts/ leases, host-granularity membership epochs) sees it
+            fleet_roster=current_roster_hash(args.train_dir),
         )
         if reusable:
             doc = prior
@@ -1936,6 +1966,10 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
                 context={
                     "network": args.network, "dataset": args.dataset,
                     "code": args.code, "seed": args.seed,
+                    **(
+                        {"fleet_roster_hash": fleet_hash}
+                        if fleet_hash else {}
+                    ),
                 },
             )
         doc = doc if doc is not None else tune(
@@ -2018,6 +2052,10 @@ def _run_autopilot(args, model, optimizer, codec, train_iter, n_dev,
             context={
                 "network": args.network, "dataset": args.dataset,
                 "code": args.code, "seed": args.seed,
+                **(
+                    {"fleet_roster_hash": fleet_hash}
+                    if fleet_hash else {}
+                ),
             },
         )
     except ValueError as exc:  # unresolvable --fabric
@@ -2723,6 +2761,7 @@ def cmd_train(args: argparse.Namespace) -> int:
         elastic_cfg = ElasticConfig(
             patience=args.elastic_patience,
             readmit_at=args.readmit_at,
+            reshard=getattr(args, "elastic_reshard", "live"),
         )
     quorum_cfg = None
     if _quorum_q(args) is not None:
@@ -3674,6 +3713,23 @@ def cmd_report(args: argparse.Namespace) -> int:
         raise SystemExit(
             f"report: train dir {args.train_dir!r} does not exist"
         )
+    if getattr(args, "fleet", False):
+        from atomo_tpu.obs.report import (
+            build_fleet_report,
+            fleet_report_path,
+            summarize_fleet_report,
+        )
+
+        doc = build_fleet_report(args.train_dir)
+        write_json_atomic(fleet_report_path(args.train_dir), doc)
+        print(summarize_fleet_report(doc), flush=True)
+        print(
+            f"fleet report -> {fleet_report_path(args.train_dir)}",
+            flush=True,
+        )
+        if args.strict and not doc["consistent"]:
+            return 3
+        return 0
     doc = build_report(args.train_dir)
     write_json_atomic(report_path(args.train_dir), doc)
     print(summarize_report(doc), flush=True)
@@ -3867,6 +3923,14 @@ def build_parser() -> argparse.ArgumentParser:
                             "trace directory a training run captured "
                             "with --profile-dir (default: "
                             "train-dir/trace)")
+    p_rep.add_argument("--fleet", action="store_true", default=False,
+                       help="build the FLEET report instead: glob every "
+                            "per-host lease/metrics/incident stream "
+                            "under train-dir/hosts/ plus the shared "
+                            "membership.json into one timeline "
+                            "(fleet_report.json) with cross-host checks "
+                            "(fleet_membership_consistent, "
+                            "fleet_lease_gap_explained)")
     p_rep.add_argument("--strict", action="store_true", default=False,
                        help="exit rc=3 when a consistency check fails "
                             "(default: report and exit 0 — the report "

@@ -60,6 +60,7 @@ def controller_reusable(
     mesh_axes: Optional[dict] = None,
     quorum: Optional[int] = None,
     staleness: Optional[int] = None,
+    fleet_roster: Optional[str] = None,
 ) -> tuple:
     """Can a ``--resume`` reuse this recorded controller decision?
 
@@ -78,6 +79,7 @@ def controller_reusable(
     ok, reason = decision_reusable(
         doc, n_dev=n_dev, mesh_axes=mesh_axes,
         quorum=quorum, staleness=staleness,
+        fleet_roster=fleet_roster,
     )
     if not ok:
         return ok, reason

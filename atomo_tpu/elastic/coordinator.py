@@ -19,14 +19,23 @@ One :class:`ElasticCoordinator` per train loop. Its life cycle:
              at every periodic checkpoint boundary: if members are
              persistently absent and the shrink is viable (the global
              batch must divide the smaller world — an unviable shrink is
-             recorded and the member stays carried), append the next
-             epoch's record + incident and raise
-             :class:`~atomo_tpu.elastic.membership.MembershipChange`;
-             else if the run is below full strength and ``readmit_at``
-             has passed, append a grow epoch back to the FULL roster and
-             raise the same way. The exception reaches the CLI, which
-             exits MEMBERSHIP_EXIT_CODE; the supervisor re-execs at the
-             new world size without charging the crash budget.
+             recorded and the member stays carried), commit the next
+             epoch; else if the run is below full strength and
+             ``readmit_at`` has passed, commit a grow epoch back to the
+             FULL roster. HOW a committed epoch reshapes the run is the
+             ``reshard`` mode: under ``reshard="live"`` the loop's
+             ``live`` callback re-slices the in-process state onto the
+             new mesh (params + momentum carried exactly — a data
+             movement, not a process death) and training continues in
+             the same process; when the live path is not viable (the
+             callback refuses, or no callback is wired) a
+             ``reshard_fallback`` incident records WHY and the epoch
+             record + incident land exactly as under
+             ``reshard="reexec"``: raise
+             :class:`~atomo_tpu.elastic.membership.MembershipChange`,
+             which the CLI turns into MEMBERSHIP_EXIT_CODE; the
+             supervisor re-execs at the new world size without charging
+             the crash budget.
 
 Re-grow (layer 3) is deliberately boundary-triggered, not mid-step: the
 re-admitted member starts from the newest checkpoint with the shard map
@@ -58,6 +67,18 @@ class ElasticConfig:
                 the full roster at the next checkpoint boundary (0 = no
                 automatic re-admission; re-grow by relaunching with the
                 full ``--n-devices`` by hand).
+    reshard:    "live" | "reexec" — how a membership transition reshapes
+                the run. "live" re-slices the in-process state onto the
+                new mesh at the boundary (no exit, no re-exec, no
+                checkpoint round-trip) and falls back to the rc=29
+                re-exec protocol with a recorded ``reshard_fallback``
+                incident whenever the in-process path is not viable
+                (layout-owned state, mesh shape not buildable, fused
+                superstep block). "reexec" is the PR-9 protocol
+                unchanged. The dataclass default stays "reexec" so
+                direct constructions keep their historical behavior;
+                the CLI's ``--elastic-reshard`` flag defaults to live —
+                the primary path.
     max_regrows: lifetime cap on AUTOMATIC re-admissions (counted as
                 ``grow`` epochs in membership.json, so it survives
                 restarts). A genuinely still-dead host would otherwise
@@ -72,8 +93,14 @@ class ElasticConfig:
     patience: int = 6
     readmit_at: int = 0
     max_regrows: int = 1
+    reshard: str = "reexec"
 
     def __post_init__(self):
+        if self.reshard not in ("live", "reexec"):
+            raise ValueError(
+                f"elastic reshard mode must be 'live' or 'reexec', "
+                f"got {self.reshard!r}"
+            )
         if self.patience < 1:
             raise ValueError(
                 f"elastic patience must be >= 1, got {self.patience}"
@@ -286,12 +313,15 @@ class ElasticCoordinator:
         once, re-slices, continues the same optimizer trajectory).
         Returns ``(new_mesh, new_state, new_specs)``.
 
-        This is the forward path for in-process reshapes; the
-        exit-and-re-exec protocol (:class:`MembershipChange` -> rc=29 ->
-        supervisor relaunch) REMAINS the fallback and the default wiring
-        — it is the only correct move when the dead replica took its
-        host process down, and the elastic loop currently runs the
-        replicated update. Drilled directly in tests/test_mesh.py."""
+        This is the sharded-update flavor of the in-process reshape;
+        the elastic train loop's replicated flavor is
+        :func:`atomo_tpu.mesh.reshard.reshard_replicated`, driven at
+        membership boundaries by :meth:`maybe_transition` under
+        ``reshard="live"``. The exit-and-re-exec protocol
+        (:class:`MembershipChange` -> rc=29 -> supervisor relaunch) is
+        the recorded FALLBACK — the only correct move when the dead
+        replica took its host process down. Drilled directly in
+        tests/test_mesh.py."""
         from atomo_tpu.mesh.reshard import reshard_sharded_update
 
         new_mesh = self.reshard_spec(new_world).build()
@@ -300,11 +330,80 @@ class ElasticCoordinator:
         )
         return new_mesh, new_state, new_specs
 
-    def maybe_transition(self, step: int) -> None:
+    def _commit_live(self, rec: MembershipEpoch) -> None:
+        """Internal reset after a successful IN-PROCESS reshape: this
+        coordinator now governs the new world — same fields a re-exec'd
+        child would construct fresh, minus the process death. The
+        absence tracker restarts empty (mesh slots renumbered) and the
+        one-shot carry guard re-arms (a later unviable shrink in the
+        new epoch deserves its own incident)."""
+        self.n_dev = rec.world_size
+        self.epoch = rec
+        self.tracker = AbsenceTracker(self.n_dev, self.cfg.patience)
+        self.pending_dead.clear()
+        self._carry_logged = False
+
+    def _commit(self, kind: str, rec: MembershipEpoch, live, **incident_kw):
+        """Make a due transition durable and reshape the run.
+
+        Under ``reshard="live"`` with a wired ``live`` callback, try the
+        in-process path first: the callback attempts the reshape and
+        returns ``(ok, why)``. On ok the epoch record + incident land
+        (tagged ``reshard="live"``) and the loop continues in-process —
+        no exception, no exit. On refusal a ``reshard_fallback``
+        incident records exactly why the live path was not taken, and
+        the re-exec protocol proceeds unchanged. Re-exec mode (or no
+        callback under live mode — e.g. a loop that never wired one)
+        goes straight to the protocol: append, incident, raise."""
+        live_mode = self.cfg.reshard == "live"
+        if live_mode and live is not None:
+            ok, why = live(kind, rec)
+            if ok:
+                self.log.append(rec)
+                self._incident(kind, rec, reshard="live", **incident_kw)
+                self.log_fn(
+                    f"Elastic: LIVE {kind} {self.n_dev} -> "
+                    f"{rec.world_size} at checkpoint step "
+                    f"{rec.start_step} (membership epoch {rec.epoch}) — "
+                    "state re-sliced in-process, no re-exec"
+                )
+                self._commit_live(rec)
+                return
+        else:
+            why = (
+                "re-exec mode configured (--elastic-reshard reexec)"
+                if not live_mode
+                else "no live reshard path wired into this loop"
+            )
+        if live_mode and self.incidents is not None:
+            # the acceptance bar: re-exec only ever happens WITH a
+            # recorded reason under live mode
+            self.incidents.append(
+                "membership",
+                action="reshard_fallback",
+                step=rec.start_step,
+                epoch=rec.epoch,
+                world=rec.world_size,
+                reason=why,
+            )
+        if live_mode:
+            self.log_fn(
+                f"Elastic: live reshard not taken ({why}); falling back "
+                "to the re-exec protocol"
+            )
+        self.log.append(rec)
+        self._incident(kind, rec, **incident_kw)
+        raise MembershipChange(kind, rec)
+
+    def maybe_transition(self, step: int, live=None) -> None:
         """Call at every periodic checkpoint boundary (AFTER the save
-        landed — the next epoch resumes from it). Raises
-        :class:`MembershipChange` when a transition is due; plain return
-        otherwise."""
+        landed — the next epoch resumes from it). ``live`` is the
+        loop's in-process reshape callback ``(kind, rec) -> (ok, why)``
+        (used only under ``reshard="live"``): on ok the loop has
+        already re-sliced its state/mesh/program for ``rec`` and this
+        method returns normally; otherwise raises
+        :class:`MembershipChange` when a transition is due; plain
+        return when none is."""
         if self.epoch is None or (self.max_steps and step >= self.max_steps):
             return
         if self.pending_dead:
@@ -357,18 +456,18 @@ class ElasticCoordinator:
                 dead=tuple(dead_members),
                 shard_map=self._shard_map(step, new_world, self._rng_crc),
             )
-            self.log.append(rec)
-            self._incident(
-                "shrink", rec, dead=dead_members, from_world=self.n_dev,
-                mesh_axes=self.reshard_spec(new_world).shape_dict(),
-            )
             self.log_fn(
                 f"Elastic: shrinking {self.n_dev} -> {new_world} at "
                 f"checkpoint step {step} (member(s) {dead_members} left; "
                 f"membership epoch {rec.epoch}); data stream re-shards "
                 "deterministically over the surviving roster"
             )
-            raise MembershipChange("shrink", rec)
+            self._commit(
+                "shrink", rec, live,
+                dead=dead_members, from_world=self.n_dev,
+                mesh_axes=self.reshard_spec(new_world).shape_dict(),
+            )
+            return
         if (
             self.cfg.readmit_at
             and step >= self.cfg.readmit_at
@@ -406,15 +505,14 @@ class ElasticCoordinator:
                 reason="grow",
                 shard_map=self._shard_map(step, full, self._rng_crc),
             )
-            self.log.append(rec)
-            self._incident(
-                "grow", rec, from_world=self.n_dev,
-                mesh_axes=self.reshard_spec(full).shape_dict(),
-            )
             self.log_fn(
                 f"Elastic: re-admitting to the full roster "
                 f"({self.n_dev} -> {full}) at checkpoint step {step} "
-                f"(membership epoch {rec.epoch}); restart resumes from "
-                "the newest checkpoint with the shard map re-derived"
+                f"(membership epoch {rec.epoch}); the shard map is "
+                "re-derived over the full roster"
             )
-            raise MembershipChange("grow", rec)
+            self._commit(
+                "grow", rec, live,
+                from_world=self.n_dev,
+                mesh_axes=self.reshard_spec(full).shape_dict(),
+            )

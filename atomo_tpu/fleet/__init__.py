@@ -1,0 +1,34 @@
+"""Fleet control plane: host-level leases over ``train_dir`` folded
+into the SAME ``membership.json`` epoch history the elastic subsystem
+owns — see :mod:`atomo_tpu.fleet.control` (protocol) and
+:mod:`atomo_tpu.fleet.launcher` (multi-process formation + drill)."""
+
+from atomo_tpu.fleet.control import (
+    FleetConfig,
+    FleetController,
+    HostLease,
+    LeaseTracker,
+    fold_leases,
+    host_incidents_path,
+    host_metrics_path,
+    hosts_dir,
+    lease_path,
+    read_leases,
+    roster_hash,
+    write_lease,
+)
+
+__all__ = [
+    "FleetConfig",
+    "FleetController",
+    "HostLease",
+    "LeaseTracker",
+    "fold_leases",
+    "host_incidents_path",
+    "host_metrics_path",
+    "hosts_dir",
+    "lease_path",
+    "read_leases",
+    "roster_hash",
+    "write_lease",
+]

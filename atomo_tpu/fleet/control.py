@@ -1,0 +1,603 @@
+"""Host-level control plane — leases over ``train_dir``, folded into
+membership epochs.
+
+The elastic subsystem (PR 9) detects a dead *replica* from inside the
+compiled step (the guard's ok_bits) — which requires the process to be
+alive and stepping. A production fleet loses *hosts*: the process is
+gone, or partitioned off the network, and nothing in-graph will ever
+report it. This module is the out-of-band half: every host maintains a
+small lease file under ``train_dir/hosts/`` and observes everyone
+else's; a pure transition function (:func:`fold_leases`) turns "whose
+lease stopped advancing" into the next :class:`MembershipEpoch` — the
+SAME epoch math as ``elastic/membership.py``, at host granularity, in
+the same ``membership.json``.
+
+Design rules, each load-bearing:
+
+  * **Leases are monotonic counters, not timestamps.** A lease is stale
+    when its ``beat`` counter has not advanced for ``patience``
+    *observer rounds* — never when its wall-clock ``ts`` looks old.
+    Two hosts with skewed clocks must not mutually evict each other;
+    ``ts`` is recorded for the post-mortem reader only and nothing
+    decides on it (drilled with forged timestamps in
+    tests/test_fleet.py).
+  * **One writer.** Only the acting *leader* — the lowest-id host whose
+    own lease is live — appends to ``membership.json``. Everyone else
+    reconciles FROM disk each round (:meth:`FleetController.reconcile`),
+    including a healed host discovering it was shrunk out while
+    partitioned: it stands down, keeps beating, and the leader
+    re-admits it under the existing ``max_regrows`` cap.
+  * **Store colocation is the fence.** ``train_dir`` lives with the
+    lowest-id host, so a partitioned host loses the *store*, not just
+    its peers: it can neither beat nor read the epoch record, which is
+    exactly what makes the leader's shrink decision safe (no
+    split-brain writer on the far side).
+  * **Same artifact discipline as everything else**: leases via
+    :func:`~atomo_tpu.utils.tracing.write_json_atomic` (readers never
+    see a torn file), per-host incident/metric streams as append-only
+    JSONL read back with the tolerant :func:`read_jsonl`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+from atomo_tpu.elastic.membership import (
+    MembershipEpoch,
+    MembershipLog,
+)
+from atomo_tpu.utils.tracing import (
+    IncidentLog,
+    read_jsonl,
+    write_json_atomic,
+)
+
+HOSTS_DIR_NAME = "hosts"
+
+
+def hosts_dir(train_dir: str) -> str:
+    return os.path.join(train_dir, HOSTS_DIR_NAME)
+
+
+def lease_path(train_dir: str, host_id: int) -> str:
+    """``train_dir/hosts/<id>.json`` — one lease file per host."""
+    return os.path.join(hosts_dir(train_dir), f"{int(host_id)}.json")
+
+
+def host_metrics_path(train_dir: str, host_id: int) -> str:
+    return os.path.join(hosts_dir(train_dir), f"{int(host_id)}.metrics.jsonl")
+
+
+def host_incidents_path(train_dir: str, host_id: int) -> str:
+    return os.path.join(
+        hosts_dir(train_dir), f"{int(host_id)}.incidents.jsonl"
+    )
+
+
+def current_roster_hash(train_dir: Optional[str]) -> Optional[str]:
+    """The fleet roster hash this ``train_dir`` currently implies: the
+    newest HOST-granularity membership epoch's roster, falling back to
+    the set of lease files under ``hosts/``. None when the run carries
+    no fleet evidence at all (single-host, pre-fleet) — the resume
+    gate (``decision_reusable``) treats None as "no roster to check",
+    never as a mismatch."""
+    if not train_dir:
+        return None
+    try:
+        log = MembershipLog.load(train_dir)
+    except Exception:  # noqa: BLE001 — torn store reads as no evidence
+        return None
+    for rec in reversed(log.epochs):
+        if (rec.detail or {}).get("granularity") == "host":
+            return roster_hash(rec.roster)
+    leases = read_leases(train_dir)
+    if leases:
+        return roster_hash(leases.keys())
+    return None
+
+
+def roster_hash(roster) -> str:
+    """Order-insensitive fingerprint of a host roster — the resume gate's
+    identity check (``decision_reusable``): a tuned decision carries the
+    roster hash it was produced under, and a resume on a *different*
+    roster at the SAME device count (two swapped hosts, one replaced
+    machine) must refuse reuse out loud — data placement and stream
+    splits are roster-order facts the device count alone cannot see."""
+    ids = sorted(int(h) for h in roster)
+    return hashlib.sha256(json.dumps(ids).encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass(frozen=True)
+class HostLease:
+    """One host's lease — the liveness claim, renewed every round.
+
+    beat:  the MONOTONIC renewal counter; staleness is "this number
+           stopped advancing", decided by the observer's own round
+           count (:class:`LeaseTracker`), never by comparing clocks.
+    epoch: the membership epoch this host believes is current — the
+           fleet report's consistency check reads it back.
+    step:  trainer step at renewal (diagnostic context).
+    ts:    wall-clock seconds at renewal — POST-MORTEM CONTEXT ONLY;
+           no liveness decision reads it (two hosts with skewed clocks
+           must not mutually evict each other).
+    """
+
+    host_id: int
+    beat: int
+    epoch: int = 0
+    step: int = 0
+    pid: int = 0
+    ts: float = 0.0
+    detail: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "host_id": int(self.host_id),
+            "beat": int(self.beat),
+            "epoch": int(self.epoch),
+            "step": int(self.step),
+            "pid": int(self.pid),
+            "ts": round(float(self.ts), 3),
+            "detail": dict(self.detail),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HostLease":
+        return cls(
+            host_id=int(d["host_id"]),
+            beat=int(d["beat"]),
+            epoch=int(d.get("epoch", 0)),
+            step=int(d.get("step", 0)),
+            pid=int(d.get("pid", 0)),
+            ts=float(d.get("ts", 0.0)),
+            detail=dict(d.get("detail", {})),
+        )
+
+
+def write_lease(train_dir: str, lease: HostLease) -> str:
+    path = lease_path(train_dir, lease.host_id)
+    write_json_atomic(path, lease.to_dict())
+    return path
+
+
+def read_leases(train_dir: str) -> dict[int, HostLease]:
+    """All readable leases under ``train_dir/hosts/``. A torn or
+    garbage file is SKIPPED, not fatal — the file's absence from the
+    result is indistinguishable from a missing beat, which is exactly
+    the staleness path the tracker already handles (the read_jsonl
+    precedent: the artifact layer must survive the failures it
+    documents)."""
+    d = hosts_dir(train_dir)
+    out: dict[int, HostLease] = {}
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".json") or name.count(".") != 1:
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                lease = HostLease.from_dict(json.load(f))
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+        out[lease.host_id] = lease
+    return out
+
+
+class LeaseTracker:
+    """Monotonic lease-expiry: a host is STALE when its ``beat`` counter
+    has not advanced for ``patience`` consecutive *observer rounds*.
+
+    The tracker never reads a lease's wall-clock ``ts`` — expiry is a
+    relation between the writer's own counter and the observer's own
+    round count, so arbitrarily skewed host clocks cannot cause mutual
+    eviction (satellite: drilled with forged timestamps). A host whose
+    lease file disappears (or tears) simply stops advancing, which is
+    the same staleness path.
+    """
+
+    def __init__(self, patience: int):
+        if patience < 1:
+            raise ValueError(f"lease patience must be >= 1, got {patience}")
+        self.patience = int(patience)
+        self._beats: dict[int, int] = {}
+        self._idle: dict[int, int] = {}
+
+    def observe(self, leases: dict[int, "HostLease"], expected=()) -> set[int]:
+        """Fold one observer round; returns every host currently stale.
+        ``leases`` maps host id -> lease (a missing entry counts as a
+        non-advancing beat for hosts seen before). ``expected`` hosts
+        that have NEVER written a lease accrue idle rounds too — a
+        member that is slow to form gets the same patience grace as one
+        that stopped beating, instead of being evicted at round 1 (the
+        formation race)."""
+        for h, lease in leases.items():
+            if self._beats.get(h) != lease.beat:
+                self._beats[h] = lease.beat
+                self._idle[h] = 0
+            else:
+                self._idle[h] = self._idle.get(h, 0) + 1
+        for h in self._beats:
+            if h not in leases:
+                self._idle[h] = self._idle.get(h, 0) + 1
+        for h in expected:
+            if h not in self._beats and h not in leases:
+                self._idle[h] = self._idle.get(h, 0) + 1
+        return self.stale()
+
+    def stale(self) -> set[int]:
+        return {h for h, n in self._idle.items() if n >= self.patience}
+
+    def seen(self) -> set[int]:
+        return set(self._beats)
+
+    def alive(self) -> set[int]:
+        """Hosts with a lease seen at least once and not stale."""
+        return self.seen() - self.stale()
+
+
+def fold_leases(
+    current: MembershipEpoch,
+    alive: set[int],
+    *,
+    step: int,
+    full_roster,
+    grows: int,
+    max_regrows: int,
+    detail: Optional[dict] = None,
+) -> tuple[Optional[MembershipEpoch], Optional[str]]:
+    """The PURE transition function: fold the live-host set into the
+    next host-granularity :class:`MembershipEpoch`, or explain why not.
+
+    Same epoch math as the replica-level coordinator, with the host-
+    level viability rule: one surviving host is a valid fleet (it still
+    holds a full local mesh), where one surviving *replica* is not a
+    multi-device mesh. Returns ``(record, why)`` — record None means no
+    transition; ``why`` (when not None) is the human reason a wanted
+    transition was refused (carried dead members, spent re-grow budget).
+    """
+    roster = set(current.roster)
+    dead = sorted(roster - set(alive))
+    if dead:
+        survivors = tuple(sorted(roster - set(dead)))
+        if not survivors:
+            return None, "no surviving hosts to form a roster"
+        rec = MembershipEpoch(
+            epoch=current.epoch + 1,
+            world_size=len(survivors),
+            roster=survivors,
+            start_step=int(step),
+            reason="shrink",
+            dead=tuple(dead),
+            shard_map={"kind": "host-lease", "skip": int(step)},
+            detail=dict(detail or {}),
+        )
+        return rec, None
+    returned = sorted((set(alive) & set(full_roster)) - roster)
+    if returned and len(roster) < len(full_roster):
+        if grows >= max_regrows:
+            return None, (
+                f"host(s) {returned} are beating again but the "
+                f"re-admission budget is spent ({grows} grow epoch(s) "
+                f"recorded, max_regrows={max_regrows})"
+            )
+        new_roster = tuple(sorted(roster | set(returned)))
+        rec = MembershipEpoch(
+            epoch=current.epoch + 1,
+            world_size=len(new_roster),
+            roster=new_roster,
+            start_step=int(step),
+            reason="grow",
+            shard_map={"kind": "host-lease", "skip": int(step)},
+            detail=dict(detail or {}),
+        )
+        return rec, None
+    return None, None
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Fleet control-plane knobs.
+
+    patience:    observer rounds without a beat advance before a lease
+                 is stale (the host-level analogue of the elastic
+                 ``--elastic-patience`` masked-step count).
+    period_s:    seconds between heartbeat rounds (the drill uses tens
+                 of milliseconds; production would use seconds).
+    max_regrows: lifetime cap on automatic re-admissions, counted as
+                 ``grow`` epochs in membership.json exactly like the
+                 replica-level coordinator's cap — a flapping host must
+                 not shrink/grow the fleet forever.
+    devices_per_host: recorded in every epoch's detail so the device-
+                 level world implied by a host roster is on disk.
+    init_timeout_s: bound (seconds) on each collective handshake AND on
+                 the shutdown barrier during a re-form. jax's shutdown
+                 is a cluster-wide barrier: waiting on a peer that will
+                 never arrive must fail into a recorded incident, not
+                 wedge the lease loop (launcher.py).
+    """
+
+    patience: int = 3
+    period_s: float = 0.05
+    max_regrows: int = 1
+    devices_per_host: int = 1
+    init_timeout_s: float = 15.0
+
+    def __post_init__(self):
+        if self.patience < 1:
+            raise ValueError(
+                f"fleet patience must be >= 1, got {self.patience}"
+            )
+        if self.period_s <= 0:
+            raise ValueError(
+                f"fleet period must be > 0 s, got {self.period_s}"
+            )
+        if self.max_regrows < 0:
+            raise ValueError(
+                f"max_regrows must be >= 0, got {self.max_regrows}"
+            )
+
+
+class FleetController:
+    """One host's view of the fleet: renew my lease, observe everyone
+    else's, and — when I am the acting leader — fold staleness into the
+    next membership epoch.
+
+    Leadership is positional, not elected: the lowest-id host in the
+    current ALIVE set acts; everyone else only reads. Because the store
+    is colocated with the lowest-id host (module docstring), a
+    partition that cuts a higher host away also cuts it from the store,
+    so the two sides cannot both append. After a heal the cut host
+    reconciles from disk (:meth:`reconcile`), discovers any epoch that
+    excluded it, and keeps beating so the leader can re-admit it.
+    """
+
+    def __init__(
+        self,
+        cfg: FleetConfig,
+        train_dir: str,
+        host_id: int,
+        n_hosts: int,
+        *,
+        log_fn=print,
+    ):
+        self.cfg = cfg
+        self.train_dir = train_dir
+        self.host_id = int(host_id)
+        self.n_hosts = int(n_hosts)
+        self.log_fn = log_fn
+        self.beat = 0
+        self.round = 0
+        self.tracker = LeaseTracker(cfg.patience)
+        self.log = MembershipLog.load(train_dir)
+        self.epoch: Optional[MembershipEpoch] = None
+        self.incidents = IncidentLog(
+            host_incidents_path(train_dir, host_id)
+        )
+        self._stale_logged: set[int] = set()
+        self._refusal_logged: Optional[str] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def _detail(self) -> dict:
+        return {
+            "granularity": "host",
+            "devices_per_host": int(self.cfg.devices_per_host),
+        }
+
+    def adopt(self, step: int = 0) -> MembershipEpoch:
+        """Bind to the shared membership history: host 0 begins epoch 0
+        on a fresh store; everyone else (and every restart) adopts the
+        recorded epoch. Mirrors ``ElasticCoordinator.adopt`` at host
+        granularity."""
+        self.log = MembershipLog.load(self.train_dir)
+        cur = self.log.latest()
+        if cur is None:
+            rec = MembershipEpoch(
+                epoch=0,
+                world_size=self.n_hosts,
+                roster=tuple(range(self.n_hosts)),
+                start_step=int(step),
+                reason="init",
+                shard_map={"kind": "host-lease", "skip": int(step)},
+                detail=self._detail(),
+            )
+            if self.host_id == 0:
+                self.log.append(rec)
+                self._incident("begin", rec)
+                self.log_fn(
+                    f"Fleet: membership epoch 0 begins "
+                    f"({self.n_hosts} hosts)"
+                )
+            else:
+                # a non-leader racing ahead of host 0's first append
+                # adopts the IMPLIED epoch 0 without writing — one
+                # writer, even at formation
+                self.log.epochs.append(rec)
+            self.epoch = rec
+        else:
+            self.epoch = cur
+            self.log_fn(
+                f"Fleet: host {self.host_id} adopted membership epoch "
+                f"{cur.epoch} (roster {list(cur.roster)})"
+            )
+        return self.epoch
+
+    def _incident(self, action: str, rec: MembershipEpoch, **extra):
+        self.incidents.append(
+            "fleet_membership",
+            action=action,
+            step=rec.start_step,
+            epoch=rec.epoch,
+            world=rec.world_size,
+            roster=list(rec.roster),
+            roster_hash=roster_hash(rec.roster),
+            **extra,
+        )
+
+    # -- per-round protocol ---------------------------------------------
+
+    def heartbeat(self, step: int = 0) -> HostLease:
+        """Renew my lease (one atomic file replace). The ``beat``
+        counter is the ONLY liveness signal; ``ts`` is diagnostic."""
+        self.beat += 1
+        lease = HostLease(
+            host_id=self.host_id,
+            beat=self.beat,
+            epoch=self.epoch.epoch if self.epoch else 0,
+            step=int(step),
+            pid=os.getpid(),
+            ts=time.time(),
+        )
+        write_lease(self.train_dir, lease)
+        return lease
+
+    def observe(self) -> set[int]:
+        """Fold one observer round over everyone's leases; returns the
+        currently-stale host set. My own lease participates (a host
+        that cannot renew its own lease must not act as leader), and
+        every CURRENT ROSTER member is expected — one that never formed
+        accrues idle rounds toward the same patience."""
+        self.round += 1
+        expected = self.epoch.roster if self.epoch else range(self.n_hosts)
+        return self.tracker.observe(read_leases(self.train_dir), expected)
+
+    def record_metrics(self, step: int = 0, **extra) -> None:
+        """One row of my per-host evidence stream — the fleet report
+        cross-checks every host's recorded epoch against
+        membership.json and reads round continuity as the lease-gap
+        signal."""
+        rec = {
+            "ts": round(time.time(), 3),
+            "host": self.host_id,
+            "round": self.round,
+            "beat": self.beat,
+            "step": int(step),
+            "epoch": self.epoch.epoch if self.epoch else 0,
+        }
+        rec.update(extra)
+        path = host_metrics_path(self.train_dir, self.host_id)
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass  # evidence is best-effort, like IncidentLog.append
+
+    def reconcile(self) -> str:
+        """Re-read membership.json and adopt any newer epoch (the
+        non-leader/healed-host half of the one-writer rule). Returns
+        "member" | "excluded" | "current"."""
+        disk = MembershipLog.load(self.train_dir)
+        cur = disk.latest()
+        if cur is None or (self.epoch and cur.epoch <= self.epoch.epoch):
+            if self.epoch and self.host_id not in self.epoch.roster:
+                return "excluded"
+            return "current"
+        self.log = disk
+        prev = self.epoch.epoch if self.epoch else None
+        self.epoch = cur
+        if self.host_id not in cur.roster:
+            self.log_fn(
+                f"Fleet: host {self.host_id} discovered epoch "
+                f"{cur.epoch} excludes it (was at epoch {prev}); "
+                "standing down — still beating so the leader can "
+                "re-admit"
+            )
+            self.incidents.append(
+                "fleet_membership",
+                action="stand_down",
+                epoch=cur.epoch,
+                world=cur.world_size,
+                host=self.host_id,
+            )
+            return "excluded"
+        self.log_fn(
+            f"Fleet: host {self.host_id} reconciled to epoch "
+            f"{cur.epoch} (roster {list(cur.roster)})"
+        )
+        return "member"
+
+    def _presumed_alive(self) -> set[int]:
+        """Hosts this controller must treat as live: every current
+        roster member and every host with a lease, MINUS the stale set.
+        A roster member never seen stays presumed-alive until its
+        patience grace runs out — death is always a staleness verdict,
+        never a mere absence at one read."""
+        roster = set(self.epoch.roster) if self.epoch else set()
+        alive = (roster | self.tracker.seen() | {self.host_id})
+        return alive - self.tracker.stale()
+
+    def is_leader(self) -> bool:
+        """Acting leader = lowest-id host among the presumed-alive set
+        (self counts — it just renewed its own lease)."""
+        return self.host_id == min(self._presumed_alive())
+
+    def maybe_transition(self, step: int = 0) -> Optional[MembershipEpoch]:
+        """Leader-only: fold the current alive set into the next epoch
+        and make it durable. Stale hosts get a ``lease_stale`` incident
+        BEFORE the shrink epoch lands, so every lease gap in the
+        timeline maps to a recorded explanation (the fleet report's
+        ``fleet_lease_gap_explained`` check)."""
+        if self.epoch is None or not self.is_leader():
+            return None
+        stale = self.tracker.stale() - {self.host_id}
+        for h in sorted(stale - self._stale_logged):
+            self._stale_logged.add(h)
+            self.incidents.append(
+                "lease_stale",
+                action="shrink_planned",
+                step=int(step),
+                epoch=self.epoch.epoch,
+                host=h,
+                idle_rounds=self.tracker._idle.get(h, 0),
+                patience=self.cfg.patience,
+            )
+            self.log_fn(
+                f"Fleet: host {h} lease stale "
+                f"({self.tracker._idle.get(h, 0)} rounds without a "
+                f"beat, patience {self.cfg.patience}); shrink planned"
+            )
+        alive = self._presumed_alive() - stale
+        grows = sum(e.reason == "grow" for e in self.log.epochs)
+        rec, why = fold_leases(
+            self.epoch,
+            alive,
+            step=step,
+            full_roster=tuple(range(self.log.full_world or self.n_hosts)),
+            grows=grows,
+            max_regrows=self.cfg.max_regrows,
+            detail=self._detail(),
+        )
+        if rec is None:
+            if why and why != self._refusal_logged:
+                self._refusal_logged = why
+                self.incidents.append(
+                    "fleet_membership",
+                    action="transition_refused",
+                    step=int(step),
+                    epoch=self.epoch.epoch,
+                    reason=why,
+                )
+                self.log_fn(f"Fleet: transition refused — {why}")
+            return None
+        # the healed-host set changed the world: clear one-shot guards
+        self._refusal_logged = None
+        self.log.append(rec)
+        self._incident(
+            rec.reason, rec,
+            from_world=self.epoch.world_size,
+            dead=list(rec.dead),
+        )
+        self.log_fn(
+            f"Fleet: {rec.reason} {self.epoch.world_size} -> "
+            f"{rec.world_size} at step {step} (epoch {rec.epoch}, "
+            f"roster {list(rec.roster)})"
+        )
+        self.epoch = rec
+        if rec.reason == "grow":
+            self._stale_logged -= set(rec.roster)
+        return rec

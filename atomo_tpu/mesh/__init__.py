@@ -27,6 +27,7 @@ from atomo_tpu.mesh.update import (
 from atomo_tpu.mesh.reshard import (
     reshard_model_axes,
     reshard_plan,
+    reshard_replicated,
     reshard_sharded_update,
 )
 
@@ -40,6 +41,7 @@ __all__ = [
     "place_sharded_update",
     "reshard_model_axes",
     "reshard_plan",
+    "reshard_replicated",
     "reshard_sharded_update",
     "sharded_state_from_params",
     "sharded_update_state",

@@ -88,6 +88,132 @@ def reshard_sharded_update(
     )
 
 
+def reshard_replicated(state, new_mesh, *, survivors=None, codec=None,
+                       axis="dp"):
+    """Re-place a LIVE replicated train state onto ``new_mesh`` — the
+    elastic coordinator's zero-downtime reshape for the replicated data
+    layout (the layout the elastic loop runs: the builders refuse
+    elastic + sharded-update/zero1/quorum, so this is the whole family).
+
+    Replicated state is the easy half of the determinism contract: the
+    host bytes are gathered once (``jax.device_get`` — the same bytes a
+    checkpoint save would write) and replicated onto the new mesh via
+    the same :func:`~atomo_tpu.parallel.replicated.replicate_state` a
+    fresh N'-device build performs, so the resharded trajectory IS the
+    fresh-build-and-continue trajectory by construction (tested
+    leaf-wise bit-exact, tests/test_elastic.py).
+
+    A ``DelayedState`` (``--overlap delayed``) carries the in-flight
+    encoded gradients as a ``(world, ...)`` row-per-source payload, and
+    those rows move with their owners:
+
+    * **shrink** — the SURVIVOR rows are re-sliced (``survivors`` = the
+      surviving old ranks, one per new-world slot, strictly increasing);
+      ``valid`` rides along, so the boundary step applies the mean of
+      the survivors' in-flight gradients — exactly what the shrunk
+      world's aggregation computes.
+    * **grow** — the new members have no in-flight rows, and zero rows
+      under ``valid=1`` would bias the mean; the carry RESETS to the
+      fresh ``valid=0`` value (one in-flight update dropped, the same
+      honest cost :func:`reshard_model_axes` states).
+
+    ``codec`` is required for a DelayedState: the payload row shapes are
+    checked against THIS codec's encode over these params and a mismatch
+    is REFUSED (a carry encoded by a different codec cannot be re-sliced
+    into a decodable one) — the caller falls back to re-exec and records
+    why.
+    """
+    # lazy: mesh.* must not import parallel.* at module level (cycle)
+    from atomo_tpu.parallel.replicated import (
+        DelayedState,
+        OverlapCarry,
+        _place_carry,
+        _zero_carry_host,
+        replicate_state,
+    )
+    from atomo_tpu.training.trainer import TrainState
+
+    n_new = int(new_mesh.shape[axis])
+    carry_in = None
+    if isinstance(state, DelayedState):
+        if codec is None:
+            raise ValueError(
+                "resharding a DelayedState needs the run's codec: the "
+                "carry's payload rows are codec-encoded gradients and "
+                "the reshard must prove they decode on the new world"
+            )
+        carry_in = state.carry
+        state = state.train
+    if not isinstance(state, TrainState):
+        raise ValueError(
+            "reshard_replicated moves the plain replicated TrainState "
+            f"(or DelayedState) only; got {type(state).__name__} — "
+            "wrapped layouts (zero1/sharded-update/quorum) are "
+            "layout-owned and go through reshard_sharded_update or the "
+            "checkpoint round-trip"
+        )
+    host = jax.device_get(state)
+    new_train = replicate_state(new_mesh, host)
+    if carry_in is None:
+        return new_train
+    payload = jax.device_get(carry_in.payload)
+    ok = jax.device_get(carry_in.ok)
+    valid = jnp.asarray(jax.device_get(carry_in.valid))
+    n_old = int(ok.shape[0])
+    zero = _zero_carry_host(codec, host.params, n_new)
+
+    def _check(old_leaf, zero_leaf):
+        if (
+            tuple(old_leaf.shape[1:]) != tuple(zero_leaf.shape[1:])
+            or old_leaf.dtype != zero_leaf.dtype
+        ):
+            raise ValueError(
+                "carry/codec mismatch: payload rows "
+                f"{tuple(old_leaf.shape[1:])}/{old_leaf.dtype} vs this "
+                f"codec's encode {tuple(zero_leaf.shape[1:])}/"
+                f"{zero_leaf.dtype} — the in-flight payload was encoded "
+                "by a different codec; re-exec instead"
+            )
+
+    try:
+        jax.tree_util.tree_map(_check, payload, zero.payload)
+    except ValueError:
+        raise
+    except Exception as exc:  # tree-structure mismatch = codec mismatch
+        raise ValueError(
+            f"carry/codec mismatch: payload tree differs from this "
+            f"codec's encode tree ({exc}); re-exec instead"
+        ) from None
+    if n_new > n_old:
+        carry = zero
+    elif n_new < n_old or survivors is not None:
+        ranks = [int(s) for s in (survivors or ())]
+        if len(ranks) != n_new or any(
+            b <= a for a, b in zip(ranks, ranks[1:])
+        ) or any(r < 0 or r >= n_old for r in ranks):
+            raise ValueError(
+                f"shrinking a DelayedState carry needs the survivor "
+                f"ranks: {n_new} strictly-increasing old ranks in "
+                f"[0, {n_old}); got {survivors!r}"
+            )
+        carry = OverlapCarry(
+            payload=jax.tree_util.tree_map(
+                lambda a: jnp.asarray(a)[jnp.asarray(ranks)], payload
+            ),
+            ok=jnp.asarray(ok)[jnp.asarray(ranks)],
+            valid=valid,
+        )
+    else:
+        carry = OverlapCarry(
+            payload=jax.tree_util.tree_map(jnp.asarray, payload),
+            ok=jnp.asarray(ok),
+            valid=valid,
+        )
+    return DelayedState(
+        train=new_train, carry=_place_carry(new_mesh, carry, axis=axis)
+    )
+
+
 def reshard_plan(
     old_spec: MeshSpec, n_devices: int, dcn_ways: int = 0
 ) -> Optional[MeshSpec]:

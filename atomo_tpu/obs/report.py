@@ -1002,3 +1002,327 @@ def summarize_report(doc: dict) -> str:
 
 def _fmt(x) -> str:
     return f"{x:.4f}" if isinstance(x, (int, float)) else str(x)
+
+
+# ---------------------------------------------------------------------------
+# Fleet report: one timeline over every host's evidence
+# ---------------------------------------------------------------------------
+
+FLEET_REPORT_NAME = "fleet_report.json"
+
+
+def fleet_report_path(train_dir: str) -> str:
+    return os.path.join(train_dir, FLEET_REPORT_NAME)
+
+
+def _check_fleet_membership_consistent(
+    epochs: list[dict], host_rows: dict[int, list[dict]], leases: dict
+) -> dict:
+    """Every host's recorded epoch stream agrees with membership.json:
+    nobody is ever AHEAD of the shared record (an epoch no leader
+    appended), and every member of the FINAL roster converged to the
+    final epoch before its stream ended (a member left behind on an old
+    epoch would split the data stream silently)."""
+    name = "fleet_membership_consistent"
+    if not epochs or not host_rows:
+        return _check(
+            name, True,
+            "skipped: no membership epochs or host evidence recorded",
+            skipped=True,
+        )
+    last = epochs[-1]
+    known = {int(e["epoch"]) for e in epochs}
+    bad = []
+    for h, rows in sorted(host_rows.items()):
+        seen = [int(r.get("epoch", 0)) for r in rows if "epoch" in r]
+        if not seen:
+            continue
+        ahead = sorted(set(seen) - known)
+        if ahead:
+            bad.append(f"host {h} recorded unknown epoch(s) {ahead}")
+        if int(h) in last.get("roster", []) and seen[-1] != int(
+            last["epoch"]
+        ):
+            bad.append(
+                f"host {h} is in the final roster but its stream ends "
+                f"at epoch {seen[-1]} (record holds {last['epoch']})"
+            )
+    for h, lease in sorted(leases.items()):
+        if int(getattr(lease, "epoch", 0)) not in known:
+            bad.append(
+                f"host {h} lease claims unknown epoch {lease.epoch}"
+            )
+    if bad:
+        return _check(name, False, "; ".join(bad))
+    return _check(
+        name, True,
+        f"{len(host_rows)} host stream(s) agree with "
+        f"{len(epochs)} membership epoch(s) "
+        f"(final epoch {last['epoch']}, roster {last.get('roster')})",
+    )
+
+
+def _check_fleet_lease_gap_explained(
+    host_rows: dict[int, list[dict]], incidents: list[dict],
+    epochs: list[dict],
+) -> dict:
+    """Every GAP in a host's evidence stream (missing observer rounds —
+    a partition, a death, a wedge) maps to a recorded explanation: a
+    ``lease_stale`` incident naming the host, a shrink epoch carrying it
+    in ``dead``, or the host's own ``stand_down``. An unexplained gap
+    means the control plane lost evidence without noticing — the exact
+    silent failure the lease protocol exists to rule out."""
+    name = "fleet_lease_gap_explained"
+    if not host_rows:
+        return _check(
+            name, True, "skipped: no host evidence recorded", skipped=True
+        )
+    explained: set[int] = set()
+    for r in incidents:
+        if r.get("cause") == "lease_stale" and r.get("host") is not None:
+            explained.add(int(r["host"]))
+        if (
+            r.get("cause") == "fleet_membership"
+            and r.get("action") == "stand_down"
+            and r.get("host") is not None
+        ):
+            explained.add(int(r["host"]))
+    for e in epochs:
+        for h in e.get("dead", []) or []:
+            explained.add(int(h))
+    gaps = []
+    unexplained = []
+    for h, rows in sorted(host_rows.items()):
+        # the "step" column is the driver's own loop counter (the fleet
+        # drill's round number); the observer "round" PAUSES while a
+        # host is cut from the store, so holes only show in step order
+        steps = [int(r["step"]) for r in rows if "step" in r]
+        holes = sum(
+            b - a - 1 for a, b in zip(steps, steps[1:]) if b > a + 1
+        )
+        if holes:
+            gaps.append((h, holes))
+            if int(h) not in explained:
+                unexplained.append(
+                    f"host {h}: {holes} missing round(s) with no "
+                    "lease_stale/stand_down/shrink record naming it"
+                )
+    if unexplained:
+        return _check(name, False, "; ".join(unexplained))
+    if gaps:
+        return _check(
+            name, True,
+            "; ".join(
+                f"host {h}: {n} missing round(s), explained"
+                for h, n in gaps
+            ),
+        )
+    return _check(
+        name, True,
+        f"{len(host_rows)} host stream(s) contiguous (no lease gaps)",
+    )
+
+
+def build_fleet_report(train_dir: str) -> dict:
+    """Join every host's evidence — ``hosts/<id>.json`` leases,
+    ``hosts/<id>.metrics.jsonl`` round streams,
+    ``hosts/<id>.incidents.jsonl`` decisions — with the shared
+    ``membership.json`` and the run-level ``incidents.jsonl`` into ONE
+    time-ordered fleet timeline with cross-host consistency checks.
+    Pure read, no jax (the ``build_report`` contract)."""
+    from atomo_tpu.fleet.control import (
+        hosts_dir,
+        read_leases,
+        roster_hash,
+    )
+    from atomo_tpu.utils.tracing import read_jsonl
+
+    epochs: list[dict] = []
+    mpath = os.path.join(train_dir, "membership.json")
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                epochs = list(json.load(f).get("epochs", []))
+        except (OSError, ValueError):
+            epochs = []
+    leases = read_leases(train_dir)
+    host_rows: dict[int, list[dict]] = {}
+    host_incidents: dict[int, list[dict]] = {}
+    hdir = hosts_dir(train_dir)
+    if os.path.isdir(hdir):
+        for name in sorted(os.listdir(hdir)):
+            if name.endswith(".metrics.jsonl"):
+                hid = int(name.split(".")[0])
+                host_rows[hid] = read_jsonl(os.path.join(hdir, name))
+            elif name.endswith(".incidents.jsonl"):
+                hid = int(name.split(".")[0])
+                host_incidents[hid] = IncidentLog.read(
+                    os.path.join(hdir, name)
+                )
+    run_incidents = IncidentLog.read(
+        os.path.join(train_dir, INCIDENT_LOG_NAME)
+    )
+
+    events: list[dict] = []
+    for e in epochs:
+        events.append(
+            {
+                "kind": "membership",
+                "ts": None,
+                "epoch": e.get("epoch"),
+                "world_size": e.get("world_size"),
+                "roster": e.get("roster"),
+                "roster_hash": roster_hash(e.get("roster") or []),
+                "reason": e.get("reason"),
+                "dead": e.get("dead", []),
+            }
+        )
+    for h, recs in sorted(host_incidents.items()):
+        for r in recs:
+            events.append(
+                {
+                    "kind": "incident",
+                    "host": h,
+                    "ts": r.get("ts"),
+                    "line": format_incident(r),
+                    "record": r,
+                }
+            )
+    for r in run_incidents:
+        events.append(
+            {
+                "kind": "incident",
+                "host": None,
+                "ts": r.get("ts"),
+                "line": format_incident(r),
+                "record": r,
+            }
+        )
+    for h, rows in sorted(host_rows.items()):
+        if not rows:
+            continue
+        # compress each host's round stream into per-epoch segments
+        seg = None
+        for r in rows:
+            ep = r.get("epoch")
+            if seg is None or seg["epoch"] != ep:
+                if seg is not None:
+                    events.append(seg)
+                seg = {
+                    "kind": "host_rounds",
+                    "host": h,
+                    "epoch": ep,
+                    "first_round": r.get("round"),
+                    "last_round": r.get("round"),
+                    "n": 1,
+                    "ts": r.get("ts"),
+                    "last_status": r.get("status"),
+                }
+            else:
+                seg["last_round"] = r.get("round")
+                seg["n"] += 1
+                seg["last_status"] = r.get("status", seg["last_status"])
+        if seg is not None:
+            events.append(seg)
+
+    def sort_key(ev):
+        if ev.get("kind") == "membership":
+            return (0, int(ev.get("epoch") or 0), 0.0)
+        return (1, 0, float(ev.get("ts") or 0.0))
+
+    events.sort(key=sort_key)
+    all_incidents = run_incidents + [
+        r for recs in host_incidents.values() for r in recs
+    ]
+    checks = [
+        _check_fleet_membership_consistent(epochs, host_rows, leases),
+        _check_fleet_lease_gap_explained(
+            host_rows, all_incidents, epochs
+        ),
+    ]
+    consistent = all(c["ok"] for c in checks)
+    last = epochs[-1] if epochs else None
+    return {
+        "kind": "fleet_report",
+        "train_dir": os.path.abspath(train_dir),
+        "sources": {
+            "membership_json": len(epochs),
+            "leases": len(leases),
+            "host_metric_streams": len(host_rows),
+            "host_incident_streams": len(host_incidents),
+            "run_incidents": len(run_incidents),
+        },
+        "summary": {
+            "hosts_seen": sorted(
+                set(host_rows) | set(host_incidents) | set(leases)
+            ),
+            "membership_epochs": len(epochs),
+            "final_epoch": last.get("epoch") if last else None,
+            "final_roster": last.get("roster") if last else None,
+            "final_roster_hash": (
+                roster_hash(last.get("roster") or []) if last else None
+            ),
+            "incidents": len(all_incidents),
+        },
+        "timeline": events,
+        "checks": checks,
+        "consistent": consistent,
+    }
+
+
+def summarize_fleet_report(doc: dict) -> str:
+    """The human fleet post-mortem: one line per timeline event."""
+    s = doc.get("summary", {})
+    lines = [
+        f"fleet report: {doc.get('train_dir')}",
+        "  hosts {}, {} membership epoch(s), final epoch {} "
+        "(roster {}, hash {}), {} incident(s)".format(
+            s.get("hosts_seen"),
+            s.get("membership_epochs"),
+            s.get("final_epoch"),
+            s.get("final_roster"),
+            s.get("final_roster_hash"),
+            s.get("incidents"),
+        ),
+    ]
+    for ev in doc.get("timeline", []):
+        kind = ev.get("kind")
+        if kind == "membership":
+            lines.append(
+                f"  membership epoch {ev.get('epoch')}: world "
+                f"{ev.get('world_size')} roster {ev.get('roster')} "
+                f"({ev.get('reason')}"
+                + (f", dead={ev.get('dead')}" if ev.get("dead") else "")
+                + ")"
+            )
+        elif kind == "incident":
+            who = (
+                f"host {ev['host']}" if ev.get("host") is not None
+                else "run"
+            )
+            lines.append(f"  [{who}] incident: {ev['line']}")
+        elif kind == "host_rounds":
+            status = (
+                f", last status {ev['last_status']}"
+                if ev.get("last_status")
+                else ""
+            )
+            lines.append(
+                f"  [host {ev['host']}] rounds "
+                f"{ev.get('first_round')}..{ev.get('last_round')} "
+                f"({ev.get('n')} row(s)) at epoch {ev.get('epoch')}"
+                f"{status}"
+            )
+    bad = [c["name"] for c in doc.get("checks", []) if not c["ok"]]
+    ran = [c for c in doc.get("checks", []) if not c.get("skipped")]
+    if doc.get("consistent"):
+        lines.append(
+            f"  consistency: OK ({len(ran)} check(s) ran, "
+            f"{len(doc.get('checks', [])) - len(ran)} skipped)"
+        )
+    else:
+        lines.append(f"  consistency: FAILED ({', '.join(bad)})")
+        for c in doc.get("checks", []):
+            if not c["ok"]:
+                lines.append(f"    {c['name']}: {c['detail']}")
+    return "\n".join(lines)

@@ -33,6 +33,7 @@ def initialize(
     process_id: Optional[int] = None,
     attempts: int = 3,
     backoff: float = 1.0,
+    init_timeout: Optional[float] = None,
 ) -> None:
     """Initialize the multi-host runtime.
 
@@ -46,6 +47,12 @@ def initialize(
     > 1 retries the initialize with exponential backoff (``backoff`` base
     seconds) on connection-flavored failures instead of dying into the
     scheduler's next restart round.
+
+    ``init_timeout`` bounds each handshake attempt (seconds) where the
+    jax version supports ``initialization_timeout``. The fleet re-form
+    path needs this: a member waiting at the rendezvous for a peer that
+    will never arrive must fail into a recorded incident, not sit in the
+    default 300 s barrier.
     """
     coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if num_processes is None:
@@ -88,6 +95,18 @@ def initialize(
                 pass  # private path moved: shutdown() above is the fallback
             raise
 
+    kw = dict(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    if init_timeout is not None:
+        import inspect
+
+        if "initialization_timeout" in inspect.signature(
+            jax.distributed.initialize
+        ).parameters:
+            kw["initialization_timeout"] = max(1, int(init_timeout))
     with_retries(
         _attempt,
         attempts=max(attempts, 1),
@@ -98,11 +117,7 @@ def initialize(
             "retrying",
             flush=True,
         ),
-    )(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    )(**kw)
 
 
 def global_mesh(axes: Sequence[tuple[str, int]] = ()) -> "jax.sharding.Mesh":

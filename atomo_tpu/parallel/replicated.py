@@ -2873,14 +2873,23 @@ def distributed_train_loop(
     ``guard``), an :class:`~atomo_tpu.elastic.coordinator
     .ElasticCoordinator` adopts/creates the membership epoch in
     ``train_dir/membership.json``, folds the per-step ``ok_bits`` series,
-    and at a periodic checkpoint boundary raises
-    :class:`~atomo_tpu.elastic.membership.MembershipChange` to shrink to
-    the surviving roster (or re-grow at ``readmit_at``) — the CLI maps it
-    to MEMBERSHIP_EXIT_CODE and the supervisor re-execs at the new world
-    size without charging the restart budget. Needs a checkpoint cadence
-    and a flat blocking aggregate; rejects zero1 / delayed / hierarchical
-    / phase_metrics (the world-size-shaped state those modes carry cannot
-    be resumed across a reshape).
+    and at a periodic checkpoint boundary commits the shrink to the
+    surviving roster (or the re-grow at ``readmit_at``). In the default
+    ``reshard="live"`` mode the commit reshapes IN PLACE — the loop's
+    state/mesh/step program swap at the boundary via
+    :func:`~atomo_tpu.mesh.reshard.reshard_replicated` with NO process
+    exit, bit-exact against a fresh new-world build resumed from the
+    same boundary (drilled in tests/test_elastic.py) — and when the loop
+    cannot reshape in place (wrapper-owned layout, mesh not viable,
+    carry/codec mismatch, fused superstep feed) it records a
+    ``reshard_fallback`` incident quoting why and raises
+    :class:`~atomo_tpu.elastic.membership.MembershipChange` — the CLI
+    maps it to MEMBERSHIP_EXIT_CODE (rc=29) and the supervisor re-execs
+    at the new world size without charging the restart budget
+    (``reshard="reexec"`` keeps that exit path as the only one). Needs a
+    checkpoint cadence and a flat blocking aggregate; rejects zero1 /
+    delayed / hierarchical / phase_metrics (the world-size-shaped state
+    those modes carry cannot be resumed across a reshape).
 
     ``recorder`` (obs.recorder.FlightRecorder) arms the flight recorder:
     one ``metrics.jsonl`` record per step — the superstep loop rides its
@@ -3938,6 +3947,88 @@ def distributed_train_loop(
                     hybrid=hybrid,
                 )
             )
+    live_reshard = None
+    if elastic_rig is not None:
+
+        def live_reshard(kind, rec, cur_state):
+            """The coordinator's zero-downtime reshape: re-place the live
+            replicated state on a mesh of the new world, rebuild the step
+            program against it, and return the loop's new quartet
+            ``(new_mesh, new_state, new_step_fn, new_eval_fn)`` — or
+            ``(None, why)`` when this loop cannot reshape in place (the
+            coordinator then records a ``reshard_fallback`` incident
+            quoting ``why`` and falls back to exit-and-re-exec).
+
+            Bit-exactness is by construction: the host bytes are the
+            ones the save at this boundary just wrote, and
+            :func:`~atomo_tpu.mesh.reshard.reshard_replicated` places
+            them through the same ``replicate_state`` /
+            ``_place_carry`` a fresh new-world build performs, on the
+            same ``make_mesh(N')`` device prefix."""
+            nonlocal mesh
+            if su_specs is not None or zero1_specs is not None:
+                return None, (
+                    "state layout is wrapper-owned (zero1/sharded-update "
+                    "master shards are world-shaped)"
+                )
+            if quorum is not None:
+                return None, "quorum staleness ring is world-shaped"
+            if tuple(mesh.axis_names) != ("dp",):
+                return None, (
+                    f"mesh axes {tuple(mesh.axis_names)} are not the "
+                    "plain dp layout"
+                )
+            n_avail = len(jax.devices())
+            if rec.world_size > n_avail:
+                return None, (
+                    f"mesh shape not viable: epoch {rec.epoch} needs "
+                    f"{rec.world_size} devices, {n_avail} attached"
+                )
+            survivors = None
+            old = elastic_rig.epoch
+            if old is not None and rec.world_size < old.world_size:
+                try:
+                    survivors = tuple(
+                        old.roster.index(m) for m in rec.roster
+                    )
+                except ValueError:
+                    return None, (
+                        f"roster {list(rec.roster)} is not a subset of "
+                        f"epoch {old.epoch}'s {list(old.roster)}"
+                    )
+            from atomo_tpu.mesh.reshard import reshard_replicated
+            from atomo_tpu.parallel.mesh import make_mesh
+
+            new_mesh = make_mesh(rec.world_size)
+            try:
+                new_state = reshard_replicated(
+                    cur_state, new_mesh,
+                    survivors=survivors, codec=codec_cell["codec"],
+                )
+            except ValueError as exc:
+                return None, str(exc)
+            # rebind the loop-scope mesh BEFORE rebuilding: build_step,
+            # retune, and the rollback _reload all read this cell at
+            # call time, so every later rebuild compiles against the
+            # new world
+            mesh = new_mesh
+            if chaos is not None:
+                # the live analogue of the supervisor's epoch env
+                # export: the rebuild below re-traces with the old
+                # epoch's die@ faults disarmed
+                chaos.membership_epoch = rec.epoch
+            new_step_fn = build_step(
+                rig.doctor.generation if rig is not None else 0
+            )
+            new_eval_fn = (
+                make_distributed_eval_step(
+                    model, new_mesh, axis=batch_axes
+                )
+                if test_iter is not None
+                else None
+            )
+            return new_mesh, new_state, new_step_fn, new_eval_fn
+
     # superstep mode beats the watchdog once per BLOCK: scale the budget
     # by K so a per-step-tuned --health-timeout does not falsely fire
     with heartbeat_watchdog(
@@ -3963,7 +4054,7 @@ def distributed_train_loop(
                 guard=guard, chaos=chaos, keep_ckpts=keep_ckpts,
                 rig=rig, incidents=incidents, tuner=tuner, retune=retune,
                 elastic_rig=elastic_rig, recorder=recorder,
-                quorum_rig=quorum_rig,
+                quorum_rig=quorum_rig, live_reshard=live_reshard,
             )
     return state
 
@@ -4026,7 +4117,7 @@ def _distributed_steps(
     profile_dir=None, profile_steps=3, batch_axes="dp",
     guard=None, chaos=None, keep_ckpts=0, rig=None, incidents=None,
     tuner=None, retune=None, elastic_rig=None, recorder=None,
-    quorum_rig=None,
+    quorum_rig=None, live_reshard=None,
 ):
     import time as _time
 
@@ -4207,8 +4298,29 @@ def _distributed_steps(
             if elastic_rig is not None:
                 # membership transitions snap to the same boundaries: the
                 # save just landed IS the next epoch's start checkpoint.
-                # Raises MembershipChange (the CLI exits rc=29) when due.
-                elastic_rig.maybe_transition(step)
+                # In live mode the transition reshapes IN PLACE — state,
+                # mesh, and step program swap at this boundary with no
+                # process exit; otherwise (or on a recorded
+                # reshard_fallback) raises MembershipChange (rc=29).
+                def _live(kind, rec):
+                    nonlocal state, step_fn, eval_fn, mesh
+                    out = live_reshard(kind, rec, state)
+                    if out[0] is None:
+                        return False, out[1]
+                    mesh, state, step_fn, eval_fn = out
+                    if recorder is not None:
+                        # re-exec children restamp the membership epoch
+                        # from env at construction; the live path must
+                        # restamp in place or every later step row
+                        # claims the old epoch (report's
+                        # membership_column_agrees check)
+                        recorder.set_context(epoch=rec.epoch)
+                    return True, None
+
+                elastic_rig.maybe_transition(
+                    step,
+                    live=_live if live_reshard is not None else None,
+                )
         if tuner is not None:
             # restamp after the boundary work (eval/save/re-probe): those
             # spans are cadence costs, not step time — folding them in
@@ -4439,8 +4551,17 @@ def _distributed_superstep_steps(
             if elastic_rig is not None:
                 # boundary-snapped like retune: the save just written is
                 # the next epoch's start checkpoint (raises on a due
-                # shrink/grow — see the per-step loop)
-                elastic_rig.maybe_transition(s)
+                # shrink/grow — see the per-step loop). The fused block
+                # feed is staged world-shaped ahead of the block, so the
+                # superstep loop REFUSES the in-place reshape: live mode
+                # records a reshard_fallback and re-execs.
+                elastic_rig.maybe_transition(
+                    s,
+                    live=lambda kind, rec: (
+                        False,
+                        "fused superstep block feed is world-shaped",
+                    ),
+                )
         if tuner is not None:
             # restamp after boundary work (eval/save/re-probe): cadence
             # costs must not enter the drift baseline

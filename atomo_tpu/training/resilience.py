@@ -1247,6 +1247,37 @@ def run_supervised(
                 f"(rc={rc}, {cause}); giving up"
             )
             return rc
+        if train_dir:
+            # a LIVE reshape (--elastic-reshard live) advances
+            # membership.json WITHOUT an rc=29 exit, so a later crash
+            # must not relaunch at the stale world: membership.json is
+            # the source of truth for the next attempt's --n-devices
+            # regardless of how the epoch advanced. Charged as a normal
+            # crash — the reshape already happened in-process.
+            try:
+                from atomo_tpu.elastic.membership import MembershipLog
+
+                plan = MembershipLog.load(train_dir).latest()
+            except Exception:  # noqa: BLE001 — unreadable plan: keep argv
+                plan = None
+            if plan is not None and (
+                last_epoch is None or plan.epoch > last_epoch
+            ):
+                from atomo_tpu.elastic.membership import (
+                    apply_world_to_argv,
+                )
+
+                last_epoch = plan.epoch
+                new_cmd = apply_world_to_argv(cmd, plan.world_size)
+                extra_env[MEMBERSHIP_EPOCH_ENV] = str(plan.epoch)
+                if new_cmd != cmd:
+                    cmd = new_cmd
+                    log_fn(
+                        f"Supervisor: membership.json holds epoch "
+                        f"{plan.epoch} (world {plan.world_size}, "
+                        f"{plan.reason}) — reshaped before the crash; "
+                        f"restarting with --n-devices {plan.world_size}"
+                    )
         delay, prev = decorrelated_delay(prev, backoff_base, backoff_max, rng)
         delay = round(delay, 3)
         if incidents is not None:

@@ -638,6 +638,7 @@ def decision_path(train_dir: str) -> str:
 def decision_reusable(
     doc, *, n_dev: int, mesh_axes: Optional[dict] = None,
     quorum: Optional[int] = None, staleness: Optional[int] = None,
+    fleet_roster: Optional[str] = None,
 ) -> tuple[bool, str]:
     """Can a ``--resume`` reuse this recorded tune decision?
 
@@ -665,6 +666,15 @@ def decision_reusable(
     pinned: a decision priced under one (Q, K) means something else
     under another — the same refusal family as the arrival artifact's
     meta check (quorum.rig), applied to the tune decision.
+
+    ``fleet_roster`` (the resuming run's host roster hash,
+    ``fleet.control.current_roster_hash``; None = no fleet evidence)
+    refuses reuse when the HOST ROSTER changed at the same device
+    count: two swapped hosts or one replaced machine keep ``n_devices``
+    and ``mesh_axes`` identical while moving data placement and stream
+    splits, which only the roster fingerprint sees. Artifacts that
+    predate the fleet record fall back to the device-count/mesh checks
+    (said in the reason, never silently).
 
     Returns ``(reusable, reason)``; the reason is logged either way and
     lands in incidents.jsonl on the re-tune path. A PURE function of the
@@ -697,6 +707,21 @@ def decision_reusable(
             "recorded winner may be invalid for this world; re-tuning"
         )
     meta = doc.get("meta") or {}
+    fleet_note = ""
+    if fleet_roster is not None:
+        rec_fleet = meta.get("fleet_roster_hash")
+        if rec_fleet is None:
+            fleet_note = (
+                "; artifact predates the fleet roster record, so the "
+                "host-roster check falls back to device count alone"
+            )
+        elif rec_fleet != fleet_roster:
+            return False, (
+                f"decision was tuned on fleet roster {rec_fleet} but "
+                f"this run's roster hashes to {fleet_roster} (same "
+                "device count, different hosts — data placement and "
+                "stream splits are roster facts); re-tuning"
+            )
     if mesh_axes is not None:
         rec_axes = meta.get("mesh_axes")
         reconstructed = False
@@ -719,7 +744,7 @@ def decision_reusable(
             return True, (
                 f"recorded decision matches this world size ({n_dev}); "
                 "artifact predates the mesh_axes record, so the shape "
-                "check falls back to n_devices only"
+                "check falls back to n_devices only" + fleet_note
             )
         src = (
             " (reconstructed from the legacy artifact's dcn_ways)"
@@ -735,9 +760,12 @@ def decision_reusable(
             )
         return True, (
             f"recorded decision matches this mesh shape ({mesh_axes})"
-            + src
+            + src + fleet_note
         )
-    return True, f"recorded decision matches this world size ({n_dev})"
+    return True, (
+        f"recorded decision matches this world size ({n_dev})"
+        + fleet_note
+    )
 
 
 class OnlineRetuner:

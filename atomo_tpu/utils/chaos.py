@@ -41,7 +41,11 @@ Fault kinds
               Like die@S:R it is keyed on the membership epoch and
               IGNORES doctor generations — a slow host stays slow
               across rollbacks                              (host)
-  kill@S      process dies (os._exit) before step S runs    (host)
+  kill@S      process dies (os._exit) before step S runs — ONE
+              preemption: fires only on run attempt 0 (the
+              supervisor's ATOMO_RUN_ATTEMPT env), so a supervised
+              restart resumes PAST it instead of dying at step S
+              forever (crashloop@ is the keeps-dying fault)  (host)
   crashloop@M the process dies at loop start on the first M runs and
               succeeds from run M+1 on (run index = the supervisor's
               ATOMO_RUN_ATTEMPT env, 0 on an unsupervised run) — the
@@ -49,6 +53,29 @@ Fault kinds
   truncate@S  the checkpoint written at step S is truncated (host, post-save)
   bitflip@S   one bit of the step-S checkpoint is flipped   (host, post-save)
   badmagic@S  the step-S checkpoint's magic is clobbered    (host, post-save)
+
+Host-level (lease-layer) faults — the fleet control plane's drills
+(``atomo_tpu.fleet``); S is the fleet heartbeat ROUND, H a host id:
+  hostdie@S:H      host H hard-exits at round S — the whole process,
+                   not one replica's gradient; only the LEASE layer
+                   (its beat stops advancing) ever notices  (host)
+  slowlink@S:H:SEC host H's store link is slow: every lease renewal
+                   from round S onward is delayed SEC seconds — the
+                   fleet analogue of slow@S:R:SEC (persistent
+                   straggler; goes stale only if SEC starves the
+                   observer's patience window)              (host)
+  partition@S:H1-H2:SEC
+                   the link between hosts H1 and H2 is cut for SEC
+                   seconds starting at round S. The store (train_dir)
+                   is colocated with the lowest-id host, so the HIGHER
+                   id of the pair loses the store entirely: no lease
+                   renewals, no membership reads — its lease goes
+                   stale, the transition function shrinks around it,
+                   and after SEC the healed host reconciles from disk
+                   and is re-admitted under max_regrows      (host)
+All three are keyed on the membership epoch like ``die@`` (fire only
+at epoch 0, so a shrunken/re-grown fleet's members come back healthy)
+and ignore doctor generations.
 
 Generations: step-targeted faults (grad faults, spike, slow, kill, ckpt
 corruption) fire only at injector ``generation`` 0. The divergence
@@ -113,6 +140,12 @@ class ChaosConfig:
     # slow@S:R:SEC — (start_step, replica, seconds): replica R lags SEC s
     # on EVERY step >= S (persistent straggler, the quorum drill's skew)
     slow_replica_faults: tuple[tuple[int, int, float], ...] = ()
+    # fleet lease-layer faults (steps are heartbeat ROUNDS, see module
+    # docstring): hostdie@S:H, slowlink@S:H:SEC, partition@S:H1-H2:SEC
+    host_die_faults: tuple[tuple[int, int], ...] = ()  # (round, host)
+    slowlink_faults: tuple[tuple[int, int, float], ...] = ()  # (round, host, sec)
+    # (round, host_a, host_b, seconds): the higher id loses the store
+    partition_faults: tuple[tuple[int, int, int, float], ...] = ()
     spike_scale: float = 8.0  # finite: passes grad_ok's finiteness screen
     crashloop: int = 0  # first M runs die at loop start; run M+1 succeeds
     explode_scale: float = 1e12
@@ -151,6 +184,7 @@ class ChaosConfig:
             spike_scale = float(env.get("ATOMO_CHAOS_SPIKE_SCALE", "8.0"))
         grad, slow, kill, ckpt, spike, die = [], [], [], [], [], []
         slow_rep = []
+        host_die, slowlink, partition = [], [], []
         crashloop = 0
         for raw in spec.split(","):
             tok = raw.strip().lower()
@@ -161,13 +195,16 @@ class ChaosConfig:
                 raise ValueError(
                     f"bad chaos token {tok!r}; expected kind@step[*][:arg] "
                     f"with kind in "
-                    f"{sorted(GRAD_FAULTS) + ['spike', 'die', 'slow', 'kill', 'crashloop'] + list(CKPT_FAULTS)}"
+                    f"{sorted(GRAD_FAULTS) + ['spike', 'die', 'slow', 'kill', 'crashloop'] + list(CKPT_FAULTS) + ['hostdie', 'slowlink', 'partition']}"
                 )
             kind, step = m.group("kind"), int(m.group("step"))
             arg, arg2 = m.group("arg"), m.group("arg2")
-            if arg2 is not None and kind != "slow":
+            if arg2 is not None and kind not in (
+                "slow", "slowlink", "partition"
+            ):
                 raise ValueError(
-                    f"chaos token {tok!r}: only slow@S:R:SEC takes two "
+                    f"chaos token {tok!r}: only slow@S:R:SEC, "
+                    "slowlink@S:H:SEC and partition@S:H1-H2:SEC take two "
                     "colon args"
                 )
             if kind in GRAD_FAULTS:
@@ -201,6 +238,50 @@ class ChaosConfig:
                     slow_rep.append((step, rep, sec))
                 else:
                     slow.append((step, float(arg) if arg else 0.25))
+            elif kind == "hostdie":
+                # the :H slot carries the fleet host id (default 0)
+                host = int(float(arg)) if arg else 0
+                if host < 0:
+                    raise ValueError(
+                        f"hostdie host must be >= 0, got {host}"
+                    )
+                host_die.append((step, host))
+            elif kind == "slowlink":
+                if arg is None or arg2 is None:
+                    raise ValueError(
+                        f"chaos token {tok!r}: slowlink needs both args "
+                        "(slowlink@ROUND:HOST:SEC)"
+                    )
+                host = int(float(arg))
+                sec = float(arg2)
+                if host < 0:
+                    raise ValueError(
+                        f"slowlink host must be >= 0, got {host}"
+                    )
+                if sec <= 0:
+                    raise ValueError(
+                        f"slowlink delay must be > 0 s, got {sec}"
+                    )
+                slowlink.append((step, host, sec))
+            elif kind == "partition":
+                if arg is None or arg2 is None or "-" not in arg:
+                    raise ValueError(
+                        f"chaos token {tok!r}: partition needs a host "
+                        "pair and a duration (partition@ROUND:H1-H2:SEC)"
+                    )
+                a, _, b = arg.partition("-")
+                h1, h2 = int(float(a)), int(float(b))
+                sec = float(arg2)
+                if h1 < 0 or h2 < 0 or h1 == h2:
+                    raise ValueError(
+                        f"partition hosts must be distinct and >= 0, "
+                        f"got {h1}-{h2}"
+                    )
+                if sec <= 0:
+                    raise ValueError(
+                        f"partition duration must be > 0 s, got {sec}"
+                    )
+                partition.append((step, h1, h2, sec))
             elif kind == "kill":
                 kill.append(step)
             elif kind == "crashloop":
@@ -218,6 +299,9 @@ class ChaosConfig:
             spike_faults=tuple(spike),
             die_faults=tuple(die),
             slow_replica_faults=tuple(slow_rep),
+            host_die_faults=tuple(host_die),
+            slowlink_faults=tuple(slowlink),
+            partition_faults=tuple(partition),
             spike_scale=spike_scale,
             crashloop=crashloop,
             seed=seed,
@@ -237,7 +321,9 @@ class ChaosConfig:
         return bool(
             self.grad_faults or self.slow_steps or self.kill_steps
             or self.ckpt_faults or self.spike_faults or self.die_faults
-            or self.slow_replica_faults or self.crashloop
+            or self.slow_replica_faults or self.host_die_faults
+            or self.slowlink_faults or self.partition_faults
+            or self.crashloop
         )
 
 
@@ -269,6 +355,11 @@ class ChaosInjector:
                 os.environ.get(MEMBERSHIP_EPOCH_ENV, "0") or "0"
             )
         self.membership_epoch = membership_epoch
+        # partition@ heal clocks: fault index -> monotonic t0 of the cut
+        # (set the first time the fault is observed active; the fault
+        # heals SEC seconds later on the SAME clock — wall time never
+        # decides, mirroring the lease layer's no-wall-clock rule)
+        self._partition_t0: dict[int, float] = {}
 
     def with_generation(self, generation: int) -> "ChaosInjector":
         """The injector the doctor rebuilds step programs with after a
@@ -461,8 +552,70 @@ class ChaosInjector:
             time.sleep(lag)
         return lag
 
+    # ---- fleet lease-layer faults (atomo_tpu.fleet) -------------------
+
+    def maybe_hostdie(self, round_no: int, host_id: int) -> None:
+        """hostdie@S:H — host H hard-exits at heartbeat round S (the
+        whole process: no finally blocks, like maybe_die). Keyed on the
+        membership epoch like die@ — a re-admitted host comes back
+        healthy."""
+        if self.membership_epoch:
+            return
+        for s, h in self.config.host_die_faults:
+            if round_no >= s and h == host_id:
+                print(
+                    f"CHAOS: host {host_id} dying at fleet round "
+                    f"{round_no} (exit {self.config.exit_code})",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                os._exit(self.config.exit_code)
+
+    def slowlink_delay(self, round_no: int, host_id: int) -> float:
+        """slowlink@S:H:SEC — host H's per-round store latency (seconds)
+        from round S onward; 0.0 when unaffected. PURE like
+        replica_delays, epoch-keyed like die@: the fleet loop sleeps
+        this before renewing its lease."""
+        if self.membership_epoch:
+            return 0.0
+        lag = 0.0
+        for s, h, sec in self.config.slowlink_faults:
+            if round_no >= s and h == host_id:
+                lag = max(lag, sec)
+        return lag
+
+    def store_partitioned(
+        self, round_no: int, host_id: int, *, now=None
+    ) -> bool:
+        """partition@S:H1-H2:SEC — is ``host_id`` currently cut off the
+        store? The store (train_dir) is colocated with the lowest-id
+        host, so the HIGHER id of the pair is the one that loses it (no
+        lease renewals, no membership reads — fencing by
+        unreachability; the lower side keeps the store and shrinks).
+        The cut lasts SEC seconds on THIS process's monotonic clock
+        from the first round the fault is active (``now`` injectable
+        for tests). Epoch-keyed like die@."""
+        if self.membership_epoch:
+            return False
+        clock = now if now is not None else time.monotonic
+        for i, (s, h1, h2, sec) in enumerate(self.config.partition_faults):
+            if host_id != max(h1, h2) or round_no < s:
+                continue
+            t0 = self._partition_t0.setdefault(i, clock())
+            if clock() - t0 < sec:
+                return True
+        return False
+
     def should_die(self, step: int) -> bool:
-        return not self.generation and step in self.config.kill_steps
+        """kill@S on run attempt 0 only: a chaos kill models ONE
+        preemption. A restarted attempt resumes from the checkpoint
+        BEFORE step S and must get past it — a kill that re-fires every
+        attempt is a deterministic trap no restart budget survives
+        (that drill is crashloop@M, which is attempt-counted by
+        design)."""
+        if self.generation or step not in self.config.kill_steps:
+            return False
+        return int(os.environ.get(ATTEMPT_ENV, "0") or "0") == 0
 
     def maybe_die(self, step: int) -> None:
         """Simulated process death: flush and hard-exit BEFORE the step runs

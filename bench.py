@@ -361,6 +361,32 @@ CONFIGS = {
              # (the warm-cache parity hazard tests/conftest.py records),
              # so the child must never inherit ATOMO_COMPILE_CACHE
              no_compile_cache=True),
+    # Config 21 (PR-20 fleet tentpole): fleet_control_plane — the host-
+    # level control plane drilled with REAL processes, not virtual
+    # devices. Two gates, both in-row: (1) the 2-PROCESS DRILL — two
+    # fleet.launcher processes form a fleet over one shared train_dir,
+    # partition@ cuts host 1 off the lease store, the leader's transition
+    # function shrinks around the stale lease, heal re-admits it
+    # (epoch 0 -> 1 -> 2), and `report --fleet --strict` over the
+    # resulting artifacts must exit 0 (every host's epochs consistent
+    # with membership.json, every lease gap explained by a recorded
+    # incident) — the drill is gated on the report's own checks, not on
+    # ad-hoc assertions; (2) the RESUME DRILL — a live in-process die@
+    # shrink (the zero-downtime reshard primary path: params + momentum
+    # re-sliced, NO rc=29 re-exec) followed by kill@ -> supervisor
+    # restart -> resume mid-epoch replays leaf-wise BIT-exact
+    # checkpoints against the uninterrupted live run (the supervisor
+    # re-derives --n-devices from membership.json because the live
+    # reshape advanced the epoch without exiting). `value` is the
+    # 2-process drill's wall seconds. Semantics + control-plane-honesty
+    # evidence like configs 8-20, not a chip-speed claim. Baseline
+    # "none". no_compile_cache: the resume drill compares executables
+    # across process generations (the same warm-cache parity hazard as
+    # config 20).
+    21: dict(metric="fleet_control_plane", kind="fleet",
+             n_hosts=2, rounds=400, period_s=0.05, patience=4,
+             stop_epoch=2, n_dev=4, force_cpu_mesh=True,
+             no_compile_cache=True),
 }
 
 # Peak dense matmul throughput per chip (bf16 MXU passes — what XLA uses for
@@ -3771,6 +3797,169 @@ def measure_two_tier(cfg: dict) -> dict:
     return out
 
 
+def measure_fleet(cfg: dict) -> dict:
+    """Config-21: the host-level fleet control plane drilled with real
+    processes (see CONFIGS[21] for the full row contract).
+
+    ``value`` is the 2-process form→partition→shrink→heal→regrow drill's
+    wall seconds. The two in-row gates: ``fleet_report_strict_ok``
+    (``report --fleet --strict`` rc=0 over the drill's train_dir) and
+    ``resume_bit_exact`` (live die@ shrink + kill→restart→resume replays
+    bit-identical checkpoints vs the uninterrupted live run)."""
+    import concurrent.futures
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    n_hosts = int(cfg.get("n_hosts", 2))
+    rounds = int(cfg.get("rounds", 400))
+    period = float(cfg.get("period_s", 0.05))
+    patience = int(cfg.get("patience", 4))
+    stop_epoch = int(cfg.get("stop_epoch", 2))
+    chaos = "partition@3:0-1:0.8"
+    base = dict(
+        metric=cfg["metric"], unit="s", value=None,
+        byte_reduction=None, mfu=None, flops_per_step=None,
+        peak_tflops=None, platform="host", device="processes",
+        ways=n_hosts, chips_measured=0,
+        timing="wall-clock-2-process-drill",
+        config=dict(kind="fleet", n_hosts=n_hosts, rounds=rounds,
+                    period_s=period, patience=patience,
+                    stop_epoch=stop_epoch, chaos=chaos),
+        note=(f"host-level control plane: {n_hosts} REAL processes form "
+              "a fleet over one shared train_dir, partition@ cuts host 1 "
+              "off the lease store, the leader shrinks, heal re-admits "
+              "(epoch 0->1->2); gated on `report --fleet --strict` rc=0 "
+              "and a bit-exact live-reshard kill->restart->resume drill "
+              "in-row; semantics evidence, not a chip-speed claim"),
+    )
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    # the resume drill crosses process generations; the shared compile
+    # cache's round-trip is not bit-faithful on this backend (measured —
+    # the config-20 caveat), so the children must never inherit it
+    env.pop("ATOMO_COMPILE_CACHE", None)
+
+    work = tempfile.mkdtemp(prefix="atomo_fleet_bench_")
+    try:
+        # ---- gate 1: the 2-process lease drill, report-gated ----
+        d = os.path.join(work, "fleet")
+        t0 = time.perf_counter()
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "atomo_tpu.fleet.launcher",
+                 "--train-dir", d, "--host-id", str(i),
+                 "--n-hosts", str(n_hosts), "--rounds", str(rounds),
+                 "--period", str(period), "--patience", str(patience),
+                 "--stop-epoch", str(stop_epoch), "--max-seconds", "60",
+                 "--chaos", chaos],
+                env=env, cwd=repo, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True)
+            for i in range(n_hosts)
+        ]
+        results = {}
+        # drain concurrently: a full stderr pipe on the not-yet-drained
+        # member would wedge a sequential communicate()
+        with concurrent.futures.ThreadPoolExecutor(n_hosts) as pool:
+            outs = list(pool.map(lambda p: p.communicate(timeout=120),
+                                 procs))
+        for p, (out, err) in zip(procs, outs):
+            if p.returncode != 0:
+                base.update(measurement_valid=False,
+                            invalid_reason="fleet member process failed",
+                            error=err[-2000:])
+                return base
+            for line in out.splitlines():
+                if line.startswith("RESULT "):
+                    r = json.loads(line[len("RESULT "):])
+                    results[r["host"]] = r
+        drill_s = time.perf_counter() - t0
+        if sorted(results) != list(range(n_hosts)):
+            base.update(measurement_valid=False,
+                        invalid_reason="missing RESULT line from a member")
+            return base
+        full_cycle = all(
+            r["member"] and r["epoch"] == stop_epoch
+            and r["world"] == n_hosts for r in results.values()
+        )
+        rep = subprocess.run(
+            [sys.executable, "-m", "atomo_tpu.cli", "report",
+             "--train-dir", d, "--fleet", "--strict"],
+            env=env, cwd=repo, capture_output=True, text=True,
+            timeout=120,
+        )
+        report_ok = rep.returncode == 0 and "consistency: OK" in rep.stdout
+
+        # ---- gate 2: live reshard + kill->restart->resume, bit-exact ----
+        train = [
+            sys.executable, "-m", "atomo_tpu.cli", "train",
+            "--synthetic", "--dataset", "mnist", "--network", "lenet",
+            "--batch-size", "12", "--eval-freq", "0", "--save-freq", "2",
+            "--log-interval", "1", "--code", "qsgd",
+            "--quantization-level", "8", "--aggregate", "gather",
+            "--grad-guard", "--elastic", "--elastic-patience", "2",
+            "--n-devices", "4", "--max-steps", "10",
+        ]
+        tenv = dict(
+            env, XLA_FLAGS="--xla_force_host_platform_device_count=4"
+        )
+        d1 = os.path.join(work, "live")
+        p1 = subprocess.run(
+            train + ["--train-dir", d1, "--chaos", "die@3:1"],
+            env=tenv, cwd=repo, capture_output=True, text=True,
+            timeout=300,
+        )
+        d2 = os.path.join(work, "crashed")
+        p2 = subprocess.run(
+            train + ["--train-dir", d2, "--chaos", "die@3:1,kill@7",
+                     "--max-restarts", "1", "--restart-backoff", "0.05"],
+            env=tenv, cwd=repo, capture_output=True, text=True,
+            timeout=300,
+        )
+        resume_ok = (
+            p1.returncode == 0 and p2.returncode == 0
+            and "Elastic: LIVE shrink 4 -> 3" in p1.stdout
+            and "reshaped before the crash; restarting with --n-devices 3"
+            in p2.stdout
+        )
+        if resume_ok:
+            from atomo_tpu.training.checkpoint import _read_state_dict
+
+            import jax as _jax
+
+            for s in (8, 10):
+                la = _jax.tree_util.tree_leaves(_read_state_dict(d1, s))
+                lb = _jax.tree_util.tree_leaves(_read_state_dict(d2, s))
+                if len(la) != len(lb) or not all(
+                    np.array_equal(np.asarray(x), np.asarray(y))
+                    for x, y in zip(la, lb)
+                ):
+                    resume_ok = False
+
+        base.update(
+            value=round(drill_s, 3),
+            vs_baseline=None, baseline="none",
+            fleet_full_cycle=full_cycle,
+            fleet_report_strict_ok=report_ok,
+            fleet_cut_rounds=int(results[n_hosts - 1].get("cut_rounds", 0)),
+            resume_bit_exact=resume_ok,
+            measurement_valid=bool(full_cycle and report_ok and resume_ok),
+        )
+        if not base["measurement_valid"]:
+            failed = [name for name, ok in [
+                ("full_cycle", full_cycle), ("report_strict", report_ok),
+                ("resume_bit_exact", resume_ok)] if not ok]
+            base["invalid_reason"] = f"gate(s) failed: {', '.join(failed)}"
+        return base
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def measure_ours(cfg: dict) -> dict:
     import jax
     import jax.numpy as jnp
@@ -3809,6 +3998,8 @@ def measure_ours(cfg: dict) -> dict:
         return measure_lm_wire(cfg)
     if cfg.get("kind") == "lmdelayed":
         return measure_lm_delayed_overlap(cfg)
+    if cfg.get("kind") == "fleet":
+        return measure_fleet(cfg)
 
     model = get_model(cfg["network"], 10)
     opt = make_optimizer("sgd", lr=0.01, momentum=0.9)

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Bench smoke (~8 min): prove the bench entrypoint still emits parseable
-# evidence without burning the full-ladder window. Sixteen checks:
+# evidence without burning the full-ladder window. Nineteen checks:
 #
 #   1. config 7 (shipped-loop superstep) on the CPU backend in fast mode —
 #      the driver's last-line JSON contract, PLUS the partial-artifact
@@ -38,13 +38,15 @@
 #
 #   8. the elastic contract (<60 s, forced 4-device CPU mesh): a chaos
 #      die@3:1 run under --elastic must carry the dead replica masked,
-#      shrink to 3 devices at a checkpoint boundary WITHOUT burning a
-#      restart-budget slot, finish at the same step count as an
+#      then shrink to 3 devices at a checkpoint boundary LIVE — the
+#      fleet PR's in-process reshape default: ONE process start to
+#      finish, no rc=29 re-exec, no membership_change incident, no
+#      restart-budget slot — finish at the same step count as an
 #      uninterrupted run, and leave a parseable incidents.jsonl with
-#      membership records plus a membership.json epoch history — the
-#      PR-9 shrink-and-continue rung. (No ATOMO_COMPILE_CACHE here:
-#      sharing one cache dir across the re-exec'd different-world-size
-#      children corrupted executions on this backend — measured.)
+#      membership records (reshard="live" on the shrink epoch) plus a
+#      membership.json epoch history. (No ATOMO_COMPILE_CACHE here:
+#      the re-exec fallback shares cache dirs across different-world
+#      children, which corrupted executions on this backend — measured.)
 #
 #   9. the stream-encode contract (<60 s, forced 4-device CPU mesh):
 #      bench config 12 must exit 0 with the per-phase encode
@@ -136,6 +138,15 @@
 #      drill bit-exact (save -> fresh rebuild -> load -> place -> replay
 #      vs the uninterrupted run) — the PR-19 delayed-overlap tentpole.
 #
+#  19. the fleet contract (<60 s, NO collectives, any backend): two REAL
+#      fleet.launcher processes form a fleet over one shared train_dir,
+#      partition@ cuts host 1 off the lease store, the leader's
+#      transition function shrinks around the stale lease, heal
+#      re-admits it (membership epoch 0 -> 1 -> 2, full world back),
+#      and `report --fleet --strict` over the resulting per-host
+#      artifacts must exit 0 — the fleet-PR host-level control plane,
+#      gated on the report's own cross-host consistency checks.
+#
 # Wired next to scripts/tier1.sh: tier1 proves correctness, this proves
 # the bench entrypoint. Usage: scripts/bench_smoke.sh (from anywhere).
 cd "$(dirname "$0")/.." || exit 2
@@ -171,7 +182,7 @@ assert doc["complete"] is True and len(doc["rows"]) == 1, doc
 assert doc["rows"][0]["metric"] == row["metric"]
 state = "valid" if row["measurement_valid"] else \
     f"invalid ({row.get('invalid_reason')})"
-print(f"bench_smoke OK[1/18]: {row['metric']} = {row['value']} {row['unit']} "
+print(f"bench_smoke OK[1/19]: {row['metric']} = {row['value']} {row['unit']} "
       f"[{row['platform']}, {state}, K={row.get('superstep')}, "
       f"amortization={row.get('dispatch_amortization')}] + artifact")
 EOF
@@ -200,7 +211,7 @@ for k in ("encode_ms", "gather_exchange_ms", "gather_decode_ms",
           "ring_exchange_decode_ms", "gather_ms_per_step"):
     assert isinstance(row.get(k), (int, float)), f"missing phase field {k}: {row}"
 assert row["aggregation_bit_parity"] is True, row
-print(f"bench_smoke OK[2/18]: ring {row['value']} vs gather "
+print(f"bench_smoke OK[2/19]: ring {row['value']} vs gather "
       f"{row['gather_ms_per_step']} ms/step; phases enc={row['encode_ms']} "
       f"gx={row['gather_exchange_ms']} gdec={row['gather_decode_ms']} "
       f"ring_xdec={row['ring_exchange_decode_ms']} ms; bit_parity=True")
@@ -237,7 +248,7 @@ for k in ("compute_ms", "encode_ms", "exchange_ms", "decode_ms",
           "hidden_ms", "exposed_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 win = row.get("overlap_win_codecs")
-print(f"bench_smoke OK[3/18]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
+print(f"bench_smoke OK[3/19]: delayed {cods['qsgd8']['delayed_ms_per_step']} "
       f"vs blocking {cods['qsgd8']['blocking_ms_per_step']} ms/step "
       f"(speedup {cods['qsgd8']['overlap_speedup']}, win_codecs={win}); "
       f"phases comp={ph['compute_ms']} enc={ph['encode_ms']} "
@@ -268,7 +279,7 @@ doc = json.load(open(sys.argv[1]))  # must parse despite the SIGKILL
 assert doc["complete"] is False
 assert isinstance(doc["rows"], list)  # completed rows (possibly none yet)
 assert doc["tpu_probe"] is not None  # probe diagnostics recorded up front
-print(f"bench_smoke OK[4/18]: killed ladder left a parseable artifact "
+print(f"bench_smoke OK[4/19]: killed ladder left a parseable artifact "
       f"({len(doc['rows'])} completed rows, probe recorded)")
 EOF
 
@@ -295,7 +306,7 @@ causes = [r["cause"] for r in recs]
 assert causes == ["crash", "crash", "clean_exit"], causes
 assert recs[-1]["action"] == "done" and recs[-1]["attempt"] == 2, recs[-1]
 assert all(r["backoff_s"] > 0 for r in recs[:2]), recs
-print(f"bench_smoke OK[5/18]: crashloop@2 recovered on attempt 2 under "
+print(f"bench_smoke OK[5/19]: crashloop@2 recovered on attempt 2 under "
       f"budget; incident log parses ({len(recs)} records)")
 EOF
 [ $? -ne 0 ] && exit 1
@@ -328,7 +339,7 @@ for r in probed:
     assert isinstance(r.get("measured_ms_per_step"), (int, float)), r
     assert isinstance(r.get("predicted_ms_per_step"), (int, float)), r
 assert doc.get("why"), doc
-print(f"bench_smoke OK[6/18]: --auto tune picked {win['name']} "
+print(f"bench_smoke OK[6/19]: --auto tune picked {win['name']} "
       f"({win.get('measured_ms_per_step')} ms/step measured, "
       f"{len(probed)}/{len(doc['rows'])} candidates probed); "
       "decision artifact parses")
@@ -372,7 +383,7 @@ for p in plans:
     assert isinstance(p.get("predicted_ms_per_step"), (int, float)), p
 td = row.get("tune_decision") or {}
 assert td.get("hierarchical_probed"), row
-print(f"bench_smoke OK[7/18]: two-tier plans "
+print(f"bench_smoke OK[7/19]: two-tier plans "
       f"{[p['plan'] for p in plans]} measured with per-tier "
       "predicted-vs-measured bytes matching, per-plan bit_parity=True; "
       f"mini-tune probed {td['hierarchical_probed']} "
@@ -380,7 +391,11 @@ print(f"bench_smoke OK[7/18]: two-tier plans "
 EOF
 [ $? -ne 0 ] && exit 1
 
-# --- 8: elastic shrink-and-continue drill --------------------------------
+# --- 8: elastic shrink-and-continue drill (LIVE reshard default) ---------
+# since the fleet PR the default membership boundary is the in-process
+# live reshape (params + momentum re-sliced, NO rc=29 re-exec): ONE
+# process start to finish, no membership_change incident, reshard="live"
+# stamped on the shrink epoch's membership record
 el="$art/elastic"
 out=$(timeout -k 5 60 env JAX_PLATFORMS=cpu ATOMO_COMPILE_CACHE= \
       XLA_FLAGS="--xla_force_host_platform_device_count=4" \
@@ -396,6 +411,11 @@ if [ $rc -ne 0 ]; then
   printf '%s\n' "$out" | tail -5
   exit 1
 fi
+case "$out" in
+  *"Elastic: LIVE shrink 4 -> 3"*) : ;;
+  *) echo "bench_smoke FAIL: live shrink log line missing"
+     printf '%s\n' "$out" | tail -5; exit 1 ;;
+esac
 python - "$el" <<'EOF'
 import json, os, sys
 
@@ -406,13 +426,16 @@ worlds = [(e["epoch"], e["world_size"], e["reason"]) for e in mem["epochs"]]
 assert worlds == [(0, 4, "init"), (1, 3, "shrink")], worlds
 assert mem["epochs"][1]["dead"] == [1], mem["epochs"][1]
 # incidents.jsonl parses and carries the membership records; the reshape
-# was a planned transition — no crash, no budget slot burned
+# was a planned IN-PROCESS transition — no crash, no budget slot burned,
+# and no membership_change (that incident belongs to the re-exec
+# fallback protocol, which must NOT have run)
 recs = [json.loads(l) for l in open(os.path.join(d, "incidents.jsonl"))]
 memrec = [r for r in recs if r["cause"] == "membership"]
 assert len(memrec) >= 1, recs
 assert [r["action"] for r in memrec] == ["begin", "shrink"], memrec
-reshape = [r for r in recs if r["cause"] == "membership_change"]
-assert len(reshape) == 1 and reshape[0]["world"] == 3, recs
+assert memrec[1]["reshard"] == "live", memrec
+assert not any(r["cause"] == "membership_change" for r in recs), recs
+assert not any(r.get("action") == "reshard_fallback" for r in recs), recs
 assert not any(r["cause"] in ("crash", "budget_exhausted") for r in recs), recs
 assert recs[-1]["cause"] == "clean_exit", recs
 # final step count matches the uninterrupted run (max-steps 8)
@@ -420,15 +443,16 @@ sys.path.insert(0, ".")
 from atomo_tpu.training.checkpoint import latest_valid_step
 
 assert latest_valid_step(d) == 8, latest_valid_step(d)
-print("bench_smoke OK[8/18]: die@3:1 shrank 4 -> 3 at a checkpoint "
-      "boundary (planned reshape, restart budget untouched), finished at "
+print("bench_smoke OK[8/19]: die@3:1 shrank 4 -> 3 LIVE in-process "
+      "(no re-exec, restart budget untouched), finished at "
       f"step {latest_valid_step(d)} with membership epochs "
       f"{[w[0] for w in worlds]} recorded")
 EOF
+[ $? -ne 0 ] && exit 1
 
 # --- 9: config 12, stream-encode exposure contract -----------------------
-out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
-      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+out=$(timeout -k 5 120 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=110 \
       ATOMO_BENCH_ARTIFACT="$art/c12.json" \
       python bench.py --config 12 --no-baseline 2>/dev/null)
 rc=$?
@@ -456,7 +480,7 @@ for k in ("compute_ms", "encode_monolithic_ms", "encode_streamed_ms",
           "encode_hidden_stream_ms"):
     assert isinstance(ph.get(k), (int, float)), (k, row)
 assert int(ph.get("n_buckets", 0)) > 1, row
-print(f"bench_smoke OK[9/18]: stream {row['value']} vs off "
+print(f"bench_smoke OK[9/19]: stream {row['value']} vs off "
       f"{row['off_ms_per_step']} ms/step; exposed encode "
       f"{ph['encode_exposed_stream_ms']} (stream, {ph['n_buckets']} "
       f"buckets) vs {ph['encode_exposed_off_ms']} (off) ms; "
@@ -505,7 +529,7 @@ assert doc["consistent"] is True, doc["checks"]
 ran = [c["name"] for c in doc["checks"] if not c["skipped"]]
 segs = [e for e in doc["timeline"] if e["kind"] == "metrics"]
 assert segs and segs[0]["first_step"] == 1 and segs[-1]["last_step"] == 6
-print("bench_smoke OK[10/18]: recorder+quality run left "
+print("bench_smoke OK[10/19]: recorder+quality run left "
       f"{len(steps)} step records ({len(steps[0]['q_rel'])}-layer "
       "quality columns), report verb joined a consistent timeline "
       f"(checks ran: {ran})")
@@ -513,8 +537,8 @@ EOF
 [ $? -ne 0 ] && exit 1
 
 # --- 11: config 13, sparse-vs-dense wire contract ------------------------
-out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
-      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+out=$(timeout -k 5 120 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=110 \
       ATOMO_BENCH_ARTIFACT="$art/c13.json" \
       python bench.py --config 13 --no-baseline 2>/dev/null)
 rc=$?
@@ -545,7 +569,7 @@ for l in layers:
     assert 0.0 <= l["density"] <= 1.0, l
     if l["assignment"] == "sparse":
         assert l["payload_bytes"] < l["dense_bytes"], l
-print(f"bench_smoke OK[11/18]: hybrid {row['hybrid_wire_bytes']} B vs "
+print(f"bench_smoke OK[11/19]: hybrid {row['hybrid_wire_bytes']} B vs "
       f"all-dense {row['alldense_wire_bytes']} B on the wire "
       f"({row['wire_reduction']}x reduction, "
       f"{len(plan['sparse_leaves'])}/{plan['n_leaves']} leaves sparse); "
@@ -555,8 +579,8 @@ EOF11
 [ $? -ne 0 ] && exit 1
 
 # --- 12: config 14, fabric probe + measured-fabric parity contract ------
-out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
-      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+out=$(timeout -k 5 120 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=110 \
       ATOMO_COMPILE_CACHE="$art/xla" \
       ATOMO_BENCH_ARTIFACT="$art/c14.json" \
       python bench.py --config 14 --no-baseline 2>/dev/null)
@@ -589,7 +613,7 @@ assert set(ratios) == {"ici", "dcn"} and all(
 # even on a contended host
 assert row["fabric_parity"] is True, row
 assert row["run_artifact_complete"] is True, row
-print(f"bench_smoke OK[12/18]: probed ici {tiers['ici']['bandwidth_gbps']} "
+print(f"bench_smoke OK[12/19]: probed ici {tiers['ici']['bandwidth_gbps']} "
       f"/ dcn {tiers['dcn']['bandwidth_gbps']} GB/s/chip "
       f"({tiers['ici']['latency_us']} / {tiers['dcn']['latency_us']} "
       "us/hop); measured-vs-preset ratios recorded; measured-priced vs "
@@ -598,8 +622,8 @@ EOF12
 [ $? -ne 0 ] && exit 1
 
 # --- 13: config 15, sharded-update memory + bit-parity contract ----------
-out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
-      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+out=$(timeout -k 5 120 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=110 \
       ATOMO_COMPILE_CACHE="$art/xla" \
       ATOMO_BENCH_ARTIFACT="$art/c15.json" \
       python bench.py --config 15 --no-baseline 2>/dev/null)
@@ -630,7 +654,7 @@ assert shd < z1 < rep, (rep, z1, shd)
 assert row["state_bytes_reduction"] > 1.5, row
 for part in ("replicated", "zero1", "sharded_update"):
     assert row[f"{part}_ms_per_step"] > 0, row
-print(f"bench_smoke OK[13/18]: per-chip state {rep} -> {z1} (zero1) -> "
+print(f"bench_smoke OK[13/19]: per-chip state {rep} -> {z1} (zero1) -> "
       f"{shd} B (sharded-update, {row['state_bytes_reduction']}x); "
       f"ms/step {row['replicated_ms_per_step']} / "
       f"{row['zero1_ms_per_step']} / {row['sharded_update_ms_per_step']}; "
@@ -639,8 +663,8 @@ EOF13
 [ $? -ne 0 ] && exit 1
 
 # --- 14: config 16, adaptive-budget Pareto + wire-match contract ---------
-out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=10 \
-      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+out=$(timeout -k 5 120 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=10 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=110 \
       ATOMO_BENCH_ARTIFACT="$art/c16.json" \
       python bench.py --config 16 --no-baseline 2>/dev/null)
 rc=$?
@@ -670,7 +694,7 @@ assert row["measured_variance_reduction"] > 0, row
 assert row["pareto_loss_ok"] is True, row
 # gate 4: bit-exact resume from the recorded allocation artifact
 assert row["resume_bit_exact"] is True, row
-print(f"bench_smoke OK[14/18]: variance alloc {alloc['variance_ks']} vs "
+print(f"bench_smoke OK[14/19]: variance alloc {alloc['variance_ks']} vs "
       f"uniform {alloc['uniform_ks']} at "
       f"{row['variance_row']['wire_bytes']} <= "
       f"{row['uniform_row']['wire_bytes']} B wire; measured q_err2 "
@@ -682,8 +706,8 @@ EOF14
 [ $? -ne 0 ] && exit 1
 
 # --- 15: config 17, quorum straggler-absorption contract -----------------
-out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=5 \
-      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+out=$(timeout -k 5 120 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=5 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=110 \
       ATOMO_COMPILE_CACHE="$art/xla" \
       ATOMO_BENCH_ARTIFACT="$art/c17.json" \
       python bench.py --config 17 --no-baseline 2>/dev/null)
@@ -714,7 +738,7 @@ assert row["schedule_steps_recorded"] > 0, row
 # gates quorum < blocking)
 assert row["straggler_absorption_speedup"] > 1, row
 assert row["stale_dropped"] == 0, row
-print(f"bench_smoke OK[15/18]: quorum {row['value']} vs blocking "
+print(f"bench_smoke OK[15/19]: quorum {row['value']} vs blocking "
       f"{row['blocking_ms_per_step']} ms/step under one slow@ replica "
       f"({row['straggler_absorption_speedup']}x absorbed) at equal wire "
       f"({row['msg_bytes']} B); {row['schedule_steps_recorded']}-step "
@@ -723,8 +747,12 @@ EOF15
 [ $? -ne 0 ] && exit 1
 
 # --- 16: config 18, global-controller joint-decision contract ------------
-out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 \
-      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+# NOTE: the joint_not_slower gate compares two measured probes under a
+# 1.25x noise tolerance — on a contended 1-core box the accumulated load
+# of the 15 prior checks can push it over. If ONLY this check fails,
+# re-run checks 16-19 in isolation before treating it as a regression.
+out=$(timeout -k 5 120 env ATOMO_BENCH_FAST=1 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=110 \
       ATOMO_COMPILE_CACHE="$art/xla" \
       ATOMO_BENCH_ARTIFACT="$art/c18.json" \
       python bench.py --config 18 --no-baseline 2>/dev/null)
@@ -759,7 +787,7 @@ assert row["pin_bit_parity"] is True, row
 assert row["pin_equal_wire"] is True, row
 assert row["resume_reusable"] is True, row
 assert row["resume_bit_parity"] is True, row
-print(f"bench_smoke OK[16/18]: controller picked "
+print(f"bench_smoke OK[16/19]: controller picked "
       f"{row['joint_winner']['name']} "
       f"({row['value']} ms/step vs best standalone "
       f"{row['best_single_ms_per_step']}); artifact-pin bit-exact at "
@@ -768,8 +796,8 @@ EOF16
 [ $? -ne 0 ] && exit 1
 
 # --- 17: config 19, model-axis compressed-dp-wire contract ---------------
-out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
-      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+out=$(timeout -k 5 120 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=110 \
       ATOMO_COMPILE_CACHE="$art/xla" \
       ATOMO_BENCH_ARTIFACT="$art/c19.json" \
       python bench.py --config 19 --no-baseline 2>/dev/null)
@@ -798,7 +826,7 @@ assert row["degeneracy_bit_parity"] is True, row
 assert row["byte_reduction"] > 1, row
 # and the seed ensemble says the wire saving is not bought with loss
 assert row["loss_no_worse"] is True, row
-print(f"bench_smoke OK[17/18]: dp2xtp2 LM compressed dp wire "
+print(f"bench_smoke OK[17/19]: dp2xtp2 LM compressed dp wire "
       f"{row['msg_bytes']} B vs dense {row['dense_bytes']} B "
       f"({row['byte_reduction']}x), predicted == executed to the byte; "
       f"scoped-vs-legacy bit-exact; ensemble loss "
@@ -815,8 +843,8 @@ EOF17
 # deterministic resume-drill divergence with any cache dir set.
 # bench.py strips ATOMO_COMPILE_CACHE from the config-20 child too
 # (CONFIGS[20]["no_compile_cache"]), so this is belt and suspenders.
-out=$(timeout -k 5 60 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
-      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=55 \
+out=$(timeout -k 5 120 env ATOMO_BENCH_FAST=1 ATOMO_BENCH_STEPS=3 \
+      ATOMO_BENCH_RETRIES=1 ATOMO_BENCH_DEADLINE_S=110 \
       ATOMO_COMPILE_CACHE="" \
       ATOMO_BENCH_ARTIFACT="$art/c20.json" \
       python bench.py --config 20 --no-baseline 2>/dev/null)
@@ -845,7 +873,7 @@ assert row["equal_wire"] is True, row
 assert row["resume_bit_exact"] is True, row
 # the modelled account rides in-row, bubble credit included
 assert "bubble_hidden_ms" in row["overlap_model"], row
-print(f"bench_smoke OK[18/18]: dp2xpp2 LM delayed overlap "
+print(f"bench_smoke OK[18/19]: dp2xpp2 LM delayed overlap "
       f"{row['value']} ms/step vs blocking "
       f"{row['blocking_ms_per_step']} ms/step at equal wire "
       f"({row['msg_bytes']} B); off-HLO identical, oracle + resume "
@@ -853,4 +881,59 @@ print(f"bench_smoke OK[18/18]: dp2xpp2 LM delayed overlap "
 EOF18
 [ $? -ne 0 ] && exit 1
 
-echo "bench_smoke: all 18 checks passed"
+# --- 19: fleet control plane, 2 REAL processes ---------------------------
+# form -> partition@ cuts host 1 off the lease store -> the leader's
+# transition function shrinks around the stale lease -> heal re-admits
+# (epoch 0 -> 1 -> 2, full world back). No collectives, no coordinator:
+# leases over the shared train_dir are the only channel, so this runs on
+# ANY backend. The gate is the fleet report's own cross-host checks:
+# `report --fleet --strict` must exit 0 (every host's recorded epochs
+# consistent with membership.json, every lease gap explained by a
+# recorded incident).
+fl="$art/fleet"
+for i in 0 1; do
+  timeout -k 5 60 env JAX_PLATFORMS=cpu \
+      python -m atomo_tpu.fleet.launcher --train-dir "$fl" \
+      --host-id "$i" --n-hosts 2 --rounds 400 --period 0.05 \
+      --patience 4 --stop-epoch 2 --max-seconds 50 \
+      --chaos partition@3:0-1:0.8 > "$art/fleet_host$i.out" 2>&1 &
+  eval "fpid$i=$!"
+done
+wait "$fpid0"; rc0=$?
+wait "$fpid1"; rc1=$?
+if [ $rc0 -ne 0 ] || [ $rc1 -ne 0 ]; then
+  echo "bench_smoke FAIL: fleet member exited rc0=$rc0 rc1=$rc1"
+  tail -5 "$art/fleet_host0.out" "$art/fleet_host1.out"
+  exit 1
+fi
+rep=$(timeout -k 5 60 env JAX_PLATFORMS=cpu \
+      python -m atomo_tpu.cli report --train-dir "$fl" --fleet --strict 2>&1)
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "bench_smoke FAIL: report --fleet --strict exited rc=$rc"
+  printf '%s\n' "$rep" | tail -10
+  exit 1
+fi
+python - "$art/fleet_host0.out" "$art/fleet_host1.out" <<'EOF19'
+import json, sys
+
+rs = {}
+for path in sys.argv[1:]:
+    for line in open(path):
+        if line.startswith("RESULT "):
+            r = json.loads(line[len("RESULT "):])
+            rs[r["host"]] = r
+assert sorted(rs) == [0, 1], f"missing RESULT lines: {sorted(rs)}"
+for r in rs.values():
+    # full cycle: back to membership at full world after shrink + regrow
+    assert r["member"] and r["epoch"] == 2 and r["world"] == 2, r
+assert rs[0]["roster_hash"] == rs[1]["roster_hash"], rs
+assert rs[1]["cut_rounds"] > 0, rs[1]  # the partition really cut it
+print("bench_smoke OK[19/19]: 2-process fleet drill "
+      "form->partition->shrink->heal->regrow (epoch 0->1->2, "
+      f"host 1 cut {rs[1]['cut_rounds']} rounds), "
+      "report --fleet --strict rc=0")
+EOF19
+[ $? -ne 0 ] && exit 1
+
+echo "bench_smoke: all 19 checks passed"

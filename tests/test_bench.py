@@ -29,7 +29,7 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     monkeypatch.setattr(sys, "argv", ["bench.py"])
     assert bench.main() == 0
     assert order == [2, 1, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16,
-                     17, 18, 19, 20]
+                     17, 18, 19, 20, 21]
 
     lines = [
         json.loads(ln)
@@ -43,7 +43,7 @@ def test_ladder_runs_headline_config_first(monkeypatch, capsys):
     assert [c["metric"] for c in aggs[-1]["configs"]] == [
         "m1", "m2", "m3", "m4", "m5", "m6", "m7", "m8", "m9", "m10",
         "m11", "m12", "m13", "m14", "m15", "m16", "m17", "m18", "m19",
-        "m20"
+        "m20", "m21"
     ]
     # an aggregate exists right after the FIRST config completes
     assert "configs" in lines[1]
@@ -181,7 +181,7 @@ def test_artifact_rows_written_atomically_as_they_complete(
     assert [r["metric"] for r in doc["rows"]] == [
         "m2", "m1", "m3", "m4", "m5", "m6", "m7", "m8", "m9", "m10",
         "m11", "m12", "m13", "m14", "m15", "m16", "m17", "m18", "m19",
-        "m20"
+        "m20", "m21"
     ]
     # atomicity: no torn temp file left behind
     assert not list(tmp_path.glob("*.tmp.*"))

@@ -799,12 +799,17 @@ def _leaves(train_dir, step):
 def test_die_shrink_matches_fresh_small_world_bit_exact(tmp_path):
     """Acceptance (a): the shrunken epoch of a die@S drill is leaf-wise
     BIT-exact with a fresh --n-devices N-1 run resumed from the same
-    healthy checkpoint (same stream skip, same roster, same program)."""
+    healthy checkpoint (same stream skip, same roster, same program).
+
+    Pinned to ``--elastic-reshard reexec``: this drill proves the
+    supervisor re-exec protocol specifically (the recorded fallback
+    path); the live in-process primary path has its own witness in
+    test_live_reshard_shrink_matches_fresh_small_world_bit_exact."""
     d1 = tmp_path / "drill"
     p = _cli_elastic(
         d1, "--n-devices", "4", "--max-steps", "10",
         "--chaos", "die@3:1", "--max-restarts", "1",
-        "--restart-backoff", "0.05",
+        "--restart-backoff", "0.05", "--elastic-reshard", "reexec",
     )
     assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
     log = MembershipLog.load(str(d1))
@@ -844,10 +849,13 @@ def test_die_shrink_regrow_records_epochs_in_order(tmp_path):
     from atomo_tpu.utils.tracing import IncidentLog
 
     d = tmp_path / "drill"
+    # pinned to reexec: the asserted membership_change incident stream
+    # (world [3, 4]) only exists on the supervisor re-exec path
     p = _cli_elastic(
         d, "--n-devices", "4", "--max-steps", "12",
         "--chaos", "die@3:1", "--readmit-at", "6",
         "--max-restarts", "1", "--restart-backoff", "0.05",
+        "--elastic-reshard", "reexec",
     )
     assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
     assert latest_valid_step(str(d)) == 12  # same step count as a clean run
@@ -866,3 +874,111 @@ def test_die_shrink_regrow_records_epochs_in_order(tmp_path):
         r["cause"] in ("crash", "budget_exhausted") for r in recs
     )
     assert recs[-1]["cause"] == "clean_exit"
+
+
+# ---------------- live reshard drills (the zero-downtime primary path)
+
+
+def test_live_reshard_shrink_matches_fresh_small_world_bit_exact(tmp_path):
+    """THE tentpole witness: under the default ``--elastic-reshard
+    live`` a die@ shrink reshapes IN PROCESS — rc=0, ONE process, no
+    re-exec — and the continued trajectory is leaf-wise BIT-exact with
+    a fresh --n-devices N-1 run resumed from the shrink checkpoint."""
+    from atomo_tpu.utils.tracing import IncidentLog
+
+    d1 = tmp_path / "drill"
+    p = _cli_elastic(
+        d1, "--n-devices", "4", "--max-steps", "10",
+        "--chaos", "die@3:1",
+    )
+    assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
+    assert "Elastic: LIVE shrink 4 -> 3" in p.stdout
+    # no supervisor fallback: the whole run was one process
+    assert "falling back to the re-exec protocol" not in p.stdout
+    log = MembershipLog.load(str(d1))
+    assert [(e.epoch, e.world_size) for e in log.epochs] == [(0, 4), (1, 3)]
+    shrink_step = log.epochs[1].start_step
+    recs = IncidentLog.read(str(d1 / "incidents.jsonl"))
+    mem = [r for r in recs if r["cause"] == "membership"]
+    assert [r["action"] for r in mem] == ["begin", "shrink"]
+    assert mem[1]["reshard"] == "live"
+    # the re-exec protocol's incident never fired
+    assert not any(r["cause"] == "membership_change" for r in recs)
+
+    d2 = tmp_path / "fresh"
+    d2.mkdir()
+    import shutil
+
+    shutil.copy(d1 / f"model_step_{shrink_step}", d2)
+    fresh_log = MembershipLog.load(str(d2))
+    for e in log.epochs:
+        fresh_log.append(e)
+    p2 = _cli_elastic(
+        d2, "--n-devices", "3", "--max-steps", "10", "--resume"
+    )
+    assert p2.returncode == 0, (p2.stdout[-2000:], p2.stderr[-2000:])
+    for s in range(shrink_step + 2, 11, 2):
+        la, lb = _leaves(d1, s), _leaves(d2, s)
+        assert len(la) == len(lb)
+        for x, y in zip(la, lb):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), s
+
+
+@pytest.mark.slow
+def test_live_reshard_refusal_records_fallback_and_reexecs(tmp_path):
+    """When the live path cannot hold its determinism contract (the
+    fused superstep's block feed is world-shaped) the coordinator
+    REFUSES out loud — a ``reshard_fallback`` incident quoting why —
+    and the supervisor re-exec protocol runs exactly as before."""
+    from atomo_tpu.utils.tracing import IncidentLog
+
+    d = tmp_path / "drill"
+    p = _cli_elastic(
+        d, "--n-devices", "4", "--max-steps", "10",
+        "--chaos", "die@3:1", "--superstep", "2",
+        "--max-restarts", "1", "--restart-backoff", "0.05",
+    )
+    assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
+    assert "falling back to the re-exec protocol" in p.stdout
+    log = MembershipLog.load(str(d))
+    assert [(e.epoch, e.world_size) for e in log.epochs] == [(0, 4), (1, 3)]
+    recs = IncidentLog.read(str(d / "incidents.jsonl"))
+    fb = [r for r in recs if r.get("action") == "reshard_fallback"]
+    assert len(fb) == 1 and "superstep" in fb[0]["reason"]
+    # the fallback ran the full re-exec protocol, recorded as ever
+    assert any(r["cause"] == "membership_change" for r in recs)
+
+
+@pytest.mark.slow
+def test_live_reshard_then_crash_restart_resumes_at_new_world(tmp_path):
+    """Satellite: a live reshape advances the membership epoch WITHOUT
+    rc=29, so a LATER crash must restart at the membership.json world,
+    not the stale launch world — the supervisor's crash path re-derives
+    --n-devices from the recorded epoch, and the replay is bit-exact
+    with the uninterrupted live drill."""
+    d1 = tmp_path / "drill"
+    p = _cli_elastic(
+        d1, "--n-devices", "4", "--max-steps", "10",
+        "--chaos", "die@3:1",
+    )
+    assert p.returncode == 0, (p.stdout[-2000:], p.stderr[-2000:])
+
+    d2 = tmp_path / "crashed"
+    p2 = _cli_elastic(
+        d2, "--n-devices", "4", "--max-steps", "10",
+        "--chaos", "die@3:1,kill@7", "--max-restarts", "1",
+        "--restart-backoff", "0.05",
+    )
+    assert p2.returncode == 0, (p2.stdout[-2000:], p2.stderr[-2000:])
+    assert "Elastic: LIVE shrink 4 -> 3" in p2.stdout
+    # the crash path re-derived the world from membership.json (the
+    # live reshape advanced the epoch without an rc=29 exit)
+    assert "reshaped before the crash; restarting with --n-devices 3" \
+        in p2.stdout
+    log = MembershipLog.load(str(d2))
+    assert [(e.epoch, e.world_size) for e in log.epochs] == [(0, 4), (1, 3)]
+    for s in (8, 10):
+        la, lb = _leaves(d1, s), _leaves(d2, s)
+        assert len(la) == len(lb)
+        for x, y in zip(la, lb):
+            assert np.array_equal(np.asarray(x), np.asarray(y)), s

@@ -1,0 +1,466 @@
+"""Fleet control plane (host-level leases -> membership epochs).
+
+Contracts pinned here:
+
+  * Lease expiry is MONOTONIC-BEAT based, never wall-clock: forged /
+    absurd ``ts`` values cannot change a staleness verdict (satellite:
+    two hosts with skewed clocks must not mutually evict each other).
+  * ``fold_leases`` is the pure transition function: shrink records the
+    dead, grow respects the re-admission budget and refuses OUT LOUD
+    when it is spent, a fleet of one is still viable.
+  * ``roster_hash`` is order-insensitive; ``current_roster_hash``
+    prefers the newest host-granularity membership epoch, falls back to
+    the lease files, and returns None on a pre-fleet train_dir.
+  * ``decision_reusable`` refuses a resume onto a DIFFERENT host roster
+    at the same device count, and states the pre-fleet fallback when
+    the artifact predates the roster record.
+  * The host-level chaos verbs (hostdie@ / slowlink@ / partition@)
+    parse, inject at the lease layer, and stay epoch-keyed.
+  * Two FleetControllers over one shared train_dir drill the full
+    story in process: form -> partition -> lease_stale -> shrink ->
+    heal -> stand_down -> re-admit -> budget-refusal, and the fleet
+    report's two checks hold over the artifacts they left.
+  * The REAL 2-process drill (subprocess launcher + jax.distributed
+    formation): form at world 2, shrink to 1, re-form, re-admit,
+    re-form again — gated on ``report --fleet --strict`` rc=0.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from atomo_tpu.elastic.membership import MembershipEpoch, MembershipLog
+from atomo_tpu.fleet.control import (
+    FleetConfig,
+    FleetController,
+    HostLease,
+    LeaseTracker,
+    current_roster_hash,
+    fold_leases,
+    host_metrics_path,
+    hosts_dir,
+    read_leases,
+    roster_hash,
+    write_lease,
+)
+from atomo_tpu.utils.chaos import ChaosConfig, ChaosInjector
+from atomo_tpu.utils.tracing import IncidentLog
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- leases
+
+
+def test_roster_hash_order_insensitive():
+    assert roster_hash([2, 0, 1]) == roster_hash((0, 1, 2))
+    assert roster_hash([0, 1]) != roster_hash([0, 2])
+
+
+def test_lease_roundtrip_and_torn_file_skipped(tmp_path):
+    d = str(tmp_path)
+    write_lease(d, HostLease(host_id=0, beat=3, epoch=1, step=7, ts=1.5))
+    write_lease(d, HostLease(host_id=2, beat=9))
+    with open(os.path.join(hosts_dir(d), "1.json"), "w") as f:
+        f.write('{"host_id": 1, "beat":')  # torn
+    leases = read_leases(d)
+    assert sorted(leases) == [0, 2]  # the torn lease reads as absent
+    assert leases[0].beat == 3 and leases[0].epoch == 1
+    assert leases[2].beat == 9
+
+
+def test_lease_staleness_is_beat_based_never_wallclock():
+    """Satellite witness: the tracker's verdict is a pure function of
+    the beat counters and the observer's own rounds — leases carrying
+    FORGED timestamps (ancient, far-future, jumping backwards) produce
+    exactly the same staleness verdicts."""
+    def drive(ts_fn):
+        t = LeaseTracker(patience=3)
+        verdicts = []
+        # rounds 1..4: host 1's beat advances (ancient/forged ts)
+        for r in range(1, 5):
+            t.observe({
+                0: HostLease(host_id=0, beat=r, ts=ts_fn(0, r)),
+                1: HostLease(host_id=1, beat=r, ts=ts_fn(1, r)),
+            })
+            verdicts.append(frozenset(t.stale()))
+        # rounds 5..8: host 1's beat FREEZES while its ts stays fresh
+        for r in range(5, 9):
+            t.observe({
+                0: HostLease(host_id=0, beat=r, ts=ts_fn(0, r)),
+                1: HostLease(host_id=1, beat=4, ts=ts_fn(1, r)),
+            })
+            verdicts.append(frozenset(t.stale()))
+        return verdicts
+
+    honest = drive(lambda h, r: 1000.0 + r)
+    forged = drive(
+        lambda h, r: [-1.0, 1e12, 0.0, 3.5e9][(h + r) % 4]  # garbage
+    )
+    assert honest == forged  # ts never reaches the verdict
+    assert honest[3] == frozenset()          # beating -> never stale
+    assert honest[-1] == frozenset({1})      # frozen beat -> stale
+    assert honest[5] == frozenset()          # ...but only past patience
+
+
+def test_lease_tracker_missing_file_and_formation_grace():
+    t = LeaseTracker(patience=2)
+    t.observe({0: HostLease(host_id=0, beat=1)}, expected=(0, 1))
+    assert t.stale() == set()        # host 1 never formed: grace round 1
+    t.observe({0: HostLease(host_id=0, beat=2)}, expected=(0, 1))
+    assert t.stale() == {1}          # grace spent at patience
+    # a lease file that disappears counts as a non-advancing beat
+    t2 = LeaseTracker(patience=2)
+    t2.observe({0: HostLease(host_id=0, beat=1),
+                1: HostLease(host_id=1, beat=1)})
+    t2.observe({0: HostLease(host_id=0, beat=2)})
+    t2.observe({0: HostLease(host_id=0, beat=3)})
+    assert t2.stale() == {1} and t2.alive() == {0}
+
+
+# ----------------------------------------------------- fold_leases
+
+
+def _epoch(epoch=0, roster=(0, 1, 2), reason="init", step=0):
+    return MembershipEpoch(
+        epoch=epoch, world_size=len(roster), roster=tuple(roster),
+        start_step=step, reason=reason,
+        detail={"granularity": "host"},
+    )
+
+
+def test_fold_leases_shrink_records_dead():
+    rec, why = fold_leases(
+        _epoch(), {0, 2}, step=9, full_roster=(0, 1, 2),
+        grows=0, max_regrows=1,
+    )
+    assert why is None
+    assert rec.epoch == 1 and rec.roster == (0, 2) and rec.dead == (1,)
+    assert rec.reason == "shrink" and rec.start_step == 9
+    # a fleet of ONE host is still viable (it holds a full local mesh)
+    rec2, _ = fold_leases(
+        rec, {0}, step=11, full_roster=(0, 1, 2), grows=0, max_regrows=1,
+    )
+    assert rec2.roster == (0,)
+    # ...but zero survivors is a refusal, not an epoch
+    rec3, why3 = fold_leases(
+        rec2, set(), step=12, full_roster=(0, 1, 2), grows=0,
+        max_regrows=1,
+    )
+    assert rec3 is None and "no surviving hosts" in why3
+
+
+def test_fold_leases_grow_and_budget_refusal():
+    cur = _epoch(epoch=1, roster=(0, 2), reason="shrink")
+    rec, why = fold_leases(
+        cur, {0, 1, 2}, step=20, full_roster=(0, 1, 2),
+        grows=0, max_regrows=1,
+    )
+    assert why is None and rec.reason == "grow" and rec.roster == (0, 1, 2)
+    # spent budget: refusal carries the human reason
+    rec2, why2 = fold_leases(
+        cur, {0, 1, 2}, step=20, full_roster=(0, 1, 2),
+        grows=1, max_regrows=1,
+    )
+    assert rec2 is None and "re-admission budget is spent" in why2
+    # steady state: nothing to do, no reason either
+    rec3, why3 = fold_leases(
+        _epoch(), {0, 1, 2}, step=5, full_roster=(0, 1, 2),
+        grows=0, max_regrows=1,
+    )
+    assert rec3 is None and why3 is None
+
+
+# ------------------------------------------------- current_roster_hash
+
+
+def test_current_roster_hash_sources(tmp_path):
+    d = str(tmp_path)
+    assert current_roster_hash(None) is None
+    assert current_roster_hash(d) is None  # pre-fleet: no evidence
+    # leases alone imply a roster
+    write_lease(d, HostLease(host_id=0, beat=1))
+    write_lease(d, HostLease(host_id=1, beat=1))
+    assert current_roster_hash(d) == roster_hash((0, 1))
+    # a host-granularity membership epoch WINS over the lease set
+    log = MembershipLog.load(d)
+    log.append(_epoch(epoch=0, roster=(0, 1, 2)))
+    assert current_roster_hash(d) == roster_hash((0, 1, 2))
+    # a replica-granularity epoch is NOT fleet evidence
+    d2 = str(tmp_path / "replica")
+    os.makedirs(d2)
+    log2 = MembershipLog.load(d2)
+    log2.append(MembershipEpoch(
+        epoch=0, world_size=4, roster=(0, 1, 2, 3), start_step=0,
+        reason="init",
+    ))
+    assert current_roster_hash(d2) is None
+
+
+def test_decision_reusable_fleet_roster_gate():
+    """Same device count, different hosts -> refuse out loud; an
+    artifact that PREDATES the roster record falls back to the device
+    count alone and SAYS so."""
+    from atomo_tpu.tuning.autopilot import decision_reusable
+
+    h = roster_hash((0, 1))
+    doc = {
+        "complete": True,
+        "winner": {"knobs": {"aggregate": "gather"}},
+        "meta": {"n_devices": 4, "fleet_roster_hash": h},
+    }
+    ok, why = decision_reusable(doc, n_dev=4, fleet_roster=h)
+    assert ok, why
+    other = roster_hash((0, 2))
+    ok, why = decision_reusable(doc, n_dev=4, fleet_roster=other)
+    assert not ok and h in why and other in why
+    assert "roster" in why
+    legacy = {
+        "complete": True,
+        "winner": {"knobs": {"aggregate": "gather"}},
+        "meta": {"n_devices": 4},
+    }
+    ok, why = decision_reusable(legacy, n_dev=4, fleet_roster=other)
+    assert ok
+    assert "predates the fleet roster record" in why
+
+
+# ------------------------------------------------------- chaos verbs
+
+
+def test_chaos_host_verbs_parse_and_reject():
+    cfg = ChaosConfig.from_spec(
+        "hostdie@3:1,slowlink@2:0:0.5,partition@4:0-1:2.0"
+    )
+    assert cfg.host_die_faults == ((3, 1),)
+    assert cfg.slowlink_faults == ((2, 0, 0.5),)
+    assert cfg.partition_faults == ((4, 0, 1, 2.0),)
+    with pytest.raises(ValueError, match="distinct"):
+        ChaosConfig.from_spec("partition@4:1-1:2.0")
+    with pytest.raises(ValueError, match="slowlink needs both"):
+        ChaosConfig.from_spec("slowlink@2:0")
+    with pytest.raises(ValueError, match="delay must be > 0"):
+        ChaosConfig.from_spec("slowlink@2:0:0")
+
+
+def test_chaos_partition_window_and_epoch_keying():
+    inj = ChaosInjector(
+        ChaosConfig.from_spec("partition@3:0-1:2.0"), membership_epoch=0
+    )
+    clock = iter([10.0, 10.5, 11.9, 12.5]).__next__
+    assert not inj.store_partitioned(2, 1, now=clock)  # before round 3
+    # conftest note: the first active round stamps t0 = 10.0
+    assert inj.store_partitioned(3, 1, now=lambda: 10.0)
+    assert inj.store_partitioned(4, 1, now=lambda: 11.9)   # inside 2 s
+    assert not inj.store_partitioned(5, 1, now=lambda: 12.5)  # healed
+    # the LOWER id of the pair keeps the store (colocation fence)
+    assert not inj.store_partitioned(3, 0, now=lambda: 10.0)
+    # epoch-keyed: a re-admitted host comes back healthy
+    inj2 = ChaosInjector(
+        ChaosConfig.from_spec("partition@3:0-1:2.0"), membership_epoch=1
+    )
+    assert not inj2.store_partitioned(3, 1, now=lambda: 10.0)
+    # slowlink: pure lag table, epoch-keyed the same way
+    s = ChaosInjector(
+        ChaosConfig.from_spec("slowlink@2:1:0.25"), membership_epoch=0
+    )
+    assert s.slowlink_delay(1, 1) == 0.0
+    assert s.slowlink_delay(2, 1) == 0.25
+    assert s.slowlink_delay(2, 0) == 0.0
+    s.membership_epoch = 1
+    assert s.slowlink_delay(2, 1) == 0.0
+
+
+# ------------------------------- two controllers, one store, in process
+
+
+def _drive(ctrl, r):
+    ctrl.heartbeat(step=r)
+    ctrl.observe()
+    status = ctrl.reconcile()
+    ctrl.maybe_transition(step=r)
+    ctrl.record_metrics(step=r, status=status)
+
+
+def test_two_controllers_full_story_and_fleet_report(tmp_path):
+    """Form -> host 1 silent -> lease_stale -> shrink -> heal ->
+    stand_down -> re-admit -> second death -> budget refusal; then the
+    fleet report's two checks hold over the artifacts this left."""
+    d = str(tmp_path)
+    cfg = FleetConfig(patience=2, period_s=0.01, max_regrows=1)
+    logs = []
+    c0 = FleetController(cfg, d, 0, 2, log_fn=logs.append)
+    c1 = FleetController(cfg, d, 1, 2, log_fn=logs.append)
+    c0.adopt()
+    c1.adopt()
+    for r in range(1, 4):          # both healthy
+        _drive(c0, r)
+        _drive(c1, r)
+    for r in range(4, 8):          # host 1 silent (partitioned)
+        _drive(c0, r)
+    log = MembershipLog.load(d)
+    assert [(e.epoch, tuple(e.roster)) for e in log.epochs] == [
+        (0, (0, 1)), (1, (0,))
+    ]
+    assert log.epochs[1].dead == (1,)
+    for r in range(8, 12):         # host 1 heals: stand down, re-admit
+        _drive(c1, r)
+        _drive(c0, r)
+    log = MembershipLog.load(d)
+    assert [(e.epoch, e.reason) for e in log.epochs] == [
+        (0, "init"), (1, "shrink"), (2, "grow")
+    ]
+    inc0 = IncidentLog.read(
+        os.path.join(hosts_dir(d), "0.incidents.jsonl")
+    )
+    assert any(r["cause"] == "lease_stale" and r["host"] == 1
+               for r in inc0)
+    inc1 = IncidentLog.read(
+        os.path.join(hosts_dir(d), "1.incidents.jsonl")
+    )
+    assert any(r.get("action") == "stand_down" for r in inc1)
+    # second death: shrink again, but the re-grow budget is spent
+    for r in range(12, 16):
+        _drive(c0, r)
+    for r in range(16, 19):
+        _drive(c1, r)
+        _drive(c0, r)
+    log = MembershipLog.load(d)
+    assert [e.reason for e in log.epochs] == [
+        "init", "shrink", "grow", "shrink"
+    ]
+    inc0 = IncidentLog.read(
+        os.path.join(hosts_dir(d), "0.incidents.jsonl")
+    )
+    refused = [r for r in inc0 if r.get("action") == "transition_refused"]
+    assert refused and "budget is spent" in refused[-1]["reason"]
+    # the leader is positional: host 1 never wrote membership.json
+    assert not any(
+        r.get("action") in ("shrink", "grow") for r in inc1
+    )
+
+    from atomo_tpu.obs.report import build_fleet_report
+
+    doc = build_fleet_report(d)
+    checks = {c["name"]: c for c in doc["checks"]}
+    for name in ("fleet_membership_consistent", "fleet_lease_gap_explained"):
+        assert not checks[name]["skipped"], checks[name]
+        assert checks[name]["ok"], checks[name]
+    assert doc["summary"]["final_roster"] == [0]
+    assert doc["summary"]["final_roster_hash"] == roster_hash((0,))
+
+
+def test_fleet_report_fails_on_unexplained_gap(tmp_path):
+    """A forged evidence stream with a hole and NO recorded explanation
+    must FAIL the gap check — silent evidence loss is the failure the
+    control plane exists to rule out."""
+    d = str(tmp_path)
+    log = MembershipLog.load(d)
+    log.append(_epoch(epoch=0, roster=(0,)))
+    os.makedirs(hosts_dir(d), exist_ok=True)
+    with open(host_metrics_path(d, 0), "a") as f:
+        for step in (1, 2, 9, 10):  # rounds 3..8 vanished, nobody said so
+            f.write(json.dumps({
+                "ts": 0.0, "host": 0, "round": step, "beat": step,
+                "step": step, "epoch": 0,
+            }) + "\n")
+
+    from atomo_tpu.obs.report import build_fleet_report
+
+    doc = build_fleet_report(d)
+    checks = {c["name"]: c for c in doc["checks"]}
+    gap = checks["fleet_lease_gap_explained"]
+    assert not gap["ok"]
+    assert "no lease_stale/stand_down/shrink record" in gap["detail"]
+    assert not doc["consistent"]
+
+
+# ------------------------------------- the real 2-process drill
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_member(train_dir, host_id, port, extra=()):
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": _REPO_ROOT + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    }
+    cmd = [
+        sys.executable, "-m", "atomo_tpu.fleet.launcher",
+        "--train-dir", str(train_dir), "--host-id", str(host_id),
+        "--n-hosts", "2", "--rounds", "400", "--period", "0.05",
+        "--patience", "4", "--stop-epoch", "2", "--max-seconds", "60",
+        "--init-timeout", "20",
+        "--chaos", "partition@3:0-1:0.8", *extra,
+    ]
+    if port is not None:
+        cmd += ["--coordinator", f"127.0.0.1:{port}"]
+    return subprocess.Popen(
+        cmd, env=env, cwd=_REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def _result_line(stdout):
+    for line in stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    return None
+
+
+def test_two_process_formation_drill_forms_shrinks_reforms(tmp_path):
+    """The tentpole drill with REAL jax.distributed formation: world 2
+    forms, a store partition shrinks it to 1 (the survivor re-forms
+    alone after the excluded host joins the shutdown barrier), the
+    healed host is re-admitted and BOTH re-form at world 2 — then
+    ``report --fleet --strict`` holds (rc=0)."""
+    d = tmp_path / "fleet"
+    port = _free_port()
+    procs = [
+        _launch_member(d, 0, port),
+        _launch_member(d, 1, port),
+    ]
+    outs = [p.communicate(timeout=120) for p in procs]
+    results = {}
+    for (out, err), p in zip(outs, procs):
+        assert p.returncode == 0, (out[-2000:], err[-2000:])
+        r = _result_line(out)
+        assert r is not None, out[-2000:]
+        results[r["host"]] = r
+    assert sorted(results) == [0, 1]
+    for r in results.values():
+        assert r["formed"] and r["member"]
+        assert r["epoch"] == 2 and r["world"] == 2
+    assert results[0]["roster_hash"] == results[1]["roster_hash"]
+    assert results[0]["reforms"] == 2  # world 1 at epoch 1, 2 at epoch 2
+    assert results[1]["reforms"] == 1  # rejoined at epoch 2
+    assert results[1]["cut_rounds"] > 0
+
+    # the excluded host recorded its half of the barrier story
+    inc1 = IncidentLog.read(
+        os.path.join(hosts_dir(str(d)), "1.incidents.jsonl")
+    )
+    assert any(
+        r.get("action") == "collective_released" for r in inc1
+    ), inc1
+
+    rc = subprocess.run(
+        [sys.executable, "-m", "atomo_tpu.cli", "report", "--train-dir",
+         str(d), "--fleet", "--strict"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120, cwd=_REPO_ROOT,
+    )
+    assert rc.returncode == 0, (rc.stdout[-2000:], rc.stderr[-2000:])
+    assert "consistency: OK" in rc.stdout

@@ -576,6 +576,110 @@ def test_reshard_live_state_equals_fresh_build():
     assert np.isfinite(float(m2["loss"]))
 
 
+# ------------------------------------- live re-shard, replicated layout
+
+
+def test_reshard_replicated_trainstate_is_fresh_build_bit_exact():
+    """The elastic live path's determinism argument, at the primitive:
+    reshard_replicated(state, mesh') == replicate_state(mesh',
+    device_get(state)) leaf-wise bit-exact — the resharded trajectory IS
+    the fresh-build-and-continue trajectory by construction."""
+    from atomo_tpu.mesh import reshard_replicated
+
+    mesh, model, opt, host, images, labels = _setup(n_dev=4)
+    state = replicate_state(mesh, host)
+    step = make_distributed_train_step(
+        model, opt, mesh, QSGD, aggregate="gather"
+    )
+    si, sl = shard_batch(mesh, images, labels)
+    for _ in range(2):
+        state, _ = step(state, jax.random.PRNGKey(1), si, sl)
+    mesh2 = make_mesh(3)
+    moved = reshard_replicated(state, mesh2)
+    fresh = replicate_state(mesh2, jax.device_get(state))
+    assert _eq(jax.device_get(moved), jax.device_get(fresh))
+    # and it steps on the new mesh
+    step2 = make_distributed_train_step(
+        model, opt, mesh2, QSGD, aggregate="gather"
+    )
+    b = images.shape[0] - images.shape[0] % 3
+    si2, sl2 = shard_batch(mesh2, images[:b], labels[:b])
+    moved, m2 = step2(moved, jax.random.PRNGKey(2), si2, sl2)
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_reshard_replicated_delayed_carry_moves_with_owners():
+    """DelayedState: shrink re-slices the SURVIVORS' in-flight payload
+    rows (valid rides along); grow resets to the fresh valid=0 carry
+    (one in-flight update dropped, stated)."""
+    from atomo_tpu.mesh import reshard_replicated
+    from atomo_tpu.parallel.replicated import DelayedState, OverlapCarry
+
+    mesh, model, opt, host, *_ = _setup(n_dev=4)
+    ds = init_delayed_state(mesh, replicate_state(mesh, host), QSGD)
+    # make every per-source row distinguishable: row i = i + 1
+    stamp = jax.tree_util.tree_map(
+        lambda a: np.asarray(jax.device_get(a))
+        + np.arange(1, 5, dtype=np.float32).reshape(
+            (4,) + (1,) * (a.ndim - 1)
+        ).astype(np.asarray(a).dtype),
+        jax.device_get(ds.carry.payload),
+    )
+    from atomo_tpu.parallel.replicated import _place_carry
+
+    carry = _place_carry(
+        mesh,
+        OverlapCarry(
+            payload=stamp,
+            ok=np.asarray([1.0, 0.0, 1.0, 1.0], np.float32),
+            valid=np.float32(1.0),
+        ),
+    )
+    ds = DelayedState(train=ds.train, carry=carry)
+
+    shrunk = reshard_replicated(
+        ds, make_mesh(2), survivors=(0, 2), codec=QSGD
+    )
+    got = jax.device_get(shrunk.carry.payload)
+    want = jax.tree_util.tree_map(lambda a: a[[0, 2]], stamp)
+    assert _eq(got, want)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(shrunk.carry.ok)), [1.0, 1.0]
+    )
+    assert float(jax.device_get(shrunk.carry.valid)) == 1.0
+
+    grown = reshard_replicated(ds, make_mesh(8), codec=QSGD)
+    assert float(jax.device_get(grown.carry.valid)) == 0.0
+    assert int(jax.device_get(grown.carry.ok).shape[0]) == 8
+
+
+def test_reshard_replicated_refusals_are_loud():
+    """Every unsafe reshape REFUSES with the reason the coordinator
+    records in its reshard_fallback incident: wrapped layouts, a
+    DelayedState without its codec, a codec whose encode does not match
+    the in-flight payload, malformed survivor ranks."""
+    from atomo_tpu.mesh import reshard_replicated
+
+    mesh, model, opt, host, *_ = _setup(n_dev=4)
+    st, _su = sharded_update_state(mesh, host, opt)
+    with pytest.raises(ValueError, match="reshard_sharded_update"):
+        reshard_replicated(st, make_mesh(2))
+
+    ds = init_delayed_state(mesh, replicate_state(mesh, host), QSGD)
+    with pytest.raises(ValueError, match="needs the run's codec"):
+        reshard_replicated(ds, make_mesh(2), survivors=(0, 2))
+    with pytest.raises(ValueError, match="carry/codec mismatch"):
+        reshard_replicated(
+            ds, make_mesh(2), survivors=(0, 2),
+            codec=QsgdCodec(bits=8, bucket_size=32),
+        )
+    for bad in ((2, 0), (0,), (0, 5)):
+        with pytest.raises(ValueError, match="survivor"):
+            reshard_replicated(
+                ds, make_mesh(2), survivors=bad, codec=QSGD
+            )
+
+
 # ------------------------------------------------ decision_reusable mesh
 
 
